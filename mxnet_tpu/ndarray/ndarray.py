@@ -1,0 +1,774 @@
+"""NDArray — imperative array with async semantics, views and autograd.
+
+TPU-native redesign of the reference NDArray (include/mxnet/ndarray.h:82,
+src/ndarray/ndarray.cc). The reference pairs every array with a dependency-
+engine variable and pushes kernels to per-device worker threads; here the
+array is a mutable cell over a `jax.Array` (a PJRT buffer): dispatch is
+already async (XLA enqueues and returns), `wait_to_read` is
+`block_until_ready`, and cross-device copy is `jax.device_put`. In-place
+mutation (`x += 1`, slice assignment, optimizer updates) rebinds the cell to
+a new buffer — with XLA donating inputs inside jitted steps, so there is no
+2x memory cost on the hot path. Views (`ndarray.h:525 Slice/At`) are
+write-through: mutating a view updates the parent via a functional
+scatter (`.at[idx].set`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype, numeric_types, integer_types
+from ..context import Context, current_context, context_from_jax_device
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concat", "concatenate", "stack", "from_jax", "waitall",
+           "save", "load", "imperative_invoke", "moveaxis", "split", "where",
+           "broadcast_to", "clip", "one_hot", "take", "tile", "repeat", "dot",
+           "batch_dot", "expand_dims", "transpose", "reshape", "squeeze",
+           "flip", "argsort", "sort", "topk"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class NDArray:
+    """An n-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_ctx", "_view_parent", "_view_index",
+                 "grad_req", "_grad", "_tape_entry", "_deferred_init",
+                 "__weakref__")
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx
+        self._view_parent = None
+        self._view_index = None
+        self.grad_req = "null"
+        self._grad = None
+        self._tape_entry = None
+        self._deferred_init = None
+
+    # ------------------------------------------------------------------ core
+    @property
+    def data_(self):
+        """The underlying jax.Array (or tracer during a jit trace)."""
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(str(self._data.dtype)) if str(self._data.dtype) != "bfloat16" else self._data.dtype
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        devs = getattr(self._data, "devices", None)
+        if devs is not None:
+            try:
+                dev = next(iter(self._data.devices()))
+                self._ctx = context_from_jax_device(dev)
+                return self._ctx
+            except Exception:
+                pass
+        return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element NDArray")
+        return bool(self.asnumpy().reshape(())[()])
+
+    def __float__(self):
+        return float(self.asnumpy().reshape(())[()])
+
+    def __int__(self):
+        return int(self.asnumpy().reshape(())[()])
+
+    def __index__(self):
+        return int(self)
+
+    def item(self):
+        return self.asnumpy().reshape(())[()]
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:  # inside a trace
+            body = f"<traced {self.shape} {self.dtype}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # ----------------------------------------------------------- engine sync
+    def wait_to_read(self):
+        """Block until the value is computed (ndarray.h:368 WaitToRead)."""
+        _jax().block_until_ready(self._data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar")
+        return self.item()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -------------------------------------------------------------- mutation
+    def _set_data(self, new_data):
+        """Rebind the cell; write through views to the parent. The trace
+        session is notified *before* the rebind so it can capture the
+        pre-mutation value for discovery-pass rollback."""
+        from ..jit import _notify_mutation
+
+        _notify_mutation(self)
+        if self._view_parent is not None:
+            p = self._view_parent
+            p._set_data(p._data.at[self._view_index].set(new_data))
+            self._data = p._data[self._view_index]
+        else:
+            self._data = new_data
+
+    def _make_view(self, index):
+        child = NDArray(self._data[index], self._ctx)
+        child._view_parent = self
+        child._view_index = index
+        return child
+
+    # ------------------------------------------------------------- transfers
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(_jax().device_put(self._data, other.jax_device()), other)
+        if isinstance(other, NDArray):
+            other._set_data(_jax().device_put(self._data, other.context.jax_device()))
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copy(self):
+        return NDArray(self._data + 0 if self.dtype != _np.dtype(bool) else self._data.copy(), self._ctx)
+
+    def astype(self, dtype, copy=True):
+        d = _jnp().asarray(self._data, dtype=np_dtype(dtype))
+        if not copy and d is self._data:
+            return self
+        return NDArray(d, self._ctx)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types are not supported on TPU "
+                             "(see SURVEY.md §7: dense Embedding path instead)")
+        return self
+
+    # -------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        self.grad_req = grad_req
+        self._grad = NDArray(_jnp().zeros(self.shape, self._data.dtype), self._ctx)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- indexing
+    def _index_to_jax(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._index_to_jax(key)
+        if isinstance(key, (int, slice)) or (
+            isinstance(key, tuple) and all(isinstance(k, (int, slice, type(Ellipsis), type(None))) for k in key)
+        ):
+            return self._make_view(key)
+        return NDArray(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        key = self._index_to_jax(key)
+        jnp = _jnp()
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, numeric_types):
+            pass
+        else:
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        if isinstance(key, slice) and key == slice(None):
+            if isinstance(value, numeric_types):
+                self._set_data(jnp.full(self.shape, value, self._data.dtype))
+            else:
+                value = jnp.asarray(value, dtype=self._data.dtype)
+                self._set_data(jnp.broadcast_to(value, self.shape) + jnp.zeros((), self._data.dtype))
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    def slice_assign(self, rhs, begin, end, step=None):
+        idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step or [None] * len(begin)))
+        self[idx] = rhs
+        return self
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary(self, other, opname, reverse=False):
+        if isinstance(other, NDArray):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return imperative_invoke(opname, lhs, rhs)[0]
+        if isinstance(other, numeric_types):
+            return imperative_invoke(opname + "_scalar", self,
+                                     scalar=float(other), reverse=reverse)[0]
+        if isinstance(other, _np.ndarray):
+            return self._binary(array(other, ctx=self.context, dtype=other.dtype), opname, reverse)
+        raise TypeError(f"unsupported operand type {type(other)} for {opname}")
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elemwise_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "elemwise_mod")
+
+    def __rmod__(self, o):
+        return self._binary(o, "elemwise_mod", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elemwise_pow")
+
+    def __rpow__(self, o):
+        return self._binary(o, "elemwise_pow", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke("negative", self)[0]
+
+    def __abs__(self):
+        return imperative_invoke("abs", self)[0]
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        out = self._binary(o, "elemwise_add")
+        self._set_data(out._data)
+        return self
+
+    def __isub__(self, o):
+        out = self._binary(o, "elemwise_sub")
+        self._set_data(out._data)
+        return self
+
+    def __imul__(self, o):
+        out = self._binary(o, "elemwise_mul")
+        self._set_data(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self._binary(o, "elemwise_div")
+        self._set_data(out._data)
+        return self
+
+    # ------------------------------------------------------------- reshaping
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        # MXNet special codes: 0 copy dim, -1 infer (subset supported)
+        newshape = []
+        for i, s in enumerate(shape):
+            newshape.append(self.shape[i] if s == 0 else s)
+        return NDArray(self._data.reshape(tuple(newshape)), self._ctx)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self
+
+    def expand_dims(self, axis):
+        return NDArray(_jnp().expand_dims(self._data, axis), self._ctx)
+
+    def squeeze(self, axis=None):
+        return NDArray(_jnp().squeeze(self._data, axis), self._ctx)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return NDArray(_jnp().transpose(self._data, axes or None), self._ctx)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def swapaxes(self, a1, a2):
+        return NDArray(_jnp().swapaxes(self._data, a1, a2), self._ctx)
+
+    def split(self, num_outputs, axis=0):
+        return split(self, num_outputs, axis)
+
+    def broadcast_to(self, shape):
+        return NDArray(_jnp().broadcast_to(self._data, shape), self._ctx)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps):
+        return NDArray(_jnp().tile(self._data, reps), self._ctx)
+
+    def repeat(self, repeats, axis=None):
+        return NDArray(_jnp().repeat(self._data, repeats, axis), self._ctx)
+
+    def pad(self, pad_width, mode="constant", constant_value=0):
+        return NDArray(_jnp().pad(self._data, pad_width, mode=mode,
+                                  constant_values=constant_value), self._ctx)
+
+    def flip(self, axis):
+        return NDArray(_jnp().flip(self._data, axis), self._ctx)
+
+    def diag(self, k=0):
+        return NDArray(_jnp().diag(self._data, k), self._ctx)
+
+    # ------------------------------------------------------------ reductions
+    def _reduce(self, opname, axis=None, keepdims=False, **kw):
+        return imperative_invoke(opname, self, axis=_norm_axis(axis),
+                                 keepdims=keepdims, **kw)[0]
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._reduce("norm", axis, keepdims, ord=ord)
+
+    def argmax(self, axis=None, keepdims=False):
+        return imperative_invoke("argmax", self, axis=axis, keepdims=keepdims)[0]
+
+    def argmin(self, axis=None, keepdims=False):
+        return imperative_invoke("argmin", self, axis=axis, keepdims=keepdims)[0]
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return argsort(self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return topk(self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    # ---------------------------------------------------------------- math
+    def __getattr_math(self):  # documentation anchor only
+        pass
+
+    def dot(self, other, **kw):
+        return dot(self, other, **kw)
+
+    def abs(self):
+        return imperative_invoke("abs", self)[0]
+
+    def sqrt(self):
+        return imperative_invoke("sqrt", self)[0]
+
+    def square(self):
+        return imperative_invoke("square", self)[0]
+
+    def exp(self):
+        return imperative_invoke("exp", self)[0]
+
+    def log(self):
+        return imperative_invoke("log", self)[0]
+
+    def relu(self):
+        return imperative_invoke("relu", self)[0]
+
+    def sigmoid(self):
+        return imperative_invoke("sigmoid", self)[0]
+
+    def tanh(self):
+        return imperative_invoke("tanh", self)[0]
+
+    def softmax(self, axis=-1):
+        return imperative_invoke("softmax", self, axis=axis)[0]
+
+    def log_softmax(self, axis=-1):
+        return imperative_invoke("log_softmax", self, axis=axis)[0]
+
+    def clip(self, a_min=None, a_max=None):
+        return clip(self, a_min, a_max)
+
+    def sign(self):
+        return imperative_invoke("sign", self)[0]
+
+    def round(self):
+        return imperative_invoke("round", self)[0]
+
+    def floor(self):
+        return imperative_invoke("floor", self)[0]
+
+    def ceil(self):
+        return imperative_invoke("ceil", self)[0]
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return one_hot(self, depth, on_value, off_value)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return take(self, indices, axis=axis, mode=mode)
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# imperative invoke: the eager dispatch path (parity: MXImperativeInvokeEx ->
+# Imperative::Invoke, src/imperative/imperative.cc:89). Wraps raw arrays,
+# honors `mutate` slots, and records on the autograd tape.
+# ---------------------------------------------------------------------------
+
+def imperative_invoke(opname, *inputs, out=None, **params):
+    from .. import autograd
+
+    op = _reg.get_op(opname)
+    params = op.normalize(params)
+    in_arrays = [x._data for x in inputs]
+    ctx = inputs[0].context if inputs else params.pop("ctx", None) or current_context()
+    import jax.core as jcore
+
+    traced = any(isinstance(a, jcore.Tracer) for a in in_arrays)
+    device = None if traced else ctx.jax_device()
+    raw = _reg.invoke(opname, *in_arrays, device=device, **params)
+    n_primary = op.n_out(params)
+    outputs = [NDArray(r, ctx) for r in raw[:n_primary]]
+    # write mutated aux slots (e.g. BatchNorm running stats, optimizer weights)
+    if op.mutate:
+        for slot_name, val in zip(op.mutate, raw[n_primary:]):
+            idx = slot_name if isinstance(slot_name, int) else None
+            if idx is None:
+                raise MXNetError("mutate slots must be input indices")
+            inputs[idx]._set_data(val)
+    from ..jit import _notify_io
+
+    _notify_io(inputs, outputs)
+    if autograd.is_recording() and not op.no_grad:
+        autograd.record_op(op, params, list(inputs), outputs)
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o, r in zip(outs, outputs):
+            o._set_data(r._data)
+        return list(outs)
+    return outputs
+
+
+# ---------------------------------------------------------------------------
+# creation / free functions
+# ---------------------------------------------------------------------------
+
+def _device_of(ctx):
+    return (ctx or current_context()).jax_device()
+
+
+def from_jax(x, ctx=None):
+    return NDArray(x, ctx)
+
+
+def array(source, ctx=None, dtype=None):
+    jnp = _jnp()
+    if isinstance(source, NDArray):
+        source = source._data
+    if dtype is None and not hasattr(source, "dtype"):
+        dtype = _np.float32
+    data = _np.asarray(source, dtype=np_dtype(dtype)) if not hasattr(source, "ndim") or isinstance(source, _np.ndarray) else source
+    ctx = ctx or current_context()
+    return NDArray(_jax().device_put(jnp.asarray(data, dtype=np_dtype(dtype)), ctx.jax_device()), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kw):
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    ctx = ctx or current_context()
+    return NDArray(_jax().device_put(
+        _jnp().zeros(shape, np_dtype(dtype) or _np.float32), ctx.jax_device()), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kw):
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    ctx = ctx or current_context()
+    return NDArray(_jax().device_put(
+        _jnp().ones(shape, np_dtype(dtype) or _np.float32), ctx.jax_device()), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    shape = (shape,) if isinstance(shape, integer_types) else tuple(shape)
+    ctx = ctx or current_context()
+    return NDArray(_jax().device_put(
+        _jnp().full(shape, val, np_dtype(dtype) or _np.float32), ctx.jax_device()), ctx)
+
+
+def zeros_like(a):
+    return zeros(a.shape, a.context, a.dtype)
+
+
+def ones_like(a):
+    return ones(a.shape, a.context, a.dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    jnp = _jnp()
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype) or _np.float32)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    ctx = ctx or current_context()
+    return NDArray(_jax().device_put(out, ctx.jax_device()), ctx)
+
+
+def concat(*arrays, dim=1, axis=None):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    axis = dim if axis is None else axis
+    return NDArray(_jnp().concatenate([a._data for a in arrays], axis=axis),
+                   arrays[0]._ctx)
+
+
+def concatenate(arrays, axis=0):
+    return concat(*arrays, dim=axis)
+
+
+def stack(*arrays, axis=0):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return NDArray(_jnp().stack([a._data for a in arrays], axis=axis),
+                   arrays[0]._ctx)
+
+
+def split(ary, num_outputs, axis=0, squeeze_axis=False):
+    parts = _jnp().split(ary._data, num_outputs, axis=axis)
+    out = [NDArray(p, ary._ctx) for p in parts]
+    if squeeze_axis:
+        out = [NDArray(_jnp().squeeze(p._data, axis), ary._ctx) for p in out]
+    return out if len(out) > 1 else out[0]
+
+
+def where(cond, x, y):
+    return imperative_invoke("where", cond, x, y)[0]
+
+
+def broadcast_to(a, shape):
+    return a.broadcast_to(shape)
+
+
+def clip(a, a_min=None, a_max=None):
+    return imperative_invoke("clip", a, a_min=a_min, a_max=a_max)[0]
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return imperative_invoke("one_hot", indices, depth=int(depth),
+                             on_value=on_value, off_value=off_value,
+                             dtype=str(dtype))[0]
+
+
+def take(a, indices, axis=0, mode="clip"):
+    return imperative_invoke("take", a, indices, axis=axis, mode=mode)[0]
+
+
+def tile(a, reps):
+    return a.tile(reps)
+
+
+def repeat(a, repeats, axis=None):
+    return a.repeat(repeats, axis)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    return imperative_invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                             transpose_b=transpose_b)[0]
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    return imperative_invoke("batch_dot", lhs, rhs, transpose_a=transpose_a,
+                             transpose_b=transpose_b)[0]
+
+
+def expand_dims(a, axis):
+    return a.expand_dims(axis)
+
+
+def transpose(a, axes=None):
+    return a.transpose(axes) if axes is not None else a.transpose()
+
+
+def reshape(a, shape):
+    return a.reshape(shape)
+
+
+def squeeze(a, axis=None):
+    return a.squeeze(axis)
+
+
+def flip(a, axis):
+    return a.flip(axis)
+
+
+def moveaxis(a, source, destination):
+    return NDArray(_jnp().moveaxis(a._data, source, destination), a._ctx)
+
+
+def argsort(a, axis=-1, is_ascend=True, dtype="float32"):
+    return imperative_invoke("argsort", a, axis=axis, is_ascend=bool(is_ascend),
+                             dtype=str(dtype))[0]
+
+
+def sort(a, axis=-1, is_ascend=True):
+    return imperative_invoke("sort", a, axis=axis, is_ascend=bool(is_ascend))[0]
+
+
+def topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    out = imperative_invoke("topk", a, axis=axis, k=int(k), ret_typ=ret_typ,
+                            is_ascend=bool(is_ascend), dtype=str(dtype))
+    return out if len(out) > 1 else out[0]
+
+
+def waitall():
+    """Parity: mx.nd.waitall() (Engine WaitForAll)."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# ------------------------------------------------------------------- save/load
+# Parity: NDArray::Save/Load (ndarray.h:404), mx.nd.save/load param files.
+# Format: numpy .npz with a name manifest (single-host files, like the ref).
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        arrs, names = [data], ["__only__"]
+    elif isinstance(data, (list, tuple)):
+        arrs, names = list(data), [f"__list_{i}__" for i in range(len(data))]
+    elif isinstance(data, dict):
+        names, arrs = zip(*data.items()) if data else ((), ())
+        names, arrs = list(names), list(arrs)
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    _np.savez(fname if fname.endswith(".npz") else fname + ".npz",
+              **{n: a.asnumpy() for n, a in zip(names, arrs)})
+    import os
+
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    f = _np.load(fname, allow_pickle=False)
+    names = list(f.keys())
+    if names == ["__only__"]:
+        return [array(f["__only__"])]
+    if all(n.startswith("__list_") for n in names):
+        return [array(f[f"__list_{i}__"]) for i in range(len(names))]
+    return {n: array(f[n]) for n in names}

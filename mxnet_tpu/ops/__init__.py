@@ -1,0 +1,15 @@
+"""Operator library: registry + op families.
+
+Importing this package registers every operator (the analogue of the static
+NNVM_REGISTER_OP initializers in src/operator/*.cc).
+"""
+from . import registry
+from .registry import OpDef, apply_op, get_op, invoke, list_ops, register
+
+from . import math as _math            # noqa: F401  tensor/elemwise/linalg
+from . import nn as _nn                # noqa: F401  neural-net kernels
+from . import rnn as _rnn              # noqa: F401  fused RNN
+from . import optimizer_ops as _opt    # noqa: F401  optimizer updates
+from . import random_ops as _rand      # noqa: F401  samplers
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]

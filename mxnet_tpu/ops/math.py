@@ -1,0 +1,776 @@
+"""Elementwise / reduction / matrix / indexing operators.
+
+TPU-native coverage of the reference's src/operator/tensor/ families
+(elemwise_binary_op*, elemwise_unary_op*, broadcast_reduce_op*, matrix_op,
+indexing_op, ordering_op, dot, init_op — ~35k LoC of C++/CUDA there). Each
+op here is a jax function: XLA supplies kernels, fusion, and autodiff, so a
+family that needed forward+backward CUDA kernels in the reference is a few
+lines. Names mirror the reference registry (src/operator/tensor/*.cc) so the
+generated nd./sym. wrappers have the same surface.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --------------------------------------------------------------- binary (bcast)
+
+def _binary(name, fn, aliases=()):
+    register(name, aliases=aliases)(fn)
+
+
+import jax.numpy as jnp  # noqa: E402  (module-level: ops are pure jnp)
+import jax  # noqa: E402
+
+
+_binary("elemwise_add", lambda a, b: a + b, aliases=("broadcast_add", "broadcast_plus", "_plus", "_add"))
+_binary("elemwise_sub", lambda a, b: a - b, aliases=("broadcast_sub", "broadcast_minus", "_sub", "_minus"))
+_binary("elemwise_mul", lambda a, b: a * b, aliases=("broadcast_mul", "_mul"))
+_binary("elemwise_div", lambda a, b: a / b, aliases=("broadcast_div", "_div"))
+_binary("elemwise_mod", lambda a, b: jnp.mod(a, b), aliases=("broadcast_mod", "_mod"))
+_binary("elemwise_pow", lambda a, b: jnp.power(a, b), aliases=("broadcast_power", "_power", "_pow"))
+_binary("broadcast_maximum", jnp.maximum, aliases=("maximum", "_maximum"))
+_binary("broadcast_minimum", jnp.minimum, aliases=("minimum", "_minimum"))
+_binary("broadcast_hypot", jnp.hypot)
+_binary("broadcast_logaddexp", jnp.logaddexp)
+
+
+@register("elemwise_add_scalar", aliases=("_plus_scalar",))
+def _add_scalar(a, scalar=0.0, reverse=False):
+    return a + scalar
+
+
+@register("elemwise_sub_scalar", aliases=("_minus_scalar", "_rminus_scalar"))
+def _sub_scalar(a, scalar=0.0, reverse=False):
+    return scalar - a if reverse else a - scalar
+
+
+@register("elemwise_mul_scalar", aliases=("_mul_scalar",))
+def _mul_scalar(a, scalar=1.0, reverse=False):
+    return a * scalar
+
+
+@register("elemwise_div_scalar", aliases=("_div_scalar", "_rdiv_scalar"))
+def _div_scalar(a, scalar=1.0, reverse=False):
+    return scalar / a if reverse else a / scalar
+
+
+@register("elemwise_mod_scalar", aliases=("_mod_scalar", "_rmod_scalar"))
+def _mod_scalar(a, scalar=1.0, reverse=False):
+    return jnp.mod(scalar, a) if reverse else jnp.mod(a, scalar)
+
+
+@register("elemwise_pow_scalar", aliases=("_power_scalar", "_rpower_scalar"))
+def _pow_scalar(a, scalar=1.0, reverse=False):
+    return jnp.power(scalar, a) if reverse else jnp.power(a, scalar)
+
+
+# comparisons (return same-dtype 0/1 like the reference)
+def _cmp(name, fn):
+    def _f(a, b, fn=fn):
+        return fn(a, b).astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+
+    register(name, no_grad=True)(_f)
+
+    def _fs(a, scalar=0.0, reverse=False, fn=fn):
+        l, r = (scalar, a) if reverse else (a, scalar)
+        return fn(l, r).astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+
+    register(name + "_scalar", no_grad=True)(_fs)
+
+
+_cmp("broadcast_equal", jnp.equal)
+_cmp("broadcast_not_equal", jnp.not_equal)
+_cmp("broadcast_greater", jnp.greater)
+_cmp("broadcast_greater_equal", jnp.greater_equal)
+_cmp("broadcast_lesser", jnp.less)
+_cmp("broadcast_lesser_equal", jnp.less_equal)
+register("broadcast_logical_and", no_grad=True)(lambda a, b: jnp.logical_and(a, b).astype(a.dtype))
+register("broadcast_logical_or", no_grad=True)(lambda a, b: jnp.logical_or(a, b).astype(a.dtype))
+register("broadcast_logical_xor", no_grad=True)(lambda a, b: jnp.logical_xor(a, b).astype(a.dtype))
+register("logical_not", no_grad=True)(lambda a: jnp.logical_not(a).astype(a.dtype))
+
+
+# ---------------------------------------------------------------------- unary
+
+def _unary(name, fn, aliases=(), no_grad=False):
+    register(name, aliases=aliases, no_grad=no_grad)(fn)
+
+
+_unary("negative", lambda a: -a, aliases=("_np_negative",))
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign, no_grad=True)
+_unary("round", jnp.round, no_grad=True)
+_unary("rint", jnp.rint, no_grad=True)
+_unary("ceil", jnp.ceil, no_grad=True)
+_unary("floor", jnp.floor, no_grad=True)
+_unary("trunc", jnp.trunc, no_grad=True)
+_unary("fix", jnp.trunc, no_grad=True)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda a: jax.lax.rsqrt(a))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda a: 1.0 / jnp.cbrt(a))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("gamma", lambda a: jnp.exp(jax.lax.lgamma(a)))
+_unary("gammaln", lambda a: jax.lax.lgamma(a))
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("reciprocal", lambda a: 1.0 / a)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("identity", lambda a: a, aliases=("_copy", "stop_gradient_identity", "BlockGrad_inner"))
+register("BlockGrad", no_grad=True, aliases=("stop_gradient",))(lambda a: jax.lax.stop_gradient(a))
+register("make_loss")(lambda a, grad_scale=1.0: a)
+register("isnan", no_grad=True)(lambda a: jnp.isnan(a).astype(jnp.float32))
+register("isinf", no_grad=True)(lambda a: jnp.isinf(a).astype(jnp.float32))
+register("isfinite", no_grad=True)(lambda a: jnp.isfinite(a).astype(jnp.float32))
+
+
+@register("clip")
+def _clip(a, a_min=None, a_max=None):
+    return jnp.clip(a, a_min, a_max)
+
+
+@register("Cast", aliases=("cast",))
+def _cast(a, dtype="float32"):
+    return a.astype(np_dtype(dtype))
+
+
+@register("amp_cast")
+def _amp_cast(a, dtype="float32"):
+    return a.astype(np_dtype(dtype))
+
+
+@register("amp_multicast", num_outputs=lambda p: p.get("num_outputs", 1))
+def _amp_multicast(*arrays, num_outputs=1):
+    widest = jnp.result_type(*[a.dtype for a in arrays])
+    return tuple(a.astype(widest) for a in arrays)
+
+
+# ----------------------------------------------------------------- reductions
+
+def _axis(params_axis):
+    return params_axis
+
+
+@register("sum", aliases=("sum_axis", "_np_sum"))
+def _sum(a, axis=None, keepdims=False, exclude=False):
+    axis = _excl(a, axis, exclude)
+    return jnp.sum(a, axis=axis, keepdims=keepdims)
+
+
+def _excl(a, axis, exclude):
+    if exclude and axis is not None:
+        ax = (axis,) if isinstance(axis, int) else tuple(axis)
+        return tuple(i for i in range(a.ndim) if i not in ax)
+    return axis
+
+
+@register("mean")
+def _mean(a, axis=None, keepdims=False, exclude=False):
+    return jnp.mean(a, axis=_excl(a, axis, exclude), keepdims=keepdims)
+
+
+@register("prod")
+def _prod(a, axis=None, keepdims=False, exclude=False):
+    return jnp.prod(a, axis=_excl(a, axis, exclude), keepdims=keepdims)
+
+
+@register("max", aliases=("max_axis",))
+def _max(a, axis=None, keepdims=False, exclude=False):
+    return jnp.max(a, axis=_excl(a, axis, exclude), keepdims=keepdims)
+
+
+@register("min", aliases=("min_axis",))
+def _min(a, axis=None, keepdims=False, exclude=False):
+    return jnp.min(a, axis=_excl(a, axis, exclude), keepdims=keepdims)
+
+
+@register("nansum")
+def _nansum(a, axis=None, keepdims=False):
+    return jnp.nansum(a, axis=axis, keepdims=keepdims)
+
+
+@register("nanprod")
+def _nanprod(a, axis=None, keepdims=False):
+    return jnp.nanprod(a, axis=axis, keepdims=keepdims)
+
+
+@register("norm")
+def _norm(a, ord=2, axis=None, keepdims=False):
+    if ord == 2 and axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(a), keepdims=keepdims))
+    return jnp.linalg.norm(a, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("L2Normalization")
+def _l2norm(a, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        flat = a.reshape(a.shape[0], -1)
+        n = jnp.sqrt(jnp.sum(flat * flat, axis=1, keepdims=True) + eps)
+        return (flat / n).reshape(a.shape)
+    if mode == "channel":
+        n = jnp.sqrt(jnp.sum(a * a, axis=1, keepdims=True) + eps)
+        return a / n
+    n = jnp.sqrt(jnp.sum(a * a) + eps)
+    return a / n
+
+
+@register("argmax", no_grad=True)
+def _argmax(a, axis=None, keepdims=False):
+    out = jnp.argmax(a, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmin", no_grad=True)
+def _argmin(a, axis=None, keepdims=False):
+    return jnp.argmin(a, axis=axis, keepdims=bool(keepdims)).astype(jnp.float32)
+
+
+@register("argmax_channel", no_grad=True)
+def _argmax_channel(a):
+    return jnp.argmax(a, axis=1).astype(jnp.float32)
+
+
+@register("cumsum")
+def _cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=np_dtype(dtype))
+
+
+@register("cumprod")
+def _cumprod(a, axis=None, dtype=None):
+    return jnp.cumprod(a, axis=axis, dtype=np_dtype(dtype))
+
+
+# -------------------------------------------------------------------- matmul
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    """Parity: src/operator/tensor/dot.cc — MXU-targeted matmul.
+
+    Accumulate in f32 even for bf16 inputs (preferred_element_type) so the
+    MXU's native mixed-precision path is used."""
+    if transpose_a:
+        a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
+    if transpose_b:
+        b = b.T if b.ndim == 2 else jnp.moveaxis(b, -1, 0)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
+    ).astype(jnp.result_type(a.dtype, b.dtype))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------- linalg (la_op)
+
+@register("linalg_gemm")
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def _potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri")
+def _potri(a):
+    l = jnp.linalg.cholesky(a) if False else a  # input is already the cholesky factor
+    inv_l = jnp.linalg.inv(a)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("linalg_trsm")
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+
+    if rightside:
+        x = jsl.solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2),
+                                 lower=not lower, trans=1 if transpose else 0)
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jsl.solve_triangular(a, b, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm")
+def _trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    t = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        t = jnp.swapaxes(t, -1, -2)
+    return alpha * (jnp.matmul(b, t) if rightside else jnp.matmul(t, b))
+
+
+@register("linalg_syrk")
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_gelqf", num_outputs=2)
+def _gelqf(a):
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def _syevd(a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_sumlogdiag")
+def _sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def _extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def _makediag(a, offset=0):
+    return jax.vmap(lambda x: jnp.diag(x, k=offset))(a.reshape(-1, a.shape[-1])).reshape(
+        a.shape[:-1] + (a.shape[-1] + abs(offset), a.shape[-1] + abs(offset)))
+
+
+@register("linalg_det")
+def _det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet", num_outputs=2)
+def _slogdet(a):
+    s, l = jnp.linalg.slogdet(a)
+    return s, l
+
+
+@register("linalg_inverse")
+def _inverse(a):
+    return jnp.linalg.inv(a)
+
+
+# ------------------------------------------------------------------- reshape
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(a, shape=None, reverse=False):
+    tgt = []
+    src = list(a.shape)
+    shape = list(shape)
+    if reverse:
+        src = src[::-1]
+        shape = shape[::-1]
+    i = 0
+    for s in shape:
+        if s == 0:
+            tgt.append(src[i]); i += 1
+        elif s == -2:
+            tgt.append(src[i]); i += 1
+        elif s == -3:
+            tgt.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            pass  # handled by following dims
+        else:
+            tgt.append(s)
+            if s != -1:
+                i += 1
+    if reverse:
+        tgt = tgt[::-1]
+    return a.reshape(tuple(tgt))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(a):
+    return a.reshape(a.shape[0], -1)
+
+
+@register("transpose")
+def _transpose(a, axes=None):
+    return jnp.transpose(a, axes or None)
+
+
+@register("expand_dims")
+def _expand_dims(a, axis=0):
+    return jnp.expand_dims(a, axis)
+
+
+@register("squeeze")
+def _squeeze(a, axis=None):
+    return jnp.squeeze(a, axis)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(a, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(a.shape)
+    for ax, s in zip(axis, size):
+        shape[ax] = s
+    return jnp.broadcast_to(a, shape)
+
+
+@register("broadcast_to")
+def _broadcast_to(a, shape=()):
+    shape = tuple(a.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(a, shape)
+
+
+@register("broadcast_like")
+def _broadcast_like(a, b, lhs_axes=None, rhs_axes=None):
+    return jnp.broadcast_to(a, b.shape)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(a, dim1=0, dim2=0):
+    return jnp.swapaxes(a, dim1, dim2)
+
+
+@register("slice")
+def _slice(a, begin=(), end=(), step=()):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return a[tuple(idx)]
+
+
+@register("slice_axis")
+def _slice_axis(a, axis=0, begin=0, end=None):
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(begin, end)
+    return a[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(a, b, axes=()):
+    axes = axes or range(a.ndim)
+    idx = [slice(None)] * a.ndim
+    for ax in axes:
+        idx[ax] = slice(0, b.shape[ax])
+    return a[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",), param_normalizer=lambda p: {k: v for k, v in p.items() if k != "num_args"})
+def _concat(*arrays, dim=1):
+    return jnp.concatenate(arrays, axis=dim)
+
+
+@register("stack")
+def _stack(*arrays, axis=0, num_args=None):
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=lambda p: p.get("num_outputs", 1))
+def _split(a, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(a, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("split_v2", num_outputs=lambda p: p.get("_num_outputs", 1))
+def _split_v2(a, indices=(), axis=0, squeeze_axis=False, sections=0, _num_outputs=None):
+    if sections:
+        parts = jnp.split(a, sections, axis=axis)
+    else:
+        parts = jnp.split(a, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis) for p in parts]
+    return tuple(parts)
+
+
+@register("tile")
+def _tile(a, reps=()):
+    return jnp.tile(a, reps)
+
+
+@register("repeat")
+def _repeat(a, repeats=1, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(a, mode="constant", pad_width=(), constant_value=0.0):
+    pw = list(zip(pad_width[::2], pad_width[1::2]))
+    mode_map = {"constant": "constant", "edge": "edge", "reflect": "reflect"}
+    if mode == "constant":
+        return jnp.pad(a, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(a, pw, mode=mode_map[mode])
+
+
+@register("flip", aliases=("reverse",))
+def _flip(a, axis=0):
+    return jnp.flip(a, axis)
+
+
+@register("depth_to_space")
+def _depth_to_space(a, block_size=1):
+    n, c, h, w = a.shape
+    b = block_size
+    x = a.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(a, block_size=1):
+    n, c, h, w = a.shape
+    b = block_size
+    x = a.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("diag")
+def _diag(a, k=0, axis1=0, axis2=1):
+    if a.ndim == 1:
+        return jnp.diag(a, k)
+    return jnp.diagonal(a, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register("shape_array", no_grad=True)
+def _shape_array(a):
+    return jnp.asarray(a.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", no_grad=True)
+def _size_array(a):
+    return jnp.asarray([a.size], dtype=jnp.int32)
+
+
+@register("zeros_like", no_grad=True)
+def _zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+@register("ones_like", no_grad=True)
+def _ones_like(a):
+    return jnp.ones_like(a)
+
+
+# ------------------------------------------------------------------- indexing
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register("batch_take", no_grad=False)
+def _batch_take(a, indices):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("pick")
+def _pick(a, indices, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(indices.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(a, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    """Parity: src/operator/tensor/indexing_op.cc Embedding. Dense gather on
+    TPU (row_sparse grads are out of scope; see SURVEY.md §7 hard part 4)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("gather_nd")
+def _gather_nd(a, indices):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return a[idx]
+
+
+@register("scatter_nd", no_grad=True)
+def _scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("one_hot", no_grad=True)
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=np_dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("where")
+def _where(cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("boolean_mask")
+def _boolean_mask(data, mask, axis=0):
+    # dynamic-shape op: TPU requires static shapes; document + host fallback
+    import numpy as np
+
+    return jnp.compress(np.asarray(mask).astype(bool), data, axis=axis)
+
+
+@register("sequence_mask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    steps = jnp.arange(data.shape[axis])
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceMask")
+def _SequenceMask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    return _sequence_mask(data, sequence_length, use_sequence_length, value, axis)
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    batch = jnp.arange(data.shape[1 - axis])
+    if axis == 0:
+        return data[idx, batch]
+    return data[batch, idx]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < L, L - 1 - steps, steps)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[rev_idx, batch]
+
+
+# ------------------------------------------------------------------- ordering
+
+@register("argsort", no_grad=True)
+def _argsort(a, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(a if is_ascend else -a, axis=axis, stable=True)
+    return idx.astype(np_dtype(dtype))
+
+
+@register("sort", no_grad=True)
+def _sort(a, axis=-1, is_ascend=True):
+    out = jnp.sort(a, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("topk", no_grad=True,
+          num_outputs=lambda p: 2 if p.get("ret_typ") == "both" else 1)
+def _topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = axis if axis >= 0 else a.ndim + axis
+    moved = jnp.moveaxis(a, ax, -1)
+    vals, idx = jax.lax.top_k(-moved if is_ascend else moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(np_dtype(dtype))
+    if ret_typ == "mask":
+        oh = jnp.sum(jax.nn.one_hot(idx, a.shape[ax], axis=ax, dtype=a.dtype), axis=-1)
+        return oh
+    return idx.astype(np_dtype(dtype))
+
+
+# ---------------------------------------------------------------------- misc
+
+@register("histogram", no_grad=True, num_outputs=2)
+def _histogram(a, bin_cnt=10, range=None):
+    lo, hi = range if range is not None else (float(a.min()), float(a.max()))
+    cnt, edges = jnp.histogram(a, bins=bin_cnt, range=(lo, hi))
+    return cnt.astype(jnp.float32), edges.astype(jnp.float32)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"),
+          param_normalizer=lambda p: {k: v for k, v in p.items() if k != "num_args"})
+def _add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+@register("smooth_l1")
+def _smooth_l1(a, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(a) < 1.0 / s2, 0.5 * s2 * a * a, jnp.abs(a) - 0.5 / s2)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(a, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * a + beta, 0.0, 1.0)
+
+
+@register("digamma")
+def _digamma(a):
+    return jax.lax.digamma(a)

@@ -1,0 +1,198 @@
+"""Optimizer update operators.
+
+Parity: src/operator/optimizer_op.cc + contrib/{adamw,multi_lamb,multi_lars,
+all_finite}.cc. The reference keeps optimizer *state math in C++ kernels* and
+mutates weights in place; here each update is a jax function with `mutate`
+slots — inside a jitted train step XLA donates the buffers, so updates are
+in-place in HBM exactly like the reference, but fused with the backward pass.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update", mutate=(0,), no_grad=True)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=None, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_w = weight - lr * (g + wd * weight)
+    return new_w, new_w
+
+
+@register("sgd_mom_update", mutate=(0, 2), no_grad=True)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=None, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    new_w = weight + new_mom
+    return new_w, new_w, new_mom
+
+
+@register("nag_mom_update", mutate=(0, 2), no_grad=True)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    new_w = weight - lr * (g + momentum * new_mom)
+    return new_w, new_w, new_mom
+
+
+@register("mp_sgd_update", mutate=(0, 2), no_grad=True)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None, lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", mutate=(0, 2, 3), no_grad=True)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                       lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", mutate=(0, 2, 3), no_grad=True)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                 lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_w, new_mean, new_var
+
+
+@register("adamw_update", mutate=(0, 2, 3), no_grad=True)
+def _adamw_update(weight, grad, mean, var, rescale_grad_arr=None, lr=0.001,
+                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                  rescale_grad=1.0, clip_gradient=None):
+    rs = rescale_grad_arr if rescale_grad_arr is not None else rescale_grad
+    g = grad * rs
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight)
+    return new_w, new_w, new_mean, new_var
+
+
+@register("ftrl_update", mutate=(0, 2, 3), no_grad=True)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0)
+    return new_w, new_w, new_z, new_n
+
+
+@register("rmsprop_update", mutate=(0, 2), no_grad=True)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                    clip_weights=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    return new_w, new_w, new_n
+
+
+@register("rmspropalex_update", mutate=(0, 2, 3, 4), no_grad=True)
+def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=None, clip_weights=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_gavg = gamma1 * g_avg + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_gavg) + epsilon)
+    new_w = weight + new_delta
+    return new_w, new_w, new_n, new_gavg, new_delta
+
+
+@register("signsgd_update", mutate=(0,), no_grad=True)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_w = weight - lr * (jnp.sign(g) + wd * weight)
+    return new_w, new_w
+
+
+@register("signum_update", mutate=(0, 2), no_grad=True)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None, wd_lh=0.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_w, new_mom
+
+
+@register("lamb_update_phase1", no_grad=True)
+def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    return m / (jnp.sqrt(v) + epsilon) + wd * weight
+
+
+@register("lamb_update_phase2", mutate=(0,), no_grad=True)
+def _lamb_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound >= 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound >= 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    new_w = weight - lr * ratio * g_update
+    return new_w, new_w
+
+
+@register("all_finite", no_grad=True)
+def _all_finite(*arrays, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+    return ok.astype(jnp.float32)
+
+
+@register("multi_all_finite", no_grad=True,
+          param_normalizer=lambda p: {k: v for k, v in p.items() if k != "num_arrays"})
+def _multi_all_finite(*arrays, init_output=True):
+    return _all_finite(*arrays)
+
+
+@register("multi_sum_sq", no_grad=True,
+          num_outputs=lambda p: p.get("num_arrays", 1),
+          param_normalizer=lambda p: p)
+def _multi_sum_sq(*arrays, num_arrays=1):
+    return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays)
+
+
+@register("reset_arrays", no_grad=True,
+          mutate=(),  # handled by caller zeroing
+          param_normalizer=lambda p: {k: v for k, v in p.items() if k != "num_arrays"})
+def _reset_arrays(*arrays):
+    return tuple(jnp.zeros_like(a) for a in arrays)

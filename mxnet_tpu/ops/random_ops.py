@@ -1,0 +1,92 @@
+"""Random sampling operators.
+
+Parity: src/operator/random/sample_op.cc + multisample_op.cc, seeded by the
+framework RNG (src/common/random_generator.h). TPU-native design: the global
+RNG is an explicit uint32 key cell (mxnet_tpu.random) threaded through every
+sampling op as a mutable input — functional under jit, stateful at the API.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+def _keyed(name, draw):
+    """Register a sampler taking (key) -> (sample, new_key) with mutate on the
+    key slot (index 0)."""
+
+    def _fn(rng_key, shape=(), dtype="float32", **kw):
+        new_key, sub = jax.random.split(rng_key)
+        out = draw(sub, tuple(shape), np_dtype(dtype) or jnp.float32, **kw)
+        return out, new_key
+
+    _fn.__name__ = name
+    register(name, mutate=(0,), no_grad=True)(_fn)
+
+
+_keyed("_random_uniform", lambda k, s, d, low=0.0, high=1.0:
+       jax.random.uniform(k, s, d, minval=low, maxval=high))
+_keyed("_random_normal", lambda k, s, d, loc=0.0, scale=1.0:
+       jax.random.normal(k, s, d) * scale + loc)
+_keyed("_random_gamma", lambda k, s, d, alpha=1.0, beta=1.0:
+       jax.random.gamma(k, alpha, s, d) * beta)
+_keyed("_random_exponential", lambda k, s, d, lam=1.0:
+       jax.random.exponential(k, s, d) / lam)
+_keyed("_random_poisson", lambda k, s, d, lam=1.0:
+       jax.random.poisson(k, lam, s).astype(d))
+_keyed("_random_negative_binomial", lambda k, s, d, k_param=1, p=1.0:
+       jax.random.poisson(k, jax.random.gamma(jax.random.fold_in(k, 1), k_param, s) * (1 - p) / p, s).astype(d))
+_keyed("_random_generalized_negative_binomial", lambda k, s, d, mu=1.0, alpha=1.0:
+       jax.random.poisson(k, jax.random.gamma(jax.random.fold_in(k, 1), 1.0 / alpha, s) * alpha * mu, s).astype(d))
+_keyed("_random_randint", lambda k, s, d, low=0, high=1:
+       jax.random.randint(k, s, int(low), int(high), jnp.int32).astype(d))
+_keyed("_random_bernoulli", lambda k, s, d, p=0.5:
+       jax.random.bernoulli(k, p, s).astype(d))
+
+
+@register("_sample_multinomial", mutate=(1,), no_grad=True)
+def _sample_multinomial(data, rng_key, shape=(), get_prob=False, dtype="int32"):
+    new_key, sub = jax.random.split(rng_key)
+    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    logits = jnp.log(jnp.clip(data, 1e-20, None))
+    if data.ndim == 1:
+        out = jax.random.categorical(sub, logits, shape=(n,))
+        out = out.reshape(shape) if shape else out[0]
+    else:
+        out = jax.random.categorical(sub, logits[:, None, :].repeat(max(n, 1), axis=1), axis=-1)
+        out = out.reshape((data.shape[0],) + tuple(shape)) if shape else out[:, 0]
+    return out.astype(np_dtype(dtype)), new_key
+
+
+@register("_shuffle", mutate=(1,), no_grad=True)
+def _shuffle(data, rng_key):
+    new_key, sub = jax.random.split(rng_key)
+    return jax.random.permutation(sub, data, axis=0), new_key
+
+
+def _elem_sampler(name, draw):
+    """Samplers whose distribution params are arrays (broadcast elemwise)."""
+
+    def _fn(param1, param2, rng_key, shape=None, dtype="float32"):
+        new_key, sub = jax.random.split(rng_key)
+        out_shape = tuple(param1.shape) + tuple(shape or ())
+        out = draw(sub, param1, param2, out_shape, np_dtype(dtype) or jnp.float32)
+        return out, new_key
+
+    _fn.__name__ = name
+    register(name, mutate=(2,), no_grad=True)(_fn)
+
+
+def _bshape(p, s):
+    return p.reshape(p.shape + (1,) * (len(s) - p.ndim))
+
+
+_elem_sampler("_sample_uniform", lambda k, lo, hi, s, d:
+              jax.random.uniform(k, s, d) * _bshape(hi - lo, s) + _bshape(lo, s))
+_elem_sampler("_sample_normal", lambda k, mu, sig, s, d:
+              jax.random.normal(k, s, d) * _bshape(sig, s) + _bshape(mu, s))
+_elem_sampler("_sample_gamma", lambda k, a, b, s, d:
+              jax.random.gamma(k, _bshape(a, s), s, d) * _bshape(b, s))

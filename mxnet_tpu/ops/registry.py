@@ -1,0 +1,149 @@
+"""Operator registry — the TPU-native analogue of NNVM_REGISTER_OP.
+
+Reference convention (src/operator/*, include/mxnet/op_attr_types.h:218-316):
+each op registers FCompute/FInferShape/FInferType/FGradient attributes keyed
+by name. Here an op is a *jax-traceable Python function*; that single fact
+subsumes most of the reference's attribute surface:
+
+- FCompute<tpu>      = the function itself (XLA lowers it; Pallas for hot ops)
+- FInferShape/Type   = jax.eval_shape over the function (no hand-written rules)
+- FGradient          = jax.vjp over the function
+- FMutateInputs/aux  = declared `mutate` slots, handled by the NDArray cell
+- kernel fusion      = XLA fusion (replaces src/operator/fusion NVRTC JIT)
+
+Eager dispatch compiles one tiny XLA executable per (op, params, shapes) and
+caches it — the analogue of the reference's per-op engine push, with PJRT's
+async dispatch supplying the "return immediately, sync on read" semantics of
+the dependency engine (src/engine/threaded_engine.cc).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]
+
+_OPS: dict[str, "OpDef"] = {}
+_ALIASES: dict[str, str] = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (matches the reference op name where one exists)
+    fn : callable(*arrays, **params) -> array | tuple(arrays)
+        Pure, jax-traceable. Keyword params must be hashable (static).
+    num_outputs : int or callable(params)->int
+    mutate : tuple of keyword names whose NDArray argument is updated in
+        place from extra outputs (e.g. BatchNorm moving stats, optimizer
+        weight updates). fn must return (primary_outs..., *mutated_values).
+    wrap_param : optional callable normalizing params before dispatch.
+    """
+
+    __slots__ = (
+        "name", "fn", "num_outputs", "mutate", "aliases", "no_grad",
+        "param_normalizer", "doc",
+    )
+
+    def __init__(self, name, fn, num_outputs=1, mutate=(), aliases=(),
+                 no_grad=False, param_normalizer=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.mutate = tuple(mutate)
+        self.aliases = tuple(aliases)
+        self.no_grad = no_grad
+        self.param_normalizer = param_normalizer
+        self.doc = fn.__doc__
+
+    def n_out(self, params):
+        return self.num_outputs(params) if callable(self.num_outputs) else self.num_outputs
+
+    def normalize(self, params):
+        params = {k: v for k, v in params.items() if v is not None}
+        if self.param_normalizer is not None:
+            params = self.param_normalizer(params)
+        return params
+
+    def closed(self, params):
+        """fn with params bound, positional-arrays-only. Used for jit/vjp."""
+        fn = self.fn
+        return functools.partial(fn, **params) if params else fn
+
+
+def register(name, *, num_outputs=1, mutate=(), aliases=(), no_grad=False,
+             param_normalizer=None):
+    """Decorator registering a jax-traceable function as an operator."""
+
+    def _reg(fn):
+        op = OpDef(name, fn, num_outputs=num_outputs, mutate=mutate,
+                   aliases=aliases, no_grad=no_grad,
+                   param_normalizer=param_normalizer)
+        _OPS[name] = op
+        for a in aliases:
+            _ALIASES[a] = name
+        return fn
+
+    return _reg
+
+
+def get_op(name) -> OpDef:
+    op = _OPS.get(name)
+    if op is None:
+        canon = _ALIASES.get(name)
+        if canon is not None:
+            return _OPS[canon]
+        raise MXNetError(f"operator '{name}' is not registered")
+    return op
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _hashable(v):
+    if isinstance(v, (list,)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+# (op name, param key, device) -> compiled executable
+_EAGER_CACHE: dict = {}
+
+
+def _eager_fn(op: OpDef, params: dict, device):
+    key = (op.name, tuple(sorted((k, _hashable(v)) for k, v in params.items())), device)
+    fn = _EAGER_CACHE.get(key)
+    if fn is None:
+        import jax
+
+        # Output placement follows committed input buffers (PJRT); no device
+        # pin needed — the cache key still includes the device so per-device
+        # executables don't collide.
+        fn = jax.jit(op.closed(dict(params)))
+        _EAGER_CACHE[key] = fn
+    return fn
+
+
+def apply_op(name, *arrays, device=None, **params):
+    """Run an op on raw jax arrays. Inside a trace, call the function
+    directly so everything fuses into the surrounding jit; eagerly, go
+    through the per-op jit cache."""
+    op = get_op(name)
+    params = op.normalize(params)
+    import jax.core as jcore
+
+    if device is None or any(isinstance(a, jcore.Tracer) for a in arrays):
+        return op.closed(params)(*arrays)
+    return _eager_fn(op, params, device)(*arrays)
+
+
+def invoke(name, *arrays, device=None, **params):
+    """Invoke returning a tuple of outputs always."""
+    out = apply_op(name, *arrays, device=device, **params)
+    return out if isinstance(out, tuple) else (out,)

@@ -1,0 +1,162 @@
+"""Fused RNN operator (vanilla/LSTM/GRU, multi-layer, bidirectional).
+
+Parity: src/operator/rnn.cc + rnn-inl.h (cuDNN RNNForwardTraining) and the
+CPU open-coded path rnn_impl.h. TPU-native design: one `lax.scan` per
+(layer, direction) — XLA unrolls the gate matmuls onto the MXU and keeps the
+recurrence on-chip. Parameters arrive as the reference's single flat vector
+(packing convention of python/mxnet/gluon/rnn/rnn_layer.py:_forward_kernel:
+all weights [per layer, per direction: i2h, h2h], then all biases).
+Gate orders match cuDNN: LSTM (i, f, g, o), GRU (r, z, n).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _unpack(params, input_size, H, L, D, mode):
+    g = _GATES[mode]
+    ws, off = [], 0
+    for layer in range(L):
+        in_sz = input_size if layer == 0 else H * D
+        per_dir = []
+        for _ in range(D):
+            w_i2h = params[off: off + g * H * in_sz].reshape(g * H, in_sz)
+            off += g * H * in_sz
+            w_h2h = params[off: off + g * H * H].reshape(g * H, H)
+            off += g * H * H
+            per_dir.append([w_i2h, w_h2h, None, None])
+        ws.append(per_dir)
+    for layer in range(L):
+        for d in range(D):
+            ws[layer][d][2] = params[off: off + g * H]
+            off += g * H
+            ws[layer][d][3] = params[off: off + g * H]
+            off += g * H
+    return ws
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new)
+        return step
+    if mode == "gru":
+        return None  # handled specially (r gates h2h term)
+    act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+    def step(carry, gates):
+        (h,) = carry
+        return (act(gates),)
+    return step
+
+
+def _run_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, reverse):
+    """x: (T, N, in). Returns (out (T,N,H), hT, cT)."""
+    H = h0.shape[-1]
+    xs = jnp.flip(x, 0) if reverse else x
+    # hoist the input projection out of the scan: one big MXU matmul
+    gi_all = jnp.einsum("tni,gi->tng", xs, w_i2h) + b_i2h
+
+    if mode == "gru":
+        def step(carry, gi):
+            h = carry[0]
+            gh = jnp.einsum("nh,gh->ng", h, w_h2h) + b_h2h
+            gi_r, gi_z, gi_n = jnp.split(gi, 3, axis=-1)
+            gh_r, gh_z, gh_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(gi_r + gh_r)
+            z = jax.nn.sigmoid(gi_z + gh_z)
+            n = jnp.tanh(gi_n + r * gh_n)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+        carry0 = (h0,)
+    elif mode == "lstm":
+        cell = _cell_step(mode, H)
+
+        def step(carry, gi):
+            h = carry[0]
+            gates = gi + jnp.einsum("nh,gh->ng", h, w_h2h) + b_h2h
+            new = cell(carry, gates)
+            return new, new[0]
+        carry0 = (h0, c0)
+    else:
+        cell = _cell_step(mode, H)
+
+        def step(carry, gi):
+            h = carry[0]
+            gates = gi + jnp.einsum("nh,gh->ng", h, w_h2h) + b_h2h
+            new = cell(carry, gates)
+            return new, new[0]
+        carry0 = (h0,)
+    carry, out = jax.lax.scan(step, carry0, gi_all)
+    if reverse:
+        out = jnp.flip(out, 0)
+    hT = carry[0]
+    cT = carry[1] if mode == "lstm" else None
+    return out, hT, cT
+
+
+def _rnn_impl(data, parameters, state, state_cell, state_size, num_layers,
+              mode, bidirectional, p, rng_key=None):
+    T, N, input_size = data.shape
+    H, L = state_size, num_layers
+    D = 2 if bidirectional else 1
+    ws = _unpack(parameters, input_size, H, L, D, mode)
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            w_i2h, w_h2h, b_i2h, b_h2h = ws[layer][d]
+            out, hT, cT = _run_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h,
+                                         mode, reverse=(d == 1))
+            outs.append(out)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        x = jnp.concatenate(outs, axis=-1) if D == 2 else outs[0]
+        if p > 0 and layer < L - 1 and rng_key is not None:
+            rng_key, sub = jax.random.split(rng_key)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape).astype(x.dtype) / (1 - p)
+            x = x * mask
+    hF = jnp.stack(h_finals)
+    cF = jnp.stack(c_finals) if c_finals else None
+    return x, hF, cF
+
+
+def _rnn_nout(params):
+    if not params.get("state_outputs", False):
+        return 1
+    return 3 if params.get("mode") == "lstm" else 2
+
+
+@register("RNN", num_outputs=_rnn_nout)
+def _rnn(data, parameters, state, state_cell=None,
+         state_size=None, num_layers=1, bidirectional=False, mode="lstm",
+         p=0.0, state_outputs=False, projection_size=None,
+         lstm_state_clip_min=None, lstm_state_clip_max=None,
+         lstm_state_clip_nan=False, use_sequence_length=False, _train=True):
+    out, hF, cF = _rnn_impl(data, parameters, state,
+                            state_cell if mode == "lstm" else None,
+                            state_size, num_layers, mode, bidirectional,
+                            p if _train else 0.0)
+    if lstm_state_clip_min is not None and cF is not None:
+        cF = jnp.clip(cF, lstm_state_clip_min, lstm_state_clip_max)
+    if not state_outputs:
+        return out
+    if mode == "lstm":
+        return out, hF, cF
+    return out, hF

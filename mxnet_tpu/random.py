@@ -1,0 +1,131 @@
+"""Stateful random number API over an explicit key cell.
+
+Parity: python/mxnet/random.py + src/common/random_generator.h. The global
+generator is an NDArray holding a jax PRNG key; every sampling op takes the
+key as a mutable input and writes back the split key (SURVEY.md §7.8:
+"wrap a global threaded key-stream to preserve the API"). Because the key is
+an ordinary mutable cell, `mx.jit.trace` captures it as threaded state and
+sampling remains correct across steps inside one compiled executable.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "multinomial", "shuffle", "bernoulli",
+           "generator_key"]
+
+_KEY = None
+
+
+def _key_cell():
+    global _KEY
+    if _KEY is None:
+        seed(_np.random.randint(0, 2**31 - 1))
+    return _KEY
+
+
+def generator_key():
+    """The global key cell (NDArray) — pass to ops needing randomness."""
+    return _key_cell()
+
+
+def seed(seed_state, ctx="all"):
+    """Parity: mx.random.seed."""
+    global _KEY
+    import jax
+
+    from .ndarray.ndarray import NDArray
+
+    raw = jax.random.PRNGKey(int(seed_state))
+    if _KEY is None:
+        _KEY = NDArray(raw)
+    else:
+        _KEY._set_data(raw)
+
+
+def _invoke(opname, *arrays, **kw):
+    from .ndarray.ndarray import imperative_invoke
+
+    return imperative_invoke(opname, *arrays, **kw)[0]
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(low, NDArray):
+        return _invoke("_sample_uniform", low, high, _key_cell(),
+                       shape=_shape(shape), dtype=dtype)
+    r = _invoke("_random_uniform", _key_cell(), shape=_shape(shape),
+                dtype=str(dtype), low=float(low), high=float(high))
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(loc, NDArray):
+        return _invoke("_sample_normal", loc, scale, _key_cell(),
+                       shape=_shape(shape), dtype=dtype)
+    r = _invoke("_random_normal", _key_cell(), shape=_shape(shape),
+                dtype=str(dtype), loc=float(loc), scale=float(scale))
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype, ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None):
+    return _invoke("_random_randint", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), low=int(low), high=int(high))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(alpha, NDArray):
+        return _invoke("_sample_gamma", alpha, beta, _key_cell(),
+                       shape=_shape(shape), dtype=dtype)
+    return _invoke("_random_gamma", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), alpha=float(alpha), beta=float(beta))
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
+    return _invoke("_random_exponential", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), lam=1.0 / float(scale))
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    return _invoke("_random_poisson", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), lam=float(lam))
+
+
+def bernoulli(p=0.5, shape=None, dtype="float32", ctx=None):
+    return _invoke("_random_bernoulli", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), p=float(p))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    return _invoke("_sample_multinomial", data, _key_cell(),
+                   shape=_shape(shape), get_prob=get_prob, dtype=str(dtype))
+
+
+def shuffle(data, out=None):
+    r = _invoke("_shuffle", data, _key_cell())
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r

@@ -1,0 +1,25 @@
+"""Test harness config: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's test strategy (SURVEY.md §4): unit tests run on CPU;
+multi-device/sharding tests use the virtual device mesh the way the
+reference's multi-GPU tests used real GPUs.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("MXNET_TPU_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    yield

@@ -1,0 +1,139 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain():
+    x = nd.array([[0.5, -0.5], [0.25, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_two_branches():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = x * 3
+        y = (a + b).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0, 5.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(nd.array([10.0, 1.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 4.0])
+
+
+def test_detach_blockgrad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_fullyconnected_grad():
+    x = nd.array(np.random.rand(4, 8).astype(np.float32))
+    w = nd.array(np.random.rand(3, 8).astype(np.float32))
+    b = nd.zeros((3,))
+    for p in (x, w, b):
+        p.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, b, num_hidden=3)
+        loss = (y * y).sum()
+    loss.backward()
+    # numeric check vs numpy
+    yn = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(w.grad.asnumpy(), (2 * yn).T @ x.asnumpy(), rtol=1e-4)
+    np.testing.assert_allclose(b.grad.asnumpy(), (2 * yn).sum(0), rtol=1e-4)
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+    assert x.grad.asnumpy()[0] == 0.0  # .grad untouched by grad()
+
+
+def test_mutated_input_after_record():
+    # gradient uses the *recorded* value even if input mutated later
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x += 100
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_custom_function():
+    class MyClip(autograd.Function):
+        def forward(self, x):
+            return nd.clip(x, 0.0, 1.0)
+
+        def backward(self, dy):
+            return dy * 2  # deliberately nonstandard
+
+    f = MyClip()
+    x = nd.array([0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7

@@ -1,0 +1,152 @@
+"""NDArray unit tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+    b = nd.ones((4,), dtype="float64")
+    assert b.dtype == np.float64
+    c = nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((2 ** a).asnumpy(), [[2, 4], [8, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    np.testing.assert_allclose(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_inplace_and_views():
+    a = nd.zeros((4, 4))
+    a += 2
+    assert (a.asnumpy() == 2).all()
+    a[1:3] = 5
+    assert (a.asnumpy()[1:3] == 5).all()
+    assert (a.asnumpy()[0] == 2).all()
+    # write-through view (parity: NDArray::Slice aliasing, ndarray.h:525)
+    v = a[0]
+    v[:] = 9
+    assert (a.asnumpy()[0] == 9).all()
+    a[:] = 0
+    assert (a.asnumpy() == 0).all()
+
+
+def test_comparison_and_reduce():
+    a = nd.array([[1.0, 5.0], [3.0, 2.0]])
+    assert (a > 2).asnumpy().tolist() == [[0, 1], [1, 0]]
+    assert float(a.sum()) == 11.0
+    assert float(a.max()) == 5.0
+    assert a.sum(axis=0).shape == (2,)
+    assert a.mean(axis=1, keepdims=True).shape == (2, 1)
+    assert int(a.argmax(axis=1)[0]) == 1
+
+
+def test_reshape_transpose_concat():
+    a = nd.arange(0, 12).reshape((3, 4))
+    assert a.T.shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape((0, 2, 2)).shape == (3, 2, 2)  # 0 = copy dim
+    b = nd.concat(a, a, dim=0)
+    assert b.shape == (6, 4)
+    c = nd.stack(a, a, axis=0)
+    assert c.shape == (2, 3, 4)
+    parts = nd.split(b, 2, axis=0)
+    assert parts[0].shape == (3, 4)
+    assert nd.expand_dims(a, 0).shape == (1, 3, 4)
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T if False else nd.array(b.asnumpy().T), transpose_b=True).asnumpy(),
+        a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.copy()
+    c += 1
+    assert (a.asnumpy() == 1).all()
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+
+
+def test_indexing_advanced():
+    a = nd.arange(0, 12).reshape((3, 4))
+    idx = nd.array([0, 2], dtype="int32")
+    taken = nd.take(a, idx, axis=0)
+    assert taken.shape == (2, 4)
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), 4)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "params")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert (loaded["w"].asnumpy() == 1).all()
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    back = nd.load(fname)
+    assert len(back) == 2 and back[0].shape == (2,)
+
+
+def test_scalar_and_len():
+    a = nd.array([3.5])
+    assert a.asscalar() == pytest.approx(3.5)
+    assert float(a) == pytest.approx(3.5)
+    b = nd.zeros((5, 2))
+    assert len(b) == 5
+
+
+def test_wait_sync():
+    a = nd.ones((8, 8))
+    b = (a * 2).wait_to_read()
+    assert (b.asnumpy() == 2).all()
+    nd.waitall()
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    idx = nd.topk(a, k=2)
+    assert idx.asnumpy().tolist() == [[0, 2]]
+    both = nd.topk(a, k=2, ret_typ="both")
+    assert both[0].asnumpy().tolist() == [[3, 2]]
+    s = nd.sort(a)
+    assert s.asnumpy().tolist() == [[1, 2, 3]]
+    ags = nd.argsort(a)
+    assert ags.asnumpy().tolist() == [[1, 2, 0]]
+
+
+def test_where_clip_misc():
+    a = nd.array([-2.0, 0.5, 3.0])
+    np.testing.assert_allclose(nd.clip(a, 0, 1).asnumpy(), [0, 0.5, 1])
+    cond = nd.array([1.0, 0.0, 1.0])
+    np.testing.assert_allclose(
+        nd.where(cond, a, nd.zeros((3,))).asnumpy(), [-2, 0, 3])
