@@ -1,0 +1,278 @@
+"""Weight initializers (parity: python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import numpy as _np
+
+from .base import MXNetError, _Registry
+from . import random as _random
+from .ndarray import ndarray as _nd
+
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
+
+_INIT_REGISTRY = _Registry("initializer")
+
+
+def register(klass):
+    _INIT_REGISTRY.register(klass)
+    _INIT_REGISTRY.register(klass, name=klass.__name__.lower())
+    return klass
+
+
+def create(init, **kwargs):
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return _INIT_REGISTRY.get(init)(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (initializer.py:31)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("call signature: (InitDesc, NDArray)")
+        if desc.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif desc.endswith("min"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("max"):
+            self._init_one(desc, arr)
+        elif desc.endswith("moving_mean") or desc.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("moving_var") or desc.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif desc.endswith("moving_inv_var") or desc.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+_INIT_REGISTRY.register(Zero, name="zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+_INIT_REGISTRY.register(One, name="ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        if hasattr(self.value, "asnumpy"):
+            arr._set_data(self.value._data)
+        else:
+            arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        _random.uniform(-self.scale, self.scale, arr.shape,
+                        dtype=str(arr.dtype), out=arr)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        _random.normal(0, self.sigma, arr.shape, dtype=str(arr.dtype), out=arr)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr._set_data(_nd.array(self.scale * q.reshape(arr.shape),
+                                dtype=arr.dtype)._data)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier init needs >=2d weight, got {name} "
+                             f"with shape {shape}")
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            _random.uniform(-scale, scale, arr.shape, dtype=str(arr.dtype),
+                            out=arr)
+        else:
+            _random.normal(0, scale, arr.shape, dtype=str(arr.dtype), out=arr)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, _, arr):
+        weight = _np.zeros(arr.shape, dtype=_np.float32).reshape(-1)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(_nd.array(weight.reshape(shape), dtype=arr.dtype)._data)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = arr.shape[0] // 4
+        a = arr.asnumpy()
+        a[num_hidden: 2 * num_hidden] = self.forget_bias  # i, f, g, o order
+        arr._set_data(_nd.array(a, dtype=arr.dtype)._data)
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")
+
+
+class Load:
+    """Init from saved dict (initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = _nd.load(param)
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            arr._set_data(self.param[name]._data)
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError(f"cannot init {name}: not found and no default")

@@ -1,0 +1,7 @@
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, MXDataIter, ImageRecordIter, MNISTIter,
+                 CSVIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MXDataIter", "ImageRecordIter", "MNISTIter",
+           "CSVIter"]

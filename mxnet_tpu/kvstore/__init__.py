@@ -1,0 +1,3 @@
+from .kvstore import KVStore, KVStoreLocal, KVStoreDevice, KVStoreTPU, create
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "KVStoreTPU", "create"]

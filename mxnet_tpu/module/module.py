@@ -1,0 +1,378 @@
+"""Module — symbolic training over one or more device contexts.
+
+Parity: python/mxnet/module/module.py + executor_group.py. Multi-context
+data parallelism slices the batch across executors like
+DataParallelExecutorGroup (executor_group.py:144,282); on TPU the preferred
+scale-out is the mesh path (parallel/), but the multi-ctx API is kept so
+reference scripts run unchanged.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc, Uniform
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint)
+from ..ndarray import ndarray as nd
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from .. import optimizer as opt
+from .base_module import BaseModule, _as_list
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        context = context or current_context()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + self._state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._execs = []
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+        self._slices = None
+
+    # ------------------------------------------------------------ factories
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        from ..model import save_checkpoint
+
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    # ------------------------------------------------------------ properties
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._output_names, self._execs[0].outputs)]
+
+    # ----------------------------------------------------------------- bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._execs = []
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        self._data_shapes = [x if isinstance(x, tuple) else tuple(x)[:2] and x
+                             for x in data_shapes]
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes) if label_shapes else []
+        ndev = len(self._context)
+        batch_axis_sizes = {}
+        # slice batch across contexts (decide_slices, executor_group.py:282)
+        self._slices = []
+        total_batch = self._data_shapes[0][1][0] if not hasattr(self._data_shapes[0], "shape") else self._data_shapes[0].shape[0]
+
+        def _shape_of(desc):
+            return tuple(desc[1]) if isinstance(desc, (tuple, list)) else tuple(desc.shape)
+
+        def _name_of(desc):
+            return desc[0] if isinstance(desc, (tuple, list)) else desc.name
+
+        total_batch = _shape_of(self._data_shapes[0])[0]
+        if total_batch % ndev != 0:
+            raise MXNetError(f"batch size {total_batch} not divisible by "
+                             f"number of contexts {ndev}")
+        step = total_batch // ndev
+        self._slices = [slice(i * step, (i + 1) * step) for i in range(ndev)]
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names or name in self._label_names:
+                req[name] = "null"
+            elif name in self._fixed_param_names:
+                req[name] = "null"
+            else:
+                req[name] = grad_req if for_training else "null"
+        if inputs_need_grad:
+            for name in self._data_names:
+                req[name] = "write"
+        shapes = {}
+        for desc in self._data_shapes:
+            s = _shape_of(desc)
+            shapes[_name_of(desc)] = (step,) + s[1:]
+        for desc in self._label_shapes:
+            s = _shape_of(desc)
+            shapes[_name_of(desc)] = (step,) + s[1:]
+        self._execs = [
+            self._symbol.simple_bind(ctx, grad_req=req, **shapes)
+            for ctx in self._context]
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+
+    # --------------------------------------------------------------- params
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        initializer = initializer if initializer is not None else Uniform(0.01)
+        ex0 = self._execs[0]
+        if self._arg_params is None:
+            self._arg_params = {n: nd_zeros(ex0.arg_dict[n].shape, cpu(),
+                                            ex0.arg_dict[n].dtype)
+                                for n in self._param_names}
+        if self._aux_params is None:
+            self._aux_params = {n: nd_zeros(ex0.aux_dict[n].shape, cpu(),
+                                            ex0.aux_dict[n].dtype)
+                                for n in self._aux_names}
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    arr._set_data(cache_arr._data)
+            else:
+                if not allow_missing and initializer is None:
+                    raise MXNetError(f"{name} is not presented")
+                if initializer is not None:
+                    initializer(InitDesc(name), arr)
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            desc = InitDesc(name)
+            if aux_params is not None and name in aux_params:
+                arr._set_data(aux_params[name]._data)
+            elif initializer is not None and not name.endswith("rng_key"):
+                initializer(desc, arr)
+        self.params_initialized = True
+        self._params_dirty = False
+        for ex in self._execs:
+            ex.copy_params_from(self._arg_params, self._aux_params,
+                                allow_extra_params=True)
+
+    def get_params(self):
+        assert self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return self._arg_params, self._aux_params
+
+    def _sync_params_from_devices(self):
+        if not self._execs:
+            return
+        ex0 = self._execs[0]
+        for n in self._param_names:
+            self._arg_params[n]._set_data(ex0.arg_dict[n]._data)
+        for n in self._aux_names:
+            self._aux_params[n]._set_data(ex0.aux_dict[n]._data)
+        self._params_dirty = False
+
+    # ------------------------------------------------------------ optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        batch_size = sum(
+            (s.stop - s.start) for s in self._slices)
+        rescale_grad = 1.0 / batch_size
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kvstore:
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            _initialize_kvstore(
+                kvstore=kvstore,
+                param_arrays=[[ex.arg_dict[n] for ex in self._execs]
+                              for n in self._param_names],
+                arg_params=self._arg_params,
+                param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------ execution
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        labels = data_batch.label or []
+        for i, ex in enumerate(self._execs):
+            sl = self._slices[i]
+            feeds = {}
+            for name, arr in zip(self._data_names, data):
+                feeds[name] = arr[sl] if len(self._execs) > 1 else arr
+            for name, arr in zip(self._label_names, labels):
+                feeds[name] = arr[sl] if len(self._execs) > 1 else arr
+            ex.forward(is_train=is_train, **{
+                k: (v if isinstance(v, NDArray) else nd.array(v))
+                for k, v in feeds.items()})
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for ex in self._execs:
+            ex.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(
+                [[ex.arg_dict[n] for ex in self._execs]
+                 for n in self._param_names],
+                [[ex.grad_dict.get(n) for ex in self._execs]
+                 for n in self._param_names],
+                self._kvstore, self._param_names)
+        else:
+            _update_params(
+                [[ex.arg_dict[n] for ex in self._execs]
+                 for n in self._param_names],
+                [[ex.grad_dict.get(n) for ex in self._execs]
+                 for n in self._param_names],
+                updater=self._updater,
+                num_device=len(self._context),
+                kvstore=self._kvstore,
+                param_names=self._param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        outs = [ex.outputs for ex in self._execs]
+        if merge_multi_context and len(outs) > 1:
+            return [nd.concatenate([o[i] for o in outs], axis=0)
+                    for i in range(len(outs[0]))]
+        return outs[0] if merge_multi_context else outs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        grads = [[ex.grad_dict.get(n) for n in self._data_names]
+                 for ex in self._execs]
+        if merge_multi_context and len(grads) > 1:
+            return [nd.concatenate([g[i] for g in grads], axis=0)
+                    for i in range(len(grads[0]))]
+        return grads[0] if merge_multi_context else grads
+
+    def get_states(self, merge_multi_context=True):
+        return []
+
+    def set_states(self, states=None, value=None):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels if not pre_sliced else labels[0])),
+            dict(zip(self._output_names, self.get_outputs())))
+
+    def install_monitor(self, mon):
+        for ex in self._execs:
+            mon.install(ex)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes) if label_shapes else []
+        self.binded = False
+        execs_params = (self._arg_params, self._aux_params)
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        for ex in self._execs:
+            ex.copy_params_from(*execs_params, allow_extra_params=True)
+
+    def borrow_optimizer(self, shared_module):
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True

@@ -13,6 +13,8 @@ its preferred layout internally during compilation.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -332,18 +334,19 @@ def _softmax_activation(data, mode="instance"):
 # loss gradient. We implement them with custom VJPs so Module training matches
 # the reference (src/operator/softmax_output.cc).
 
-@jax.custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore, normalization_mult):
     return jax.nn.softmax(data, axis=-1)
 
 
 def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, normalization_mult):
     out = jax.nn.softmax(data, axis=-1)
-    return out, (out, label, grad_scale, ignore_label, use_ignore, normalization_mult)
+    return out, (out, label)
 
 
-def _softmax_output_bwd(res, g):
-    out, label, grad_scale, ignore_label, use_ignore, normalization_mult = res
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore,
+                        normalization_mult, res, g):
+    out, label = res
     if label.ndim == out.ndim:
         one_hot = label
     else:
@@ -353,7 +356,7 @@ def _softmax_output_bwd(res, g):
         mask = (label != ignore_label).astype(out.dtype)
         grad = grad * mask[..., None]
     grad = grad * grad_scale * normalization_mult
-    return grad, jnp.zeros_like(label), None, None, None, None
+    return grad, jnp.zeros_like(label)
 
 
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
@@ -381,7 +384,7 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     return out
 
 
-@jax.custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def _regression_core(data, label, kind, grad_scale):
     if kind == 1:
         return jax.nn.sigmoid(data)
@@ -390,18 +393,18 @@ def _regression_core(data, label, kind, grad_scale):
 
 def _regression_fwd(data, label, kind, grad_scale):
     out = jax.nn.sigmoid(data) if kind == 1 else data
-    return out, (out, label, kind, grad_scale)
+    return out, (out, label)
 
 
-def _regression_bwd(res, g):
-    out, label, kind, grad_scale = res
+def _regression_bwd(kind, grad_scale, res, g):
+    out, label = res
     label = label.reshape(out.shape)
     if kind == 2:  # MAE
         grad = jnp.sign(out - label)
     else:  # linear / logistic both use (pred - label)
         grad = out - label
     num = out.shape[1] if out.ndim > 1 else 1
-    return grad * grad_scale / num, jnp.zeros_like(label), None, None
+    return grad * grad_scale / num, jnp.zeros_like(label)
 
 
 _regression_core.defvjp(_regression_fwd, _regression_bwd)

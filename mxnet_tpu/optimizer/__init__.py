@@ -1,0 +1,9 @@
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta,
+                        RMSProp, Ftrl, Adamax, Nadam, Signum, SGLD, DCASGD,
+                        FTML, LAMB, LARS, LBSGD, Test, Updater, get_updater,
+                        create, register)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "Adamax", "Nadam", "Signum", "SGLD", "DCASGD",
+           "FTML", "LAMB", "LARS", "LBSGD", "Test", "Updater", "get_updater",
+           "create", "register"]

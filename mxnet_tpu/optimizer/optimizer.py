@@ -1,0 +1,622 @@
+"""Optimizers.
+
+Parity: python/mxnet/optimizer/optimizer.py (Optimizer base w/ registry,
+create_state, multi-precision master weights :234, 17 optimizers) backed by
+the fused update *operators* in ops/optimizer_ops.py — the same split as the
+reference, where state math lives in src/operator/optimizer_op.cc kernels.
+Each update mutates the weight cell in place; inside a traced train step the
+whole update fuses into the step executable with donated buffers.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError, _Registry
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["Optimizer", "Updater", "get_updater", "create", "register"]
+
+_OPT_REGISTRY = _Registry("optimizer")
+
+
+def register(klass):
+    _OPT_REGISTRY.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    return _OPT_REGISTRY.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (optimizer.py:53). Learning-rate/wd multipliers come
+    from param_dict / idx2name attributes exactly like the reference."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.aggregate_num = 0
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = weight.astype(_np.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            inner_state, w32 = state
+            g32 = grad.astype(_np.float32)
+            self.update(index, w32, g32, inner_state)
+            weight._set_data(w32.astype(_np.float16)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; use it to change the rate")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+        for name in self.idx2name.values():
+            if name.endswith(("_bias", "_gamma", "_beta")) and name not in self.wd_mult:
+                self.wd_mult[name] = 0.0
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = (self.lr_scheduler(self.num_update) if self.lr_scheduler
+              else self.lr)
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= getattr(self.param_dict[name], "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= getattr(self.param_dict[name], "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD + momentum (optimizer.py:527); fused kernel sgd(_mom)_update."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, weight.context, weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            _nd.imperative_invoke("sgd_mom_update", weight, grad, state,
+                                  momentum=self.momentum, **kw)
+        else:
+            _nd.imperative_invoke("sgd_update", weight, grad, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            if self.momentum != 0.0:
+                mom, w32 = state
+                _nd.imperative_invoke("mp_sgd_mom_update", weight, grad, mom,
+                                      w32, momentum=self.momentum,
+                                      **self._common_kwargs(index))
+                self._update_count(index)
+            else:
+                (_, w32) = state if isinstance(state, tuple) else (None, state)
+                _nd.imperative_invoke("mp_sgd_update", weight, grad, w32,
+                                      **self._common_kwargs(index))
+                self._update_count(index)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, weight.context, weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            _nd.imperative_invoke("nag_mom_update", weight, grad, state,
+                                  momentum=self.momentum, **kw)
+        else:
+            _nd.imperative_invoke("sgd_update", weight, grad, **kw)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr as in the reference
+        kw["lr"] *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        _nd.imperative_invoke("adam_update", weight, grad, mean, var,
+                              beta1=self.beta1, beta2=self.beta2,
+                              epsilon=self.epsilon, **kw)
+
+
+@register
+class AdamW(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mean, var = state
+        kw = self._common_kwargs(index)
+        _nd.imperative_invoke("adamw_update", weight, grad, mean, var,
+                              beta1=self.beta1, beta2=self.beta2,
+                              epsilon=self.epsilon, eta=self.eta, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, weight.context, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        state._set_data((state + g * g)._data)
+        delta = g / ((state ** 0.5) + self.float_stable_eps) + wd * weight
+        weight._set_data((weight - lr * delta)._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1 - self.rho) * g * g)._data)
+        cur_delta = ((acc_delta + self.epsilon) ** 0.5 /
+                     (acc_g + self.epsilon) ** 0.5) * g
+        acc_delta._set_data((self.rho * acc_delta + (1 - self.rho) * cur_delta * cur_delta)._data)
+        weight._set_data(((1 - wd) * weight - cur_delta)._data)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered, self.epsilon = centered, epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd_zeros(weight.shape, weight.context, weight.dtype)
+        if self.centered:
+            return (z(), z(), z())
+        return z()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.centered:
+            n, g_avg, delta = state
+            _nd.imperative_invoke("rmspropalex_update", weight, grad, n, g_avg,
+                                  delta, gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon, **kw)
+        else:
+            _nd.imperative_invoke("rmsprop_update", weight, grad, state,
+                                  gamma1=self.gamma1, epsilon=self.epsilon, **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        kw = self._common_kwargs(index)
+        _nd.imperative_invoke("ftrl_update", weight, grad, z, n,
+                              lamda1=self.lamda1, beta=self.beta, **kw)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m._set_data((self.beta1 * m + (1 - self.beta1) * g)._data)
+        u._set_data(_nd.imperative_invoke("broadcast_maximum",
+                                          u * self.beta2, _nd.imperative_invoke("abs", g)[0])[0]._data)
+        weight._set_data((weight - lr * m / (u + 1e-8))._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon, self.schedule_decay = epsilon, schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._set_data((self.beta1 * m + (1 - self.beta1) * g)._data)
+        v._set_data((self.beta2 * v + (1 - self.beta2) * g * g)._data)
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m / (1 - m_schedule_next)
+        v_prime = v / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._set_data((weight - lr * m_bar / ((v_prime ** 0.5) + self.epsilon))._data)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, weight.context, weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            _nd.imperative_invoke("signum_update", weight, grad, state,
+                                  momentum=self.momentum, wd_lh=self.wd_lh, **kw)
+        else:
+            _nd.imperative_invoke("signsgd_update", weight, grad, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = _random.normal(0, math.sqrt(lr), weight.shape,
+                               dtype=str(weight.dtype))
+        weight._set_data((weight - lr / 2 * g + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype)
+                if self.momentum != 0.0 else None,
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev_w = state
+        d = g + wd * weight + self.lamda * g * g * (weight - prev_w)
+        if mom is not None:
+            mom._set_data((self.momentum * mom - lr * d)._data)
+            upd = mom
+        else:
+            upd = -lr * d
+        prev_w._set_data(weight._data)
+        weight._set_data((weight + upd)._data)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: nd_zeros(weight.shape, weight.context, weight.dtype)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        d, v, z = state
+        v._set_data((self.beta2 * v + (1 - self.beta2) * g * g)._data)
+        d_t = (1 - self.beta1 ** t) / lr * ((v / (1 - self.beta2 ** t)) ** 0.5 + self.epsilon)
+        sigma_t = d_t - self.beta1 * d
+        z._set_data((self.beta1 * z + (1 - self.beta1) * g - sigma_t * weight)._data)
+        d._set_data(d_t._data)
+        weight._set_data((-z / d_t)._data)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, weight.context, weight.dtype),
+                nd_zeros(weight.shape, weight.context, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mean, var = state
+        kw = {"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+              "t": t, "bias_correction": self.bias_correction, "wd": wd,
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        # phase1 returns the adam-direction; means/vars updated inline
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        mean._set_data((self.beta1 * mean + (1 - self.beta1) * g)._data)
+        var._set_data((self.beta2 * var + (1 - self.beta2) * g * g)._data)
+        m = mean / (1 - self.beta1 ** t) if self.bias_correction else mean
+        v = var / (1 - self.beta2 ** t) if self.bias_correction else var
+        update = m / ((v ** 0.5) + self.epsilon) + wd * weight
+        r1 = weight.norm()
+        r2 = update.norm()
+        _nd.imperative_invoke("lamb_update_phase2", weight, update, r1, r2,
+                              lr=lr,
+                              lower_bound=self.lower_bound if self.lower_bound is not None else -1.0,
+                              upper_bound=self.upper_bound if self.upper_bound is not None else -1.0)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (optimizer.py:798)."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd_zeros(weight.shape, weight.context, weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w_norm = float(weight.norm().asscalar())
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = _nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        g_norm = float(g.norm().asscalar())
+        if w_norm > 0 and g_norm > 0:
+            lr *= self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
+        if state is not None:
+            state._set_data((self.momentum * state - lr * (g + wd * weight))._data)
+            weight._set_data((weight + state)._data)
+        else:
+            weight._set_data((weight - lr * (g + wd * weight))._data)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with warmup (optimizer.py:1058) — LARS-style scaling."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+
+
+@register
+class Test(Optimizer):
+    """The reference's debugging optimizer (optimizer.py:2032)."""
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, weight.context, weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad)._data)
+        state._set_data(weight._data)
+
+
+class Updater:
+    """Applies an optimizer per key (bottom of optimizer.py). Serializable
+    for Module.save_optimizer_states parity."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        def _to_nd(x):
+            if isinstance(x, _np.ndarray):
+                from ..ndarray.ndarray import array
+
+                return array(x)
+            if isinstance(x, tuple):
+                return tuple(_to_nd(y) for y in x)
+            return x
+
+        data = pickle.loads(states)
+        self.states = {k: _to_nd(v) for k, v in data.items()}
+        self.states_synced = {k: True for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        def _to_np(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, tuple):
+                return tuple(_to_np(y) for y in x)
+            return x
+
+        return pickle.dumps({k: _to_np(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

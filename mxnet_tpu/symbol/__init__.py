@@ -1,0 +1,38 @@
+"""mx.sym — symbolic namespace with generated op creators.
+
+Parity: python/mxnet/symbol/ (creators generated from the op registry at
+import, like register.py does from the C API).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     make_symbol_creator)
+from ..ops.registry import list_ops as _list_ops, _ALIASES as _OP_ALIASES
+
+_MODULE = _sys.modules[__name__]
+
+
+def _populate():
+    for name in _list_ops():
+        if not hasattr(_MODULE, name):
+            setattr(_MODULE, name, make_symbol_creator(name))
+    for alias, canon in _OP_ALIASES.items():
+        if alias.isidentifier() and not hasattr(_MODULE, alias):
+            setattr(_MODULE, alias, make_symbol_creator(canon))
+
+
+_populate()
+
+
+def __getattr__(name):
+    from ..ops.registry import get_op
+
+    try:
+        get_op(name)
+    except Exception:
+        raise AttributeError(name)
+    c = make_symbol_creator(name)
+    setattr(_MODULE, name, c)
+    return c

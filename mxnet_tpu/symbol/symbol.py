@@ -1,0 +1,631 @@
+"""Symbol — declarative graph composition compiling to one XLA executable.
+
+Parity: python/mxnet/symbol/symbol.py + the nnvm graph substrate
+(src/nnvm/, src/executor/). TPU-native redesign: the Symbol DAG is a thin
+Python structure over the same op registry the imperative path uses; binding
+traces the whole graph once into a jitted function — the "XLA-HLO emission
+pass" the north star asks for. nnvm passes map as follows: shape/type
+inference = fixpoint propagation + jax.eval_shape; MXGradient = jax.vjp at
+bind time; PlanMemory / DetectInplaceAddTo / pointwise fusion = XLA buffer
+assignment + fusion (nothing to build).
+"""
+from __future__ import annotations
+
+import inspect as _inspect
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "name_manager"]
+
+_NAME_LOCK = threading.Lock()
+_NAME_COUNTERS: dict[str, int] = {}
+
+
+def _auto_name(kind):
+    with _NAME_LOCK:
+        i = _NAME_COUNTERS.get(kind, 0)
+        _NAME_COUNTERS[kind] = i + 1
+    return f"{kind}{i}"
+
+
+class _Node:
+    """One graph node: a variable or an op application."""
+
+    __slots__ = ("op", "name", "params", "inputs", "attrs", "aux_mark")
+
+    def __init__(self, op, name, params=None, inputs=None, attrs=None):
+        self.op = op              # None for variables, else canonical op name
+        self.name = name
+        self.params = params or {}
+        self.inputs = inputs or []  # list[(Node, out_idx)]
+        self.attrs = attrs or {}
+        self.aux_mark = False     # variable used in a mutate slot => aux state
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.is_var:
+            return 1
+        op = _registry.get_op(self.op)
+        return op.n_out(op.normalize(self.params))
+
+
+class Symbol:
+    """A handle to one or more output entries of the graph."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list[(Node, idx)]
+
+    # ------------------------------------------------------------- structure
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return ", ".join(n.name for n, _ in self._outputs)
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield Symbol([self._outputs[i]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError(f"no output named {index!r}: {names}")
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def _topo_nodes(self):
+        order, seen = [], set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (inp, _) in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo_nodes() if n.is_var and not n.aux_mark]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo_nodes() if n.is_var and n.aux_mark]
+
+    def list_outputs(self):
+        out = []
+        for n, i in self._outputs:
+            if n.num_outputs() > 1:
+                out.append(f"{n.name}_output{i}")
+            else:
+                out.append(f"{n.name}_output" if not n.is_var else n.name)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var]
+
+    def get_internals(self):
+        entries = []
+        for n in self._topo_nodes():
+            for i in range(n.num_outputs()):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = {id(n): n for n, _ in self._outputs}
+        ins = []
+        for n, _ in self._outputs:
+            ins.extend(n.inputs)
+        return Symbol(ins) if ins else None
+
+    def attr(self, key):
+        return self._outputs[0][0].attrs.get(key)
+
+    def attr_dict(self):
+        return {n.name: dict(n.attrs) for n in self._topo_nodes() if n.attrs}
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attrs.update(kwargs)
+
+    # ---------------------------------------------------------- composition
+    def __call__(self, *args, **kwargs):
+        """Compose: replace variable inputs by other symbols."""
+        s = Symbol(self._outputs)
+        # compose by name
+        var_nodes = {n.name: n for n in s._topo_nodes() if n.is_var}
+        for name, sub in kwargs.items():
+            if name in var_nodes and isinstance(sub, Symbol):
+                node = var_nodes[name]
+                node.op = "identity"
+                node.inputs = [sub._outputs[0]]
+        return s
+
+    def _binary(self, other, opname, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _create(opname, [lhs, rhs], {})
+        return _create(opname + "_scalar", [self],
+                       {"scalar": float(other), "reverse": reverse})
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elemwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elemwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elemwise_pow")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal")
+
+    def __eq__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary(o, "broadcast_equal")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary(o, "broadcast_not_equal")
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    # convenience mirrors of common ops (full set via generated sym.* wrappers)
+    def reshape(self, shape=None, **kw):
+        return _create("Reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _create("transpose", [self], {"axes": tuple(axes) if axes else None})
+
+    def sum(self, axis=None, keepdims=False):
+        return _create("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _create("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def flatten(self):
+        return _create("Flatten", [self], {})
+
+    def slice_axis(self, axis=0, begin=0, end=None):
+        return _create("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def expand_dims(self, axis):
+        return _create("expand_dims", [self], {"axis": axis})
+
+    def astype(self, dtype):
+        return _create("Cast", [self], {"dtype": str(dtype)})
+
+    def softmax(self, axis=-1):
+        return _create("softmax", [self], {"axis": axis})
+
+    # ------------------------------------------------------------- inference
+    def infer_shape(self, **kwargs):
+        try:
+            return self._infer_shape_impl(partial=False, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, **kwargs):
+        return self._infer_shape_impl(partial=True, **kwargs)
+
+    def _infer_shape_impl(self, partial=False, **kwargs):
+        """Fixpoint shape propagation. Forward: jax.eval_shape when all inputs
+        known. Parameter shapes: per-op hooks (the TPU stand-in for
+        FInferShape backward inference, infer_graph_attr_pass.cc:553)."""
+        known: dict[tuple, tuple] = {}
+        nodes = self._topo_nodes()
+        for n in nodes:
+            if n.is_var and n.name in kwargs and kwargs[n.name] is not None:
+                known[(id(n), 0)] = tuple(kwargs[n.name])
+        changed = True
+        while changed:
+            changed = False
+            for n in nodes:
+                if n.is_var:
+                    continue
+                in_shapes = [known.get((id(i), s)) for i, s in n.inputs]
+                op = _registry.get_op(n.op)
+                params = op.normalize(n.params)
+                hook = _PARAM_SHAPE_HOOKS.get(op.name)
+                if hook and any(s is None for s in in_shapes):
+                    hints = hook(in_shapes, params)
+                    for idx, shape in (hints or {}).items():
+                        node_i, slot_i = n.inputs[idx]
+                        if shape is not None and known.get((id(node_i), slot_i)) is None:
+                            known[(id(node_i), slot_i)] = tuple(shape)
+                            changed = True
+                    in_shapes = [known.get((id(i), s)) for i, s in n.inputs]
+                if all(s is not None for s in in_shapes) and \
+                        known.get((id(n), 0)) is None:
+                    out_shapes = _eval_out_shapes(n, in_shapes)
+                    for i, s in enumerate(out_shapes):
+                        known[(id(n), i)] = s
+                    changed = True
+        arg_shapes = []
+        for name in self.list_arguments():
+            node = next(x for x in nodes if x.is_var and x.name == name)
+            s = known.get((id(node), 0))
+            if s is None and not partial:
+                raise MXNetError(f"infer_shape: cannot infer shape of argument "
+                                 f"'{name}' — provide it explicitly")
+            arg_shapes.append(s)
+        out_shapes = [known.get((id(n), i)) for n, i in self._outputs]
+        aux_shapes = []
+        for name in self.list_auxiliary_states():
+            node = next(x for x in nodes if x.is_var and x.name == name)
+            aux_shapes.append(known.get((id(node), 0)))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        arg_names = self.list_arguments()
+        dt = kwargs.get(arg_names[0], _np.float32) if arg_names else _np.float32
+        return ([_np.dtype(dt)] * len(arg_names),
+                [_np.dtype(dt)] * len(self._outputs),
+                [_np.dtype(dt)] * len(self.list_auxiliary_states()))
+
+    # --------------------------------------------------------------- binding
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req=grad_req, **kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def gradient(self, wrt):
+        raise MXNetError("symbol.gradient: use bind + backward")
+
+    # ---------------------------------------------------------- (de)serialize
+    def tojson(self):
+        nodes = self._topo_nodes()
+        idx_of = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_var else n.op,
+                "name": n.name,
+                "attrs": {k: json.dumps(v) for k, v in n.params.items()} if n.params else {},
+                "inputs": [[idx_of[id(i)], s, 0] for i, s in n.inputs],
+                "aux": n.aux_mark,
+            })
+        heads = [[idx_of[id(n)], i, 0] for n, i in self._outputs]
+        return json.dumps({"nodes": jnodes, "heads": heads,
+                           "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo_nodes():
+            kind = "Variable" if n.is_var else n.op
+            ins = ", ".join(i.name for i, _ in n.inputs)
+            lines.append(f"{kind} {n.name}({ins})")
+        return "\n".join(lines)
+
+
+def _eval_out_shapes(node, in_shapes):
+    import jax
+    import jax.numpy as jnp
+
+    op = _registry.get_op(node.op)
+    params = op.normalize(node.params)
+    fn = op.closed(params)
+    specs = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+    try:
+        out = jax.eval_shape(fn, *specs)
+    except Exception as e:
+        raise MXNetError(f"shape inference failed at node '{node.name}' "
+                         f"(op {node.op}, inputs {in_shapes}): {e}") from e
+    outs = out if isinstance(out, tuple) else (out,)
+    return [tuple(o.shape) for o in outs]
+
+
+# --- parameter-shape hooks (backward inference for learnable params) --------
+
+def _fc_hook(in_shapes, p):
+    data = in_shapes[0]
+    hints = {}
+    if data is not None:
+        import numpy as np
+
+        in_dim = int(np.prod(data[1:])) if p.get("flatten", True) else data[-1]
+        nh = p["num_hidden"]
+        hints[1] = (nh, in_dim)
+        if len(in_shapes) > 2:
+            hints[2] = (nh,)
+    return hints
+
+
+def _conv_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    k = p.get("kernel") or ()
+    k = (k,) if isinstance(k, int) else tuple(k)
+    nf = p["num_filter"]
+    ng = p.get("num_group", 1)
+    hints = {1: (nf, data[1] // ng) + k}
+    if len(in_shapes) > 2:
+        hints[2] = (nf,)
+    return hints
+
+
+def _deconv_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    k = tuple(p.get("kernel") or ())
+    nf = p["num_filter"]
+    ng = p.get("num_group", 1)
+    hints = {1: (data[1], nf // ng) + k}
+    if len(in_shapes) > 2:
+        hints[2] = (nf,)
+    return hints
+
+
+def _bn_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    c = data[p.get("axis", 1)]
+    return {i: (c,) for i in range(1, 5)}
+
+
+def _norm_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    ax = p.get("axis", -1)
+    c = data[ax]
+    return {1: (c,), 2: (c,)}
+
+
+def _groupnorm_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    return {1: (data[1],), 2: (data[1],)}
+
+
+def _embedding_hook(in_shapes, p):
+    return {1: (p["input_dim"], p["output_dim"])}
+
+
+def _rnn_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    from ..ops.rnn import _GATES
+
+    T, N, I = data
+    H = p["state_size"]
+    L = p.get("num_layers", 1)
+    D = 2 if p.get("bidirectional") else 1
+    g = _GATES[p.get("mode", "lstm")]
+    size = 0
+    for layer in range(L):
+        in_sz = I if layer == 0 else H * D
+        size += D * (g * H * in_sz + g * H * H)
+    size += L * D * 2 * g * H
+    hints = {1: (size,), 2: (L * D, N, H)}
+    if len(in_shapes) > 3:
+        hints[3] = (L * D, N, H)
+    return hints
+
+
+_PARAM_SHAPE_HOOKS = {
+    "FullyConnected": _fc_hook,
+    "Convolution": _conv_hook,
+    "Deconvolution": _deconv_hook,
+    "BatchNorm": _bn_hook,
+    "LayerNorm": _norm_hook,
+    "GroupNorm": _groupnorm_hook,
+    "InstanceNorm": _groupnorm_hook,
+    "Embedding": _embedding_hook,
+    "RNN": _rnn_hook,
+}
+
+
+# ------------------------------------------------------------- construction
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = str(init)
+    node = _Node(None, name, attrs=attrs)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _create(opname, input_syms, params, name=None, attr=None):
+    """Create an op node; auto-create missing parameter variables the way the
+    reference does (generated creators add <name>_weight etc.)."""
+    op = _registry.get_op(opname)
+    name = name or _auto_name(op.name.lower().replace("_", ""))
+    inputs = []
+    for s in input_syms:
+        if s is None:
+            continue
+        if len(s._outputs) != 1:
+            raise MXNetError(f"{opname}: cannot take a multi-output symbol "
+                             f"as a single input")
+        inputs.append(s._outputs[0])
+    node = _Node(op.name, name, params=dict(params), inputs=inputs,
+                 attrs=dict(attr or {}))
+    return Symbol([(node, i) for i in range(node.num_outputs())])
+
+
+def _array_param_names(op):
+    """Leading positional (array) parameter names of the op function."""
+    sig = _inspect.signature(op.fn)
+    names = []
+    for p in sig.parameters.values():
+        if p.kind in (p.VAR_POSITIONAL,):
+            return names, True
+        if p.default is p.empty or p.name in ("bias", "state_cell", "rng_key",
+                                              "sequence_length", "like"):
+            if p.kind == p.POSITIONAL_OR_KEYWORD:
+                names.append(p.name)
+        else:
+            break
+    return names, False
+
+
+def make_symbol_creator(opname):
+    op = _registry.get_op(opname)
+    arr_names, variadic = _array_param_names(op)
+
+    def creator(*args, name=None, attr=None, **kwargs):
+        syms = []
+        rest = []
+        for a in args:
+            if isinstance(a, Symbol):
+                syms.append(a)
+            else:
+                rest.append(a)
+        name = name or _auto_name(op.name.lower().replace("_", ""))
+        if variadic:
+            params = dict(kwargs)
+            params.pop("num_args", None)
+            return _create(opname, syms, params, name=name, attr=attr)
+        # map keyword-symbol args (e.g. data=..., weight=...)
+        slots: dict[str, Symbol | None] = {}
+        si = 0
+        for an in arr_names:
+            if an in kwargs and isinstance(kwargs[an], Symbol):
+                slots[an] = kwargs.pop(an)
+            elif si < len(syms):
+                slots[an] = syms[si]
+                si += 1
+            else:
+                slots[an] = None
+        params = dict(kwargs)
+        # positional non-symbol args map onto remaining op params (rare)
+        # auto-create missing parameter variables
+        mutate_idx = set(op.mutate)
+        final_inputs = []
+        for idx, an in enumerate(arr_names):
+            s = slots[an]
+            if s is None:
+                if an in ("bias",) and params.get("no_bias"):
+                    continue
+                if an == "rng_key":
+                    s = Variable(f"{name}_rng_key")
+                    s._outputs[0][0].aux_mark = True
+                elif an in ("state_cell",) and params.get("mode") != "lstm":
+                    continue
+                elif an in ("sequence_length", "like", "label"):
+                    continue
+                else:
+                    s = Variable(f"{name}_{an}")
+                    if idx in mutate_idx:
+                        s._outputs[0][0].aux_mark = True
+            final_inputs.append(s)
+        return _create(opname, final_inputs, params, name=name, attr=attr)
+
+    creator.__name__ = opname
+    creator.__doc__ = op.doc
+    return creator
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        params = {k: json.loads(v) for k, v in jn.get("attrs", {}).items()}
+        params = {k: (tuple(v) if isinstance(v, list) else v) for k, v in params.items()}
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"])
+            node.aux_mark = jn.get("aux", False)
+        else:
+            node = _Node(jn["op"], jn["name"], params=params)
+        node.inputs = [(nodes[i], s) for i, s, _ in jn["inputs"]]
+        nodes.append(node)
+    return Symbol([(nodes[i], s) for i, s, _ in data["heads"]])

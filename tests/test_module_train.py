@@ -1,0 +1,96 @@
+"""End-to-end Module training — the MNIST-MLP acceptance gate
+(parity model: tests/python/train/test_mlp.py +
+example/image-classification/train_mnist.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sym = mx.sym
+
+
+def _synthetic_mnist(n=1024, dim=64, num_classes=10, seed=0):
+    """Separable synthetic classification data (stand-in for MNIST files)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(num_classes, dim).astype(np.float32) * 3
+    labels = rng.randint(0, num_classes, n)
+    data = centers[labels] + rng.randn(n, dim).astype(np.float32)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = sym.Activation(net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=32)
+    net = sym.Activation(net, name="relu2", act_type="relu")
+    net = sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_mlp_fit_accuracy():
+    data, labels = _synthetic_mnist()
+    train_iter = mx.io.NDArrayIter(data[:768], labels[:768], batch_size=64,
+                                   shuffle=True)
+    val_iter = mx.io.NDArrayIter(data[768:], labels[768:], batch_size=64)
+    mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=5, eval_metric="acc",
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val_iter, "acc")
+    assert score[0][1] > 0.9, f"accuracy too low: {score}"
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    data, labels = _synthetic_mnist(n=256)
+    train_iter = mx.io.NDArrayIter(data, labels, batch_size=32, shuffle=True)
+    mod = mx.module.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01}, num_epoch=2)
+    eval_iter = mx.io.NDArrayIter(data, labels, batch_size=32)  # no shuffle
+    preds = mod.predict(eval_iter)
+    assert preds.shape == (256, 10)
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2)
+    mod2 = mx.module.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=eval_iter.provide_data,
+              label_shapes=eval_iter.provide_label, for_training=False)
+    mod2.set_params(*mod2.get_params())
+    p2 = mod2.predict(eval_iter)
+    np.testing.assert_allclose(preds.asnumpy(), p2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_module_multi_context_data_parallel():
+    """Data-parallel over 2 virtual devices (DataParallelExecutorGroup path)."""
+    data, labels = _synthetic_mnist(n=512)
+    train_iter = mx.io.NDArrayIter(data, labels, batch_size=64, shuffle=True)
+    mod = mx.module.Module(_mlp_symbol(), context=[mx.cpu(0), mx.cpu(0)])
+    mod.fit(train_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=3, kvstore="device",
+            initializer=mx.initializer.Xavier())
+    score = mod.score(train_iter, "acc")
+    assert score[0][1] > 0.85, f"accuracy too low: {score}"
+
+
+def test_linear_regression_module():
+    rng = np.random.RandomState(0)
+    x = rng.rand(200, 4).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = x @ w_true + 0.7
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, name="fc", num_hidden=1)
+    out = sym.LinearRegressionOutput(out, sym.Variable("lr_label"),
+                                     name="lro")
+    it = mx.io.NDArrayIter(x, y, batch_size=20, shuffle=True,
+                           label_name="lr_label")
+    mod = mx.module.Module(out, label_names=("lr_label",), context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            num_epoch=20, eval_metric="mse")
+    w = mod.get_params()[0]["fc_weight"].asnumpy().ravel()
+    b = mod.get_params()[0]["fc_bias"].asnumpy().ravel()
+    np.testing.assert_allclose(w, w_true, atol=0.2)
+    np.testing.assert_allclose(b, [0.7], atol=0.2)

@@ -1,0 +1,125 @@
+"""Symbol + Executor tests (parity model: test_symbol.py, test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sym = mx.sym
+
+
+def test_compose_and_listing():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    out = sym.SoftmaxOutput(fc2, sym.Variable("label"), name="softmax")
+    args = out.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias", "label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    fc2 = sym.FullyConnected(fc1, name="fc2", num_hidden=4)
+    arg_shapes, out_shapes, _ = fc2.infer_shape(data=(8, 32))
+    d = dict(zip(fc2.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 32)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert out_shapes[0] == (8, 4)
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv0")
+    p = sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = p.infer_shape(data=(2, 3, 16, 16))
+    d = dict(zip(p.list_arguments(), arg_shapes))
+    assert d["conv0_weight"] == (8, 3, 3, 3)
+    assert out_shapes[0] == (2, 8, 8, 8)
+
+
+def test_simple_bind_forward():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, name="fc", num_hidden=3)
+    ex = out.simple_bind(mx.cpu(), data=(2, 5))
+    ex.arg_dict["data"][:] = 1.0
+    ex.arg_dict["fc_weight"][:] = 0.5
+    ex.arg_dict["fc_bias"][:] = 0.25
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full((2, 3), 2.75),
+                               rtol=1e-5)
+
+
+def test_executor_backward():
+    x = sym.Variable("x")
+    y = x * x
+    ex = y.simple_bind(mx.cpu(), x=(3,))
+    ex.arg_dict["x"]._set_data(nd.array([1.0, 2.0, 3.0])._data)
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_softmax_output_grad():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.SoftmaxOutput(data, label, name="softmax")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3), label=(2,),
+                         grad_req={"data": "write", "label": "null"})
+    logits = np.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]], np.float32)
+    ex.arg_dict["data"]._set_data(nd.array(logits)._data)
+    ex.arg_dict["label"]._set_data(nd.array([2.0, 0.0])._data)
+    ex.forward(is_train=True)
+    ex.backward()
+    p = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    expect = p.copy()
+    expect[0, 2] -= 1
+    expect[1, 0] -= 1
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), expect,
+                               rtol=1e-5)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), p, rtol=1e-5)
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable("data")
+    out = sym.BatchNorm(data, name="bn", fix_gamma=False, momentum=0.5)
+    ex = out.simple_bind(mx.cpu(), data=(4, 3))
+    assert set(ex.aux_dict) == {"bn_moving_mean", "bn_moving_var"}
+    ex.arg_dict["data"]._set_data(nd.array(np.random.rand(4, 3).astype(np.float32) + 5)._data)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    mm_before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward()
+    mm_after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(mm_before, mm_after)  # stats updated in training
+    ex.forward(is_train=False)
+    mm_pred = ex.aux_dict["bn_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm_after, mm_pred)  # frozen in inference
+
+
+def test_symbol_save_load(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=4)
+    net = sym.Activation(net, act_type="tanh")
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+    ex = net2.simple_bind(mx.cpu(), data=(2, 3))
+    assert ex.forward()[0].shape == (2, 4)
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = a * 2
+    c = a + 1
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    ex = g.simple_bind(mx.cpu(), a=(2,))
+    ex.arg_dict["a"]._set_data(nd.array([1.0, 2.0])._data)
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2.0, 3.0])
