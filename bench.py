@@ -1,0 +1,63 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's published ResNet-50 training throughput,
+109 img/s at bs=32 on 1x K80 (BASELINE.md,
+reference example/image-classification/README.md:154).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import numpy as np
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = 32
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    if not on_tpu:
+        batch = 8  # keep the CPU smoke run quick
+
+    net = vision.resnet50_v1()
+    net.initialize(mx.initializer.Xavier())
+    x0 = mx.nd.zeros((batch, 3, 224, 224))
+    net(x0)  # materialize params
+
+    mesh = parallel.create_mesh({"dp": 1}, jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    y = (rng.rand(batch) * 1000).astype(np.float32)
+
+    # warmup (compilation + first steps)
+    for _ in range(3):
+        trainer.step(x, y).block_until_ready()
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    baseline = 109.0  # reference K80 img/s, bs=32
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Gluon — the imperative high-level API (parity: python/mxnet/gluon/).
+
+Blocks run eagerly for debuggability; ``hybridize()`` compiles the whole
+forward/backward into one XLA executable (see block.py for the TPU redesign
+of CachedOp).
+"""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import parameter
+from . import block
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

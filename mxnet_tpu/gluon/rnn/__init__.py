@@ -1,0 +1,5 @@
+"""Gluon recurrent layers (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn_layer import *  # noqa: F401,F403
+from . import rnn_cell
+from . import rnn_layer

@@ -1,0 +1,548 @@
+"""Gluon recurrent cells.
+
+Parity: python/mxnet/gluon/rnn/rnn_cell.py in the reference. Cells run one
+timestep; unroll() composes timesteps. Under hybridize the unrolled graph
+compiles into one XLA program (the fused multi-step path is rnn_layer.py's
+lax.scan RNN op).
+"""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+from ... import ndarray as nd
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _get_begin_state(cell, F, begin_state, inputs, batch_size):
+    if begin_state is None:
+        begin_state = cell.begin_state(batch_size=batch_size)
+    return begin_state
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    assert inputs is not None
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, nd.NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            in_axis = in_layout.find("T") if in_layout else axis
+            inputs = nd.split_v2(inputs, inputs.shape[in_axis], axis=in_axis,
+                                 squeeze_axis=True)
+            if not isinstance(inputs, (list, tuple)):
+                inputs = [inputs]
+    else:
+        batch_size = inputs[0].shape[batch_axis - (1 if axis == 0 else 0)] \
+            if axis == 0 else inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = nd.stack(*inputs, axis=axis)
+    return inputs, axis, batch_size
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
+                                   merge):
+    assert valid_length is not None
+    if not isinstance(data, nd.NDArray):
+        data = nd.stack(*data, axis=time_axis)
+    outputs = nd.SequenceMask(data, sequence_length=valid_length,
+                              use_sequence_length=True, axis=time_axis)
+    if not merge:
+        outputs = nd.split_v2(outputs, outputs.shape[time_axis],
+                              axis=time_axis, squeeze_axis=True)
+    return outputs
+
+
+class RecurrentCell(Block):
+    """Abstract base class for RNN cells (gluon/rnn/rnn_cell.py:78)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states for this cell (rnn_cell.py:118)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            if func is None:
+                state = nd.zeros(shape, **{k: v for k, v in info.items()
+                                           if k in ("ctx", "dtype")})
+            else:
+                state = func(name=f"{self._prefix}begin_state_"
+                                  f"{self._init_counter}", shape=shape, **info)
+            states.append(state)
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unrolls the cell for `length` timesteps (rnn_cell.py:160)."""
+        from ... import ndarray as F
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [nd.SequenceLast(nd.stack(*ele_list, axis=0),
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(
+                F, outputs, length, valid_length, axis, True)
+        if merge_outputs is None:
+            merge_outputs = False
+        if merge_outputs and not isinstance(outputs, nd.NDArray):
+            outputs = nd.stack(*outputs, axis=axis)
+        elif merge_outputs is False and isinstance(outputs, nd.NDArray):
+            outputs = nd.split_v2(outputs, outputs.shape[axis], axis=axis,
+                                  squeeze_axis=True)
+        return outputs, states
+
+    def _get_activation(self, F, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            if activation in ("tanh", "relu", "sigmoid", "softrelu",
+                              "softsign"):
+                return F.Activation(inputs, act_type=activation, **kwargs)
+            return getattr(F, activation)(inputs, **kwargs)
+        if isinstance(activation, Block):
+            return activation(inputs)
+        return activation(inputs, **kwargs)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states) if False else \
+            self.hybrid_call(inputs, states)
+
+    def hybrid_call(self, inputs, states):
+        raise NotImplementedError
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    """RecurrentCell whose step is hybridizable (rnn_cell.py:340)."""
+
+    def __init__(self, prefix=None, params=None):
+        RecurrentCell.__init__(self, prefix=prefix, params=params)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        params = {name: p.data() for name, p in self._reg_params.items()}
+        from ... import ndarray as F
+        return self.hybrid_forward(F, inputs, states, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell: h' = act(Wx + Rh + b) (rnn_cell.py:364)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = f"t{self._counter}_"
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size,
+                               name=prefix + "h2h")
+        i2h_plus_h2h = i2h + h2h
+        output = self._get_activation(F, i2h_plus_h2h, self._activation,
+                                      name=prefix + "out")
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell (rnn_cell.py:463). Gate order (i, f, g, o) = cuDNN/ref."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = f"t{self._counter}_"
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "h2h")
+        gates = i2h + h2h
+        slice_gates = F.SliceChannel(gates, num_outputs=4,
+                                     name=prefix + "slice")
+        in_gate = self._get_activation(F, slice_gates[0],
+                                       self._recurrent_activation)
+        forget_gate = self._get_activation(F, slice_gates[1],
+                                           self._recurrent_activation)
+        in_transform = self._get_activation(F, slice_gates[2],
+                                            self._activation)
+        out_gate = self._get_activation(F, slice_gates[3],
+                                        self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell (rnn_cell.py:599). Gate order (r, z, n) = cuDNN/ref."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = f"t{self._counter}_"
+        prev_state_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size,
+                               name=prefix + "h2h")
+        i2h_r, i2h_z, i2h = F.SliceChannel(i2h, num_outputs=3,
+                                           name=prefix + "i2h_slice")
+        h2h_r, h2h_z, h2h = F.SliceChannel(h2h, num_outputs=3,
+                                           name=prefix + "h2h_slice")
+        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = F.Activation(i2h + reset_gate * h2h, act_type="tanh")
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Sequentially stacking multiple cells (rnn_cell.py:705)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        assert all(not isinstance(cell, BidirectionalCell)
+                   for cell in self._children.values())
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        _, _, batch_size = _format_sequence(length, inputs, layout, None)
+        num_cells = len(self._children)
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._children.values()):
+            n = len(cell.state_info())
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Applies dropout on input (rnn_cell.py:790)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert isinstance(rate, (int, float))
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells that modify a wrapped cell (rnn_cell.py:850)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified. One cell cannot be modified twice" \
+            % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (rnn_cell.py:910)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout. " \
+            "Please add ZoneoutCell to the cells underneath instead."
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        super().__init__(base_cell)
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        p_outputs, p_states = self._zoneout_outputs, self._zoneout_states
+
+        def mask(p, like):
+            m = F.Dropout(F.ones_like(like), p=p)
+            return m
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = F.zeros_like(next_output)
+        output = F.where(mask(p_outputs, next_output), next_output,
+                         prev_output) if p_outputs != 0.0 else next_output
+        new_states = [F.where(mask(p_states, new_s), new_s, old_s)
+                      for new_s, old_s in zip(next_states, states)] \
+            if p_states != 0.0 else next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds residual connection to a cell (rnn_cell.py:975)."""
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def _alias(self):
+        return "residual"
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+        if isinstance(outputs, nd.NDArray):
+            inputs, _, _ = _format_sequence(length, inputs, layout, True)
+            outputs = outputs + inputs
+        else:
+            inputs, _, _ = _format_sequence(length, inputs, layout, False)
+            outputs = [o + i for o, i in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Bidirectional RNN over two cells (rnn_cell.py:1030)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self._output_prefix = output_prefix
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        reversed_inputs = list(reversed(inputs))
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info())],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=reversed_inputs,
+            begin_state=states[len(l_cell.state_info()):],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            reversed_r_outputs = list(reversed(r_outputs))
+        else:
+            stacked = nd.stack(*r_outputs, axis=0)
+            rev = nd.SequenceReverse(stacked, sequence_length=valid_length,
+                                     use_sequence_length=True, axis=0)
+            reversed_r_outputs = nd.split_v2(rev, length, axis=0,
+                                             squeeze_axis=True)
+            if isinstance(reversed_r_outputs, nd.NDArray):
+                reversed_r_outputs = [reversed_r_outputs]
+        outputs = [nd.Concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, reversed_r_outputs)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        states = l_states + r_states
+        return outputs, states

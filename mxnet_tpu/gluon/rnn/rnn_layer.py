@@ -1,0 +1,267 @@
+"""Fused multi-step RNN layers: RNN / LSTM / GRU.
+
+Parity: python/mxnet/gluon/rnn/rnn_layer.py:307,404,535 — the reference
+dispatches to the monolithic sym.RNN op (cuDNN); here the same RNN op is a
+lax.scan program (ops/rnn.py) whose gate matmuls ride the MXU. Parameter
+layout (flat vector packing) matches the reference so checkpoints
+round-trip.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ... import ndarray as nd
+
+__all__ = ["RNN", "LSTM", "GRU", "_RNNLayer"]
+
+
+class _RNNLayer(HybridBlock):
+    """Base for fused RNN layers (rnn_layer.py:36)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        if projection_size:
+            raise NotImplementedError(
+                "projection_size (LSTMP) is not implemented yet on TPU")
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(f"{j}{i}_i2h_weight", (ng * nh, ni),
+                                     i2h_weight_initializer)
+                self._register_param(f"{j}{i}_h2h_weight", (ng * nh, nh),
+                                     h2h_weight_initializer)
+                self._register_param(f"{j}{i}_i2h_bias", (ng * nh,),
+                                     i2h_bias_initializer)
+                self._register_param(f"{j}{i}_h2h_bias", (ng * nh,),
+                                     h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = f"{shape[1] if shape[1] else None} -> {shape[0] // self._gates}"
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        pattern = re_pattern = None
+        def convert_key(m, bidirectional):  # for compatibility with old parameter format
+            d, l, g, t = [m[i] for i in range(4)]
+            if bidirectional:
+                return f"_unfused.{l}.{d}_cell.{g}_{t}"
+            return f"_unfused.{l}.{g}_{t}"
+        import re
+        bidirectional = any(k.startswith("r") for k in self._reg_params)
+        ret = {}
+        for k, val in self._reg_params.items():
+            m = re.match(r"(l|r)(\d+)_(i2h|h2h)_(weight|bias)", k)
+            ret[prefix + k] = val.data() if val._data is not None else None
+        return {k: v for k, v in ret.items() if v is not None}
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def cast(self, dtype):
+        super().cast(dtype)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            shape = info.pop("shape")
+            if func is None:
+                state = nd.zeros(shape, **{k: v for k, v in info.items()
+                                           if k in ("ctx", "dtype")})
+            else:
+                info.update(kwargs)
+                state = func(name=f"{self.prefix}h0_{i}", shape=shape, **info)
+            states.append(state)
+        return states
+
+    def _flat_params(self):
+        """Pack params into the reference's flat vector layout
+        (rnn_layer.py _forward_kernel: weights then biases)."""
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, f"{j}{i}_i2h_weight").data().reshape((-1,)))
+                ws.append(getattr(self, f"{j}{i}_h2h_weight").data().reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(getattr(self, f"{j}{i}_i2h_bias").data())
+                bs.append(getattr(self, f"{j}{i}_h2h_bias").data())
+        return nd.concat(*(ws + bs), dim=0)
+
+    def forward(self, inputs, states=None):
+        from ...symbol import Symbol
+        if isinstance(inputs, Symbol):
+            return super().forward(inputs, states)
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        for info, state in zip(self.state_info(batch_size), states):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    f"Invalid recurrent state shape. Expecting "
+                    f"{info['shape']}, got {state.shape}.")
+        out = self._forward_kernel(inputs, states)
+        return out[0] if skip_states else out
+
+    def _finish_deferred(self, inputs):
+        # complete deferred shapes from the input feature size
+        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        in_sz = ni
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = getattr(self, f"{j}{i}_i2h_weight")
+                if p.shape is None or 0 in p.shape:
+                    p.shape = (ng * nh, in_sz)
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                for nm in (f"{j}{i}_h2h_weight", f"{j}{i}_i2h_bias",
+                           f"{j}{i}_h2h_bias"):
+                    q = getattr(self, nm)
+                    if q._deferred_init:
+                        q._finish_deferred_init()
+            in_sz = nh * self._dir
+
+    def _forward_kernel(self, inputs, states):
+        self._finish_deferred(inputs)
+        if self._layout == "NTC":
+            inputs = nd.swapaxes(inputs, 0, 1)
+        params = self._flat_params()
+        if self._mode == "lstm":
+            rnn_args = [states[0], states[1]]
+        else:
+            rnn_args = [states[0]]
+        rnn_out = nd.RNN(inputs, params, *rnn_args,
+                         state_size=self._hidden_size,
+                         num_layers=self._num_layers,
+                         bidirectional=self._dir == 2, mode=self._mode,
+                         p=self._dropout, state_outputs=True)
+        outputs = rnn_out[0]
+        states_out = list(rnn_out[1:])
+        if self._layout == "NTC":
+            outputs = nd.swapaxes(outputs, 0, 1)
+        return outputs, states_out
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        # symbolic path for export/shape inference
+        if states is None:
+            states = [F.zeros(())]
+        sym_params = self._flat_params_sym(F)
+        args = [states[0]] if self._mode != "lstm" else list(states[:2])
+        out = F.RNN(inputs, sym_params, *args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=False)
+        return out
+
+    def _flat_params_sym(self, F):
+        from ... import symbol as sym
+        parts = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                parts.append(getattr(self, f"{j}{i}_i2h_weight").var().reshape((-1,)))
+                parts.append(getattr(self, f"{j}{i}_h2h_weight").var().reshape((-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                parts.append(getattr(self, f"{j}{i}_i2h_bias").var())
+                parts.append(getattr(self, f"{j}{i}_h2h_bias").var())
+        return sym.Concat(*parts, dim=0)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (rnn_layer.py:307)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (rnn_layer.py:404)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm",
+                         projection_size=projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (rnn_layer.py:535)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

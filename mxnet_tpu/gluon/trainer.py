@@ -1,0 +1,167 @@
+"""Gluon Trainer — applies an Optimizer to a set of Parameters.
+
+Parity: python/mxnet/gluon/trainer.py:28 in the reference (step :320,
+_allreduce_grads :371, _update :430). TPU redesign: on 'tpu'/'dist' kvstores
+the gradient allreduce is a psum that XLA lowers onto ICI when the step runs
+inside a pjit-ed mesh program (see mxnet_tpu/parallel); the single-process
+update path runs the fused optimizer ops so the whole step can live in one
+jitted executable.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import Parameter
+from ..ndarray import NDArray
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Applies an Optimizer on a set of Parameters."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._states_to_init = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore:
+            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+            if update_on_kvstore is None:
+                update_on_kvstore = False
+            self._kvstore = kv
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(i, param.data())
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Makes one step of parameter update: allreduce grads then apply
+        the optimizer (trainer.py:320)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                grad = param.grad()
+                self._kvstore.push(i, grad)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updates = [[] for _ in self._updaters]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._update_on_kvstore:
+                self._kvstore.pull(i, param.data())
+                continue
+            for upd, arr, grad in zip(updates, param.list_data(),
+                                      param.list_grad()):
+                upd.append((i, grad, arr))
+        if not self._update_on_kvstore:
+            for updater, upd in zip(self._updaters, updates):
+                for i, g, w in upd:
+                    updater(i, g, w)
+
+    def save_states(self, fname):
+        """Saves trainer states (optimizer + scheduler) to a file
+        (trainer.py:463)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(
+                dump_optimizer=self._update_on_kvstore))
+
+    def load_states(self, fname):
+        """Loads trainer states from a file (trainer.py:492)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)}

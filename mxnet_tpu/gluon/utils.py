@@ -1,0 +1,96 @@
+"""Gluon utilities.
+
+Parity: python/mxnet/gluon/utils.py (split_data, split_and_load,
+clip_global_norm, check_sha1, download).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Splits an NDArray into num_slice slices along batch_axis
+    (gluon/utils.py:35)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's multiple of {num_slice} or set even_split=False.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Splits an NDArray into len(ctx_list) slices and loads each onto one
+    context (gluon/utils.py:81)."""
+    if not isinstance(data, nd.NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescales arrays so that the sum of their 2-norm is <= max_norm
+    (gluon/utils.py:115)."""
+    assert len(arrays) > 0
+    total_norm = nd.add_n(*[nd.sum(x * x).reshape((1,)) for x in arrays])
+    total_norm = float(nd.sqrt(total_norm).asnumpy()[0])
+    if check_isfinite:
+        import math
+        if not math.isfinite(total_norm):
+            import warnings
+            warnings.warn(
+                UserWarning("nan or inf is detected. Clipping results will be "
+                            "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Checks whether the sha1 hash of the file content matches
+    (gluon/utils.py:173)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file from a URL (gluon/utils.py:193). This environment has
+    no egress; the function only serves cached files already on disk."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        f"download({url}): network egress is unavailable in this environment "
+        f"and no cached copy exists at {fname}")

@@ -1,0 +1,3 @@
+"""mx.image (parity: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from . import image

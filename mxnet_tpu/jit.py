@@ -101,6 +101,22 @@ def _notify_io(inputs, outputs):
             s.note_created(o)
 
 
+class no_trace:
+    """Suspend trace-session capture. One-time side effects that happen to
+    fire during a discovery pass (deferred parameter init, lazy state
+    creation) must survive the discovery rollback and not become traced
+    state, so they run with the session stack parked."""
+
+    def __enter__(self):
+        self._saved = list(_sessions())
+        _TLS.stack.clear()
+        return self
+
+    def __exit__(self, *a):
+        _TLS.stack.extend(self._saved)
+        return False
+
+
 class TracedFunction:
     """Shape-keyed jit cache over an imperative function of NDArrays."""
 
@@ -124,20 +140,46 @@ class TracedFunction:
 
     def __call__(self, *args):
         from .ndarray.ndarray import NDArray
+        from . import autograd
 
         key = self._key(args)
         entry = self._cache.get(key)
         dyn = [a for i, a in enumerate(args) if i not in self.static_argnums]
         if entry is None:
             entry = self._build(args, key)
-        jitted, state_cells, n_out, single = entry
+        jitted, pure, state_cells, n_out, single = entry
         state_vals = [c._data for c in state_cells]
         outs, new_state = jitted([a._data for a in dyn], state_vals)
-        for c, v in zip(state_cells, new_state):
-            c._data = v  # direct rebind: no re-notify, views not supported here
         ctx = args[0].context if args else None
         out_nds = [NDArray(o, ctx) for o in outs]
+        if autograd.is_recording():
+            # the whole traced program is ONE tape node, exactly like the
+            # reference's CachedOp recording itself (cached_op.cc:1026);
+            # recorded before state write-back so the node captures entry
+            # values of params/stats.
+            self._record_tape_node(pure, n_out, dyn, state_cells, out_nds)
+        for c, v in zip(state_cells, new_state):
+            c._data = v  # direct rebind: no re-notify, views not supported here
         return out_nds[0] if single else out_nds
+
+    def _record_tape_node(self, pure, n_out, dyn, state_cells, out_nds):
+        from . import autograd
+        from .ops.registry import OpDef
+
+        n_args = len(dyn)
+        # freeze the train-mode flag at record time: the vjp replay re-runs
+        # the user's Python later (possibly outside the record scope), and
+        # Dropout/BatchNorm read autograd.is_training() live — without the
+        # freeze the backward would differentiate the eval-mode graph
+        train_flag = autograd.is_training()
+
+        def tape_fn(*datas):
+            with autograd._Scope(recording=False, training=train_flag):
+                outs, _ = pure(list(datas[:n_args]), list(datas[n_args:]))
+            return tuple(outs)
+
+        op = OpDef(f"_traced_{self.name}", tape_fn, num_outputs=n_out)
+        autograd.record_op(op, {}, list(dyn) + list(state_cells), out_nds)
 
     def _build(self, args, key):
         import jax
@@ -157,12 +199,8 @@ class TracedFunction:
         res_list = [result] if single else list(result)
         n_out = len(res_list)
         state_cells = list(sess.captured)
-        mutated = sess.mutated
-        mutated_idx = [state_cells.index(m) for m in mutated]
         fn = self.fn
         statics = {i: a for i, a in enumerate(args) if i in self.static_argnums}
-        dyn_positions = [i for i in range(len(args)) if i not in self.static_argnums]
-        arg_ctxs = [a.context for a in args if not isinstance(a, (int, float, str, bool))]
 
         # ---- pass 2: pure wrapper for jit
         def pure(arg_datas, state_datas):
@@ -192,9 +230,13 @@ class TracedFunction:
                     c._data = d
             return out_data, new_state
 
-        donate = (1,) if self.donate_state else ()
+        from . import autograd
+
+        # when recording, entry state buffers feed the tape's vjp replay —
+        # they must not be donated to the forward executable
+        donate = (1,) if self.donate_state and not autograd.is_recording() else ()
         jitted = jax.jit(pure, donate_argnums=donate)
-        entry = (jitted, state_cells, n_out, single)
+        entry = (jitted, pure, state_cells, n_out, single)
         self._cache[key] = entry
         return entry
 

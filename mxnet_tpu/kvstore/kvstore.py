@@ -84,7 +84,9 @@ class KVStore:
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._data[k])
             else:
-                self._data[k]._set_data((self._data[k] + merged)._data)
+                # no updater: the store holds the latest reduced value
+                # (kvstore_local.h:208 PushImpl — reduce then assign)
+                self._data[k]._set_data(merged._data)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _pairs(key, out)

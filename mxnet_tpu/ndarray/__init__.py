@@ -30,7 +30,7 @@ def _make_wrapper(opname):
     except ValueError:
         key_pos = None
 
-    def wrapper(*args, out=None, **kwargs):
+    def wrapper(*args, out=None, name=None, attr=None, **kwargs):
         from .. import autograd
 
         args = list(args)

@@ -363,15 +363,14 @@ class NDArray:
         return self
 
     # ------------------------------------------------------------- reshaping
+    # all shape ops dispatch through imperative_invoke so the autograd tape
+    # records them (a raw NDArray(...) constructor would sever the chain —
+    # the reference records every op via Imperative::RecordOp equally)
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        # MXNet special codes: 0 copy dim, -1 infer (subset supported)
-        newshape = []
-        for i, s in enumerate(shape):
-            newshape.append(self.shape[i] if s == 0 else s)
-        return NDArray(self._data.reshape(tuple(newshape)), self._ctx)
+        return imperative_invoke("Reshape", self, shape=tuple(shape))[0]
 
     def reshape_like(self, other):
         return self.reshape(other.shape)
@@ -380,47 +379,49 @@ class NDArray:
         return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self
 
     def expand_dims(self, axis):
-        return NDArray(_jnp().expand_dims(self._data, axis), self._ctx)
+        return imperative_invoke("expand_dims", self, axis=axis)[0]
 
     def squeeze(self, axis=None):
-        return NDArray(_jnp().squeeze(self._data, axis), self._ctx)
+        return imperative_invoke("squeeze", self, axis=axis)[0]
 
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
             axes = tuple(axes[0])
-        return NDArray(_jnp().transpose(self._data, axes or None), self._ctx)
+        return imperative_invoke("transpose", self,
+                                 axes=tuple(axes) if axes else None)[0]
 
     @property
     def T(self):
         return self.transpose()
 
     def swapaxes(self, a1, a2):
-        return NDArray(_jnp().swapaxes(self._data, a1, a2), self._ctx)
+        return imperative_invoke("SwapAxis", self, dim1=a1, dim2=a2)[0]
 
     def split(self, num_outputs, axis=0):
         return split(self, num_outputs, axis)
 
     def broadcast_to(self, shape):
-        return NDArray(_jnp().broadcast_to(self._data, shape), self._ctx)
+        return imperative_invoke("broadcast_to", self, shape=tuple(shape))[0]
 
     def broadcast_like(self, other):
         return self.broadcast_to(other.shape)
 
     def tile(self, reps):
-        return NDArray(_jnp().tile(self._data, reps), self._ctx)
+        return imperative_invoke("tile", self, reps=tuple(reps) if
+                                 isinstance(reps, (list, tuple)) else reps)[0]
 
     def repeat(self, repeats, axis=None):
-        return NDArray(_jnp().repeat(self._data, repeats, axis), self._ctx)
+        return imperative_invoke("repeat", self, repeats=repeats, axis=axis)[0]
 
     def pad(self, pad_width, mode="constant", constant_value=0):
-        return NDArray(_jnp().pad(self._data, pad_width, mode=mode,
-                                  constant_values=constant_value), self._ctx)
+        return imperative_invoke("pad", self, pad_width=pad_width, mode=mode,
+                                 constant_value=constant_value)[0]
 
     def flip(self, axis):
-        return NDArray(_jnp().flip(self._data, axis), self._ctx)
+        return imperative_invoke("flip", self, axis=axis)[0]
 
     def diag(self, k=0):
-        return NDArray(_jnp().diag(self._data, k), self._ctx)
+        return imperative_invoke("diag", self, k=k)[0]
 
     # ------------------------------------------------------------ reductions
     def _reduce(self, opname, axis=None, keepdims=False, **kw):
@@ -633,8 +634,8 @@ def concat(*arrays, dim=1, axis=None):
     if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
         arrays = tuple(arrays[0])
     axis = dim if axis is None else axis
-    return NDArray(_jnp().concatenate([a._data for a in arrays], axis=axis),
-                   arrays[0]._ctx)
+    return imperative_invoke("Concat", *arrays, dim=axis,
+                             num_args=len(arrays))[0]
 
 
 def concatenate(arrays, axis=0):
@@ -644,8 +645,8 @@ def concatenate(arrays, axis=0):
 def stack(*arrays, axis=0):
     if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
         arrays = tuple(arrays[0])
-    return NDArray(_jnp().stack([a._data for a in arrays], axis=axis),
-                   arrays[0]._ctx)
+    return imperative_invoke("stack", *arrays, axis=axis,
+                             num_args=len(arrays))[0]
 
 
 def split(ary, num_outputs, axis=0, squeeze_axis=False):

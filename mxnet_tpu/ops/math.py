@@ -528,8 +528,20 @@ def _split(a, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts) if len(parts) > 1 else parts[0]
 
 
-@register("split_v2", num_outputs=lambda p: p.get("_num_outputs", 1))
+def _split_v2_nout(p):
+    if p.get("_num_outputs"):
+        return p["_num_outputs"]
+    ind = p.get("indices", ())
+    if isinstance(ind, int):
+        return p.get("sections") or ind
+    return p.get("sections") or (len(tuple(ind)) + 1)
+
+
+@register("split_v2", num_outputs=_split_v2_nout)
 def _split_v2(a, indices=(), axis=0, squeeze_axis=False, sections=0, _num_outputs=None):
+    # numpy semantics: int -> equal sections, tuple -> split points
+    if isinstance(indices, int) and not sections:
+        sections, indices = indices, ()
     if sections:
         parts = jnp.split(a, sections, axis=axis)
     else:

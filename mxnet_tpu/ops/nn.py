@@ -144,13 +144,18 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
             pads.append((pad[i], max(needed, pad[i])))
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    # init values must be PYTHON scalars: jax only recognizes the
+    # max/add monoid (-> differentiable reduce_window_max/sum primitives)
+    # for scalar inits; array inits fall back to the general reduce_window,
+    # which has no transpose rule under jit
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype), jax.lax.max,
+        init = -_np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return jax.lax.reduce_window(data, init, jax.lax.max,
                                      window, strides, pads)
     if pool_type in ("avg", "sum"):
-        s = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype), jax.lax.add,
-                                  window, strides, pads)
+        s = jax.lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                                  jax.lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
@@ -158,14 +163,15 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
             for k in kernel:
                 denom *= k
             return s / denom
+        zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
         ones = jnp.ones_like(data)
-        cnt = jax.lax.reduce_window(ones, jnp.asarray(0, data.dtype), jax.lax.add,
+        cnt = jax.lax.reduce_window(ones, zero, jax.lax.add,
                                     window, strides, pads)
         return s / cnt
     # lp pooling
+    zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
     s = jax.lax.reduce_window(jnp.power(jnp.abs(data), p_value),
-                              jnp.asarray(0, data.dtype), jax.lax.add,
-                              window, strides, pads)
+                              zero, jax.lax.add, window, strides, pads)
     return jnp.power(s, 1.0 / p_value)
 
 
@@ -246,9 +252,11 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5, output_mean_var=False
     red = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.var(x, axis=red, keepdims=True)
-    out = ((x - mean) * jax.lax.rsqrt(var + eps)).reshape(data.shape)
-    bshape = (1, c) + (1,) * (data.ndim - 2)
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    # gamma/beta are per-group, shape (num_groups,) — src/operator/nn/group_norm-inl.h
+    bshape = (1, num_groups) + (1,) * (x.ndim - 2)
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out.reshape(data.shape)
 
 
 @register("InstanceNorm")

@@ -1,0 +1,23 @@
+"""Distributed/parallel execution over TPU meshes.
+
+SURVEY.md §2.3: the reference's parallelism stack (Comm/NCCL/ps-lite +
+DataParallelExecutorGroup) is replaced by named device meshes + GSPMD
+shardings; tp/pp/sp axes — absent in the reference — are exposed here as
+first-class (free on XLA).
+"""
+from .mesh import create_mesh, default_mesh, local_devices, AXES
+from .functional import functional_call, param_arrays, aux_arrays
+from .trainer import ShardedTrainer, make_update_fn
+from . import mesh
+from . import functional
+from . import trainer
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("ring", "ring_attention"):
+        mod = importlib.import_module(".ring_attention", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

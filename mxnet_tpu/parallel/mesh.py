@@ -1,0 +1,57 @@
+"""Device mesh helpers.
+
+The TPU-native replacement for the reference's device topology machinery
+(src/kvstore/gpu_topology.h link discovery, CommDeviceTree): on TPU the
+topology is a named mesh and XLA chooses collective algorithms over ICI/DCN.
+Axis convention (scaling-book style): 'dp' data, 'tp' tensor/model, 'pp'
+pipeline, 'sp' sequence/context, 'ep' expert.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["create_mesh", "default_mesh", "local_devices", "AXES"]
+
+AXES = ("dp", "tp", "pp", "sp", "ep")
+
+
+def local_devices(platform=None):
+    import jax
+
+    return jax.devices(platform) if platform else jax.devices()
+
+
+def create_mesh(axes=None, devices=None):
+    """Create a jax.sharding.Mesh.
+
+    axes: dict axis-name -> size (a -1 size absorbs remaining devices),
+          or None for a pure data-parallel mesh over all devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = math.prod(sizes)
+    assert total == len(devices), \
+        f"mesh {dict(zip(names, sizes))} needs {total} devices, " \
+        f"got {len(devices)}"
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh(n_devices=None):
+    import jax
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return create_mesh({"dp": len(devs)}, devs)

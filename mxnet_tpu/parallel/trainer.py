@@ -1,0 +1,205 @@
+"""Sharded training step over a device mesh.
+
+The TPU-native replacement for DataParallelExecutorGroup + kvstore push/pull
+(SURVEY.md §2.3): one jitted step function holds forward, backward, gradient
+allreduce, and optimizer update. Parameters/batches carry NamedShardings on
+the mesh; the gradient reduction over the 'dp' axis is inserted by XLA
+(GSPMD) because the loss is a mean over the globally-sharded batch — the
+explicit-NCCL push/pull of the reference collapses into compiler-placed ICI
+collectives. Tensor-parallel shardings are expressed as parameter
+PartitionSpec rules.
+"""
+from __future__ import annotations
+
+import re
+
+from .functional import functional_call, param_arrays, aux_arrays
+from .mesh import create_mesh
+
+__all__ = ["ShardedTrainer", "sgd_init", "make_update_fn"]
+
+
+def _tree_map(f, *trees):
+    return {k: f(*(t[k] for t in trees)) for k in trees[0]}
+
+
+def sgd_init(params):
+    return {k: None for k in params}
+
+
+def make_update_fn(optimizer="sgd", optimizer_params=None):
+    """Functional optimizer update built from the registered fused update
+    ops (ops/optimizer_ops.py — same kernels the imperative path uses)."""
+    import jax.numpy as jnp
+
+    from ..ops.registry import get_op
+
+    kw = dict(optimizer_params or {})
+    lr = kw.pop("learning_rate", 0.01)
+    wd = kw.pop("wd", 0.0)
+    momentum = kw.pop("momentum", 0.0)
+    rescale = kw.pop("rescale_grad", 1.0)
+    clip = kw.pop("clip_gradient", None)
+
+    if optimizer == "sgd" and momentum == 0.0:
+        fn = get_op("sgd_update").fn
+
+        def init(params):
+            return {k: () for k in params}
+
+        def update(w, g, s):
+            new_w = fn(w, g, lr=lr, wd=wd, rescale_grad=rescale,
+                       clip_gradient=clip)[0]
+            return new_w, ()
+    elif optimizer == "sgd":
+        fn = get_op("sgd_mom_update").fn
+
+        def init(params):
+            return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+        def update(w, g, s):
+            new_w, _, new_mom = fn(w, g, s, lr=lr, momentum=momentum, wd=wd,
+                                   rescale_grad=rescale, clip_gradient=clip)
+            return new_w, new_mom
+    elif optimizer == "adam":
+        fn = get_op("adam_update").fn
+        beta1 = kw.pop("beta1", 0.9)
+        beta2 = kw.pop("beta2", 0.999)
+        epsilon = kw.pop("epsilon", 1e-8)
+
+        def init(params):
+            return {k: (jnp.zeros_like(v), jnp.zeros_like(v))
+                    for k, v in params.items()}
+
+        def update(w, g, s):
+            m, v = s
+            new_w, _, new_m, new_v = fn(w, g, m, v, lr=lr, beta1=beta1,
+                                        beta2=beta2, epsilon=epsilon, wd=wd,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip)
+            return new_w, (new_m, new_v)
+    else:
+        raise ValueError(f"unsupported sharded optimizer '{optimizer}' "
+                         "(sgd / adam; extend make_update_fn)")
+    return init, update
+
+
+class ShardedTrainer:
+    """Compiles a full training step over a mesh.
+
+    Parameters
+    ----------
+    net : initialized gluon Block (params already materialized)
+    loss_fn : gluon Loss or callable(pred_nd, label_nd)->NDArray
+    optimizer, optimizer_params : like gluon.Trainer
+    mesh : jax.sharding.Mesh (default: all-devices 'dp' mesh)
+    param_rules : list of (regex, PartitionSpec) — first match wins;
+        unmatched params are replicated. This is where tp/pp/ep shardings
+        plug in.
+    batch_axis_name : mesh axis the batch dimension is sharded over.
+    """
+
+    def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, param_rules=(), batch_axis_name="dp"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.net = net
+        self.mesh = mesh if mesh is not None else create_mesh()
+        self.loss_fn = loss_fn
+        self._fwd = functional_call(net, train=True)
+        self.params = param_arrays(net)
+        self.aux = aux_arrays(net)
+        init, update = make_update_fn(optimizer, optimizer_params)
+        self.opt_state = init(self.params)
+        self._update = update
+        self._rules = [(re.compile(pat), spec) for pat, spec in param_rules]
+        self._batch_axis = batch_axis_name
+
+        def spec_for(name):
+            for pat, spec in self._rules:
+                if pat.match(name):
+                    return spec
+            return P()
+
+        self._param_sharding = {
+            k: NamedSharding(self.mesh, spec_for(k)) for k in self.params}
+        repl = NamedSharding(self.mesh, P())
+        self._aux_sharding = {k: repl for k in self.aux}
+        self._batch_sharding = NamedSharding(self.mesh, P(batch_axis_name))
+        self._place()
+        self._step = None
+
+    def _place(self):
+        import jax
+
+        self.params = {k: jax.device_put(v, self._param_sharding[k])
+                       for k, v in self.params.items()}
+        self.aux = {k: jax.device_put(v, self._aux_sharding[k])
+                    for k, v in self.aux.items()}
+        self.opt_state = jax.tree.map(
+            lambda v: jax.device_put(v, jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec())), self.opt_state)
+
+    def _build_step(self):
+        import jax
+
+        fwd = self._fwd
+        loss_fn = self.loss_fn
+        update = self._update
+
+        from ..ndarray.ndarray import NDArray
+        from ..jit import TraceSession
+
+        def compute_loss(params, aux, x, y):
+            out, new_aux = fwd(params, aux, x)
+            with TraceSession() as sess:
+                out_nd, y_nd = NDArray(out), NDArray(y)
+                sess.note_created(out_nd)
+                sess.note_created(y_nd)
+                loss = loss_fn(out_nd, y_nd)
+            return loss.data_.mean(), new_aux
+
+        def step(params, aux, opt_state, x, y):
+            (loss, new_aux), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params, aux, x, y)
+            new_params, new_opt = {}, {}
+            for k in params:
+                new_params[k], new_opt[k] = update(
+                    params[k], grads[k], opt_state[k])
+            return new_params, new_aux, new_opt, loss
+
+        out_shardings = (self._param_sharding, self._aux_sharding,
+                         None, None)
+        self._step = jax.jit(
+            step,
+            in_shardings=(self._param_sharding, self._aux_sharding, None,
+                          self._batch_sharding, self._batch_sharding),
+            out_shardings=out_shardings,
+            donate_argnums=(0, 1, 2))
+
+    def step(self, x, y):
+        """Run one sharded training step; returns the scalar loss."""
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        if self._step is None:
+            self._build_step()
+        if isinstance(x, NDArray):
+            x = x.data_
+        if isinstance(y, NDArray):
+            y = y.data_
+        x = jax.device_put(x, self._batch_sharding)
+        y = jax.device_put(y, self._batch_sharding)
+        self.params, self.aux, self.opt_state, loss = self._step(
+            self.params, self.aux, self.opt_state, x, y)
+        return loss
+
+    def sync_to_net(self):
+        """Write the sharded parameter state back into the gluon net."""
+        for name, p in self.net.collect_params().items():
+            if name in self.params:
+                p.data()._set_data(self.params[name])
+            elif name in self.aux:
+                p.data()._set_data(self.aux[name])

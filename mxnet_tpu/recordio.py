@@ -1,0 +1,251 @@
+"""RecordIO file format.
+
+Parity: python/mxnet/recordio.py (MXRecordIO :37, MXIndexedRecordIO :216,
+IRHeader pack/unpack :344-387) and the dmlc-core RecordIO writer the C++
+side used. Binary format is byte-compatible with the reference:
+each record = [kMagic u32][cflag:3bits|length:29bits u32][payload][pad to 4B].
+A C++ reader for the hot data path lives in src/io (ctypes-loaded); this
+module is the pure-Python contract + fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+def _encode_flag_len(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_flag_len(v):
+    return v >> 29, v & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Reads/writes sequential RecordIO files (recordio.py:37)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.record = None
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["record"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        self.record = None
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in multiple processes")
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.record.close()
+        self.is_open = False
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        self.record.write(struct.pack("<II", _kMagic,
+                                      _encode_flag_len(0, len(buf))))
+        self.record.write(buf)
+        pad = (-len(buf)) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        hdr = self.record.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, fl = struct.unpack("<II", hdr)
+        assert magic == _kMagic, "invalid record magic"
+        _, length = _decode_flag_len(fl)
+        buf = self.record.read(length)
+        pad = (-length) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with random access by key (recordio.py:216)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        self.fidx = open(self.idx_path, "w") if self.writable else None
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        pos = self.idx[idx]
+        self.record.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Packs a string payload with an IRHeader (recordio.py:344)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        buf = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                          header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        buf = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                          header.id2) + label.tobytes()
+    return buf + s
+
+
+def unpack(s):
+    """Unpacks an IRHeader + payload (recordio.py:365)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpacks a record into header + decoded image (recordio.py:379)."""
+    header, s = unpack(s)
+    img = _imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Packs an image with an IRHeader (recordio.py:387)."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode(buf, iscolor=1):
+    from io import BytesIO
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("image decode requires PIL") from e
+    img = Image.open(BytesIO(buf.tobytes()))
+    if iscolor == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+    return arr
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    from io import BytesIO
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("image encode requires PIL") from e
+    if hasattr(img, "asnumpy"):
+        img = img.asnumpy()
+    img = np.asarray(img).astype(np.uint8)
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]
+    pil = Image.fromarray(img)
+    bio = BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(bio, format=fmt, quality=quality)
+    return bio.getvalue()

@@ -1,0 +1,351 @@
+"""Gluon Block/Parameter/Trainer/nn/loss tests.
+
+Mirrors the reference's tests/python/unittest/test_gluon.py and
+test_gluon_trainer.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.data().shape == (10, 10)
+    assert len(p.list_data()) == 1
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict_get():
+    params = gluon.ParameterDict("net_")
+    p1 = params.get("w", shape=(2, 2))
+    p2 = params.get("w")
+    assert p1 is p2
+    assert "net_w" in params
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4.0]])
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with mx.autograd.record():
+        x = mx.nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert np.allclose(test.const.data().asnumpy(), test.value)
+    assert np.allclose(x.grad.asnumpy(), np.ones((2, 2)))
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     params=None, prefix="test_")
+    inputs = mx.nd.zeros((32, 4, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (32, 4, 128)
+    # flatten=True
+    model2 = nn.Dense(64, in_units=30)
+    model2.initialize()
+    out = model2(mx.nd.zeros((17, 3, 10)))
+    assert out.shape == (17, 64)
+
+
+def test_dense_deferred_and_hybrid_parity():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 6).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    assert net[0].weight.shape == (8, 6)
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    assert np.allclose(y_eager, y_hybrid, atol=1e-5)
+
+
+def test_sequential_getitem_len_iter():
+    net = nn.Sequential()
+    with net.name_scope():
+        for _ in range(5):
+            net.add(nn.Dense(4, in_units=4))
+    assert len(net) == 5
+    assert isinstance(net[1], nn.Dense)
+    assert len(list(net)) == 5
+
+
+def test_conv_layers():
+    for layer, shape, oshape in [
+        (nn.Conv1D(16, 3, in_channels=4), (2, 4, 10), (2, 16, 8)),
+        (nn.Conv2D(16, 3, in_channels=4, padding=1), (2, 4, 8, 8), (2, 16, 8, 8)),
+        (nn.Conv2D(16, 3, in_channels=4, groups=2), (2, 4, 8, 8), (2, 16, 6, 6)),
+        (nn.Conv3D(8, 3, in_channels=2), (2, 2, 6, 6, 6), (2, 8, 4, 4, 4)),
+    ]:
+        layer.initialize()
+        out = layer(mx.nd.ones(shape))
+        assert out.shape == oshape, (layer, out.shape, oshape)
+
+
+def test_conv_transpose():
+    layer = nn.Conv2DTranspose(16, 3, strides=2, in_channels=4)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 4, 8, 8)))
+    assert out.shape == (2, 16, 17, 17)
+
+
+def test_pool_layers():
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, strides=1)(x).shape == (2, 3, 7, 7)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+    # avg pool matches numpy
+    out = nn.AvgPool2D(2)(x).asnumpy()
+    ref = x.asnumpy().reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    assert np.allclose(out, ref, atol=1e-6)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.rand(8, 4, 3, 3).astype(np.float32) * 5)
+    with mx.autograd.record():
+        y = bn(x)
+    # running stats moved toward batch stats
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    # inference mode uses running stats
+    y_inf = bn(x)
+    assert not np.allclose(y.asnumpy(), y_inf.asnumpy())
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = mx.nd.array(np.random.rand(2, 6, 4).astype(np.float32))
+    ln = nn.LayerNorm(in_channels=4)
+    ln.initialize()
+    out = ln(x).asnumpy()
+    ref = (x.asnumpy() - x.asnumpy().mean(-1, keepdims=True)) / \
+        np.sqrt(x.asnumpy().var(-1, keepdims=True) + 1e-5)
+    assert np.allclose(out, ref, atol=1e-4)
+
+    gn = nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    assert gn(x).shape == x.shape
+
+    inorm = nn.InstanceNorm(in_channels=6)
+    inorm.initialize()
+    assert inorm(x).shape == x.shape
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 5)
+    layer.initialize()
+    x = mx.nd.array([0, 2, 5])
+    out = layer(x)
+    assert out.shape == (3, 5)
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.weight.grad().asnumpy()
+    assert np.abs(g[0]).sum() > 0 and np.abs(g[1]).sum() == 0
+
+
+def test_activations():
+    x = mx.nd.array(np.array([-2.0, -1.0, 0.0, 1.0, 2.0], dtype=np.float32))
+    for blk, fn in [
+        (nn.Activation("relu"), lambda v: np.maximum(v, 0)),
+        (nn.LeakyReLU(0.1), lambda v: np.where(v > 0, v, 0.1 * v)),
+        (nn.ELU(1.0), lambda v: np.where(v > 0, v, np.expm1(v))),
+        (nn.Swish(), lambda v: v / (1 + np.exp(-v))),
+    ]:
+        blk.initialize()
+        out = blk(x).asnumpy()
+        assert np.allclose(out, fn(x.asnumpy()), atol=1e-5), blk
+
+
+def test_losses_vs_numpy():
+    pred = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    ref = 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1)
+    assert np.allclose(l2, ref, atol=1e-6)
+
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    assert np.allclose(l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(axis=1), atol=1e-6)
+
+    # softmax CE with sparse labels
+    logits = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+    lab = mx.nd.array(np.array([0, 1, 2, 1], dtype=np.float32))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(logits, lab).asnumpy()
+    lnp = logits.asnumpy()
+    sm = np.exp(lnp) / np.exp(lnp).sum(1, keepdims=True)
+    ref = -np.log(sm[np.arange(4), lab.asnumpy().astype(int)])
+    assert np.allclose(ce, ref, atol=1e-5)
+
+    # hinge
+    hl = gluon.loss.HingeLoss()(pred, label).asnumpy()
+    ref = np.maximum(0, 1 - pred.asnumpy() * label.asnumpy()).mean(axis=1)
+    assert np.allclose(hl, ref, atol=1e-6)
+
+
+def test_sigmoid_bce():
+    pred = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = mx.nd.array((np.random.rand(4, 3) > 0.5).astype(np.float32))
+    loss = gluon.loss.SigmoidBCELoss()(pred, label).asnumpy()
+    p = pred.asnumpy()
+    ref = (np.maximum(p, 0) - p * label.asnumpy() +
+           np.log1p(np.exp(-np.abs(p)))).mean(axis=1)
+    assert np.allclose(loss, ref, atol=1e-5)
+
+
+def test_trainer_convergence():
+    # tiny linear regression must converge
+    w_true = np.array([[2.0, -3.4]], dtype=np.float32)
+    b_true = 4.2
+    X = np.random.RandomState(0).normal(size=(100, 2)).astype(np.float32)
+    Y = X @ w_true.T + b_true
+
+    net = nn.Dense(1)
+    net.initialize(mx.initializer.Normal(0.01))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(100):
+        with mx.autograd.record():
+            out = net(mx.nd.array(X))
+            loss = loss_fn(out, mx.nd.array(Y))
+        loss.backward()
+        trainer.step(100)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert np.allclose(w, w_true, atol=1e-1)
+    assert np.allclose(b, b_true, atol=1e-1)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = mx.nd.ones((4, 3))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4, activation="relu"))
+        net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    x = mx.nd.ones((2, 4))
+    y1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4, activation="relu"))
+        net2.add(nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    y2 = net2(x).asnumpy()
+    assert np.allclose(y1, y2, atol=1e-6)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="m_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4))
+        net.add(nn.Dense(4, in_units=4))
+    all_params = net.collect_params()
+    assert len(all_params) == 4
+    only_w = net.collect_params(".*weight")
+    assert len(only_w) == 2
+
+
+def test_hybrid_block_grad_matches_eager():
+    np.random.seed(0)
+    x_np = np.random.rand(3, 4).astype(np.float32)
+
+    def build():
+        net = nn.HybridSequential(prefix="gm_")
+        with net.name_scope():
+            net.add(nn.Dense(5, in_units=4, activation="tanh"))
+            net.add(nn.Dense(2, in_units=5))
+        net.initialize(mx.initializer.Xavier())
+        return net
+
+    mx.random.seed(7)
+    net_e = build()
+    mx.random.seed(7)
+    net_h = build()
+    net_h.hybridize()
+
+    grads = []
+    for net in (net_e, net_h):
+        x = mx.nd.array(x_np)
+        with mx.autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        grads.append(net[0].weight.grad().asnumpy())
+    assert np.allclose(grads[0], grads[1], atol=1e-5)
+
+
+def test_export_symbolblock_import(tmp_path):
+    net = nn.HybridSequential(prefix="exp_")
+    with net.name_scope():
+        net.add(nn.Dense(6, in_units=4, activation="relu"))
+        net.add(nn.Dense(3, in_units=6))
+    net.initialize()
+    x = mx.nd.ones((2, 4))
+    y1 = net(x).asnumpy()
+    sym_f, par_f = net.export(str(tmp_path / "model"))
+    net2 = gluon.SymbolBlock.imports(sym_f, ["data"], par_f)
+    y2 = net2(x).asnumpy()
+    assert np.allclose(y1, y2, atol=1e-5)
+
+
+def test_split_and_load():
+    from mxnet_tpu.gluon.utils import split_and_load, split_data
+    x = mx.nd.arange(12).reshape((6, 2))
+    parts = split_data(x, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = split_and_load(x, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2
+
+
+def test_clip_global_norm():
+    from mxnet_tpu.gluon.utils import clip_global_norm
+    arrays = [mx.nd.ones((2, 2)) * 3, mx.nd.ones((3,)) * 4]
+    norm = clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-3
+    assert norm > 1.0

@@ -1,0 +1,92 @@
+"""Gluon data pipeline tests (mirrors reference test_gluon_data.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import data
+
+
+def test_array_dataset():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    ds = data.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    assert len(ds) == 10
+    item = ds[3]
+    assert np.allclose(item[0].asnumpy(), X[3])
+
+
+def test_simple_dataset_transform():
+    ds = data.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: x * 2)
+    assert doubled[4] == 8
+    sharded = ds.shard(3, 0)
+    assert len(sharded) == 4
+
+
+def test_dataloader_basic():
+    X = np.random.rand(25, 4).astype(np.float32)
+    ds = data.ArrayDataset(mx.nd.array(X))
+    loader = data.DataLoader(ds, batch_size=10)
+    shapes = [b.shape for b in loader]
+    assert shapes == [(10, 4), (10, 4), (5, 4)]
+    loader = data.DataLoader(ds, batch_size=10, last_batch="discard")
+    assert len(list(loader)) == 2
+    loader = data.DataLoader(ds, batch_size=10, last_batch="rollover")
+    assert len(list(loader)) == 2
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(64, dtype=np.float32).reshape(32, 2)
+    ds = data.ArrayDataset(mx.nd.array(X))
+    seen = []
+    for b in data.DataLoader(ds, batch_size=8, shuffle=True, num_workers=2):
+        seen.append(b.asnumpy())
+    cat = np.concatenate(seen)
+    assert cat.shape == (32, 2)
+    assert set(cat[:, 0].astype(int)) == set(range(0, 64, 2))
+
+
+def test_mnist_dataset_and_loader():
+    ds = data.vision.MNIST(train=True)
+    assert len(ds) > 0
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    tds = ds.transform_first(data.vision.transforms.ToTensor())
+    loader = data.DataLoader(tds, batch_size=16)
+    x, y = next(iter(loader))
+    assert x.shape == (16, 1, 28, 28)
+    assert float(x.asnumpy().max()) <= 1.0
+
+
+def test_cifar10_dataset():
+    ds = data.vision.CIFAR10(train=False)
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+
+
+def test_transforms_compose():
+    t = data.vision.transforms.Compose([
+        data.vision.transforms.ToTensor(),
+        data.vision.transforms.Normalize(mean=(0.5,), std=(0.25,)),
+    ])
+    x = mx.nd.array((np.random.rand(8, 8, 1) * 255).astype(np.uint8))
+    out = t(x)
+    assert out.shape == (1, 8, 8)
+    ref = (x.asnumpy().transpose(2, 0, 1) / 255.0 - 0.5) / 0.25
+    assert np.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_random_transforms():
+    x = mx.nd.array((np.random.rand(8, 8, 3) * 255).astype(np.uint8))
+    for t in [data.vision.transforms.RandomFlipLeftRight(),
+              data.vision.transforms.RandomFlipTopBottom(),
+              data.vision.transforms.RandomBrightness(0.1)]:
+        out = t(x)
+        assert out.shape[0] == 8
+
+
+def test_batch_sampler():
+    s = data.BatchSampler(data.SequentialSampler(10), 3, "keep")
+    assert list(s) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert len(s) == 4

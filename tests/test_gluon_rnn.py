@@ -1,0 +1,131 @@
+"""Gluon RNN cell/layer tests (mirrors reference test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import rnn
+
+
+def _x(T=5, N=3, C=4):
+    return mx.nd.array(np.random.RandomState(0).rand(T, N, C).astype(np.float32))
+
+
+def test_rnn_cells_shapes():
+    x = _x()
+    for cell, n_states in [(rnn.RNNCell(8, input_size=4), 1),
+                           (rnn.LSTMCell(8, input_size=4), 2),
+                           (rnn.GRUCell(8, input_size=4), 1)]:
+        cell.initialize()
+        outs, states = cell.unroll(5, x, layout="TNC", merge_outputs=True)
+        assert outs.shape == (5, 3, 8)
+        assert len(states) == n_states
+
+
+def test_fused_layers_shapes():
+    x = _x()
+    for layer, h in [(rnn.RNN(7, input_size=4), 7), (rnn.LSTM(7), 7),
+                     (rnn.GRU(7), 7)]:
+        layer.initialize()
+        out = layer(x)
+        assert out.shape == (5, 3, h)
+
+
+def test_lstm_bidirectional_multilayer():
+    x = _x()
+    l = rnn.LSTM(8, num_layers=2, bidirectional=True)
+    l.initialize()
+    out, states = l(x, l.begin_state(3))
+    assert out.shape == (5, 3, 16)
+    assert states[0].shape == (4, 3, 8)
+    assert states[1].shape == (4, 3, 8)
+
+
+def test_ntc_layout():
+    l = rnn.LSTM(6, layout="NTC")
+    l.initialize()
+    x = mx.nd.array(np.random.rand(3, 5, 4).astype(np.float32))
+    assert l(x).shape == (3, 5, 6)
+
+
+def test_cell_vs_fused_lstm_parity():
+    x = _x()
+    fl = rnn.LSTM(8, input_size=4)
+    fl.initialize()
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    cell.i2h_weight.set_data(fl.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fl.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fl.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fl.l0_h2h_bias.data())
+    outs, _ = cell.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert np.allclose(outs.asnumpy(), fl(x).asnumpy(), atol=1e-5)
+
+
+def test_gru_cell_vs_numpy():
+    # single step GRU against a numpy reference (cuDNN gate order r,z,n with
+    # reset applied to the h2h term)
+    np.random.seed(0)
+    H, C = 3, 2
+    cell = rnn.GRUCell(H, input_size=C)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(1, C).astype(np.float32))
+    h0 = mx.nd.array(np.random.rand(1, H).astype(np.float32))
+    out, _ = cell(x, [h0])
+
+    wi = cell.i2h_weight.data().asnumpy()
+    wh = cell.h2h_weight.data().asnumpy()
+    bi = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+    gi = x.asnumpy() @ wi.T + bi
+    gh = h0.asnumpy() @ wh.T + bh
+    ir, iz, inn = np.split(gi, 3, 1)
+    hr, hz, hn = np.split(gh, 3, 1)
+    sigmoid = lambda v: 1 / (1 + np.exp(-v))
+    r, z = sigmoid(ir + hr), sigmoid(iz + hz)
+    n = np.tanh(inn + r * hn)
+    ref = (1 - z) * n + z * h0.asnumpy()
+    assert np.allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_lstm_backward():
+    l = rnn.LSTM(8)
+    l.initialize()
+    x = _x()
+    with mx.autograd.record():
+        out = l(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = l.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_residual_and_dropout_cells():
+    x = _x(5, 3, 8)
+    cell = rnn.ResidualCell(rnn.GRUCell(8, input_size=8))
+    cell.initialize()
+    outs, _ = cell.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (5, 3, 8)
+    dcell = rnn.DropoutCell(0.5)
+    outs, _ = dcell.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (5, 3, 8)
+
+
+def test_sequential_cell():
+    x = _x()
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.LSTMCell(8, input_size=4))
+    seq.add(rnn.GRUCell(6, input_size=8))
+    seq.initialize()
+    outs, states = seq.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (5, 3, 6)
+    assert len(states) == 3
+
+
+def test_bidirectional_cell():
+    x = _x()
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(6, input_size=4),
+                               rnn.LSTMCell(6, input_size=4))
+    bi.initialize()
+    outs, states = bi.unroll(5, x, layout="TNC", merge_outputs=True)
+    assert outs.shape == (5, 3, 12)
+    assert len(states) == 4

@@ -1,0 +1,49 @@
+"""Model zoo construction + forward tests (mirrors reference
+tests/python/unittest/test_gluon_model_zoo.py, scaled down for CI speed)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name,in_shape,classes", [
+    ("resnet18_v1", (1, 3, 32, 32), 10),
+    ("resnet18_v2", (1, 3, 32, 32), 10),
+    ("mobilenet0.25", (1, 3, 32, 32), 10),
+    ("mobilenetv2_0.25", (1, 3, 32, 32), 10),
+    ("squeezenet1.1", (1, 3, 64, 64), 10),
+])
+def test_model_forward(name, in_shape, classes):
+    net = vision.get_model(name, classes=classes)
+    net.initialize()
+    out = net(mx.nd.ones(in_shape))
+    assert out.shape == (in_shape[0], classes)
+
+
+def test_resnet50_v1_structure():
+    # flagship: parameter count must match the reference resnet50_v1 (25.6M)
+    net = vision.resnet50_v1()
+    net.initialize()
+    net(mx.nd.ones((1, 3, 224, 224)))
+    n_params = sum(
+        int(np.prod(p.shape)) for p in net.collect_params().values())
+    assert abs(n_params - 25_557_032) / 25_557_032 < 0.01, n_params
+
+
+def test_model_zoo_train_step():
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    net.hybridize()
+    from mxnet_tpu import gluon
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.rand(2, 3, 32, 32).astype(np.float32))
+    y = mx.nd.array(np.array([1, 3], dtype=np.float32))
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(2)
+    assert np.isfinite(loss.asnumpy()).all()
