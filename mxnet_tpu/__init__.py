@@ -13,11 +13,15 @@ Usage mirrors the reference:
 from __future__ import annotations
 
 def _configure_jax():
-    import jax
+    """TPU-first numerics: float32 default (f64 is emulated/slow on TPU and
+    silently changes promotion semantics). Opt into x64 per-process with
+    MXNET_TPU_ENABLE_X64=1 (e.g. for float64 parity testing on CPU)."""
+    import os
 
-    # float64 support for API parity with the reference (tests compare
-    # against float64 numpy); weak-typed literals keep float32 as default.
-    jax.config.update("jax_enable_x64", True)
+    if os.environ.get("MXNET_TPU_ENABLE_X64") == "1":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
 
 
 _configure_jax()

@@ -1,0 +1,5 @@
+"""mx.amp — automatic mixed precision (reference: python/mxnet/contrib/amp)."""
+from .amp import (init, init_trainer, scale_loss, unscale, convert_model,
+                  convert_hybrid_block, amp_active, cast_inputs_for, reset)
+from .loss_scaler import LossScaler
+from . import lists

@@ -1,0 +1,159 @@
+"""Automatic mixed precision.
+
+Parity: python/mxnet/contrib/amp/amp.py. The reference monkey-patches op
+creators to insert amp_cast/amp_multicast symbols (amp.py:251); this build
+hooks the single imperative dispatch chokepoint (imperative_invoke) instead:
+with AMP active, inputs of MXU-bound ops are cast to the target dtype and
+inputs of numerically-sensitive ops to fp32 (lists.py). Because hybridize /
+mx.jit.trace re-run the imperative Python under jit, the same hook covers
+compiled executables — the casts land inside the XLA graph and fuse away.
+
+bf16 is the TPU-native target (same exponent range as fp32 → loss scaling
+usually unnecessary); fp16 is supported for reference parity with the
+dynamic LossScaler.
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+
+import numpy as _np
+
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "amp_active",
+           "cast_inputs_for"]
+
+_STATE = {"active": False, "target_dtype": None, "target_ops": frozenset(),
+          "fp32_ops": frozenset(), "widest_ops": frozenset(),
+          "loss_scaler": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Turn on AMP (amp.py:251).
+
+    target_dtype : 'bfloat16' (TPU-native) or 'float16' (reference parity).
+    target_precision_ops / fp32_ops : override the default op lists.
+    """
+    import jax.numpy as jnp
+
+    target_dtype = str(target_dtype)
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16, got "
+                         f"{target_dtype}")
+    if conditional_fp32_ops:
+        warnings.warn("conditional_fp32_ops is accepted for API parity but "
+                      "treated as fp32_ops")
+    fp32 = set(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    if conditional_fp32_ops:
+        fp32.update(op for op, _, _ in conditional_fp32_ops)
+    if target_dtype == "float16":
+        fp32.update(lists.FP16_FP32_OPS)
+    _STATE.update(
+        active=True,
+        target_dtype=jnp.bfloat16 if target_dtype == "bfloat16"
+        else jnp.float16,
+        target_ops=frozenset(target_precision_ops
+                             if target_precision_ops is not None
+                             else lists.TARGET_DTYPE_OPS),
+        fp32_ops=frozenset(fp32),
+        widest_ops=frozenset(lists.WIDEST_TYPE_CASTS),
+        loss_scaler=LossScaler(
+            init_scale=2. ** 16 if target_dtype == "float16" else 1.0),
+    )
+
+
+def reset():
+    """Deactivate AMP (this build's extension; the reference has no off
+    switch, but tests need one)."""
+    _STATE.update(active=False, target_dtype=None,
+                  target_ops=frozenset(), fp32_ops=frozenset(),
+                  widest_ops=frozenset(), loss_scaler=None)
+
+
+def amp_active():
+    return _STATE["active"]
+
+
+def cast_inputs_for(opname, in_arrays):
+    """Dispatch hook: returns in_arrays cast per the active policy.
+    Called from imperative_invoke; cheap no-op when AMP is off."""
+    import jax.numpy as jnp
+
+    if not _STATE["active"]:
+        return in_arrays
+    tgt = None
+    if opname in _STATE["target_ops"]:
+        tgt = _STATE["target_dtype"]
+    elif opname in _STATE["fp32_ops"]:
+        tgt = jnp.float32
+    elif opname in _STATE["widest_ops"]:
+        f_dtypes = [a.dtype for a in in_arrays
+                    if hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)]
+        if len(set(map(str, f_dtypes))) > 1:
+            tgt = jnp.result_type(*f_dtypes)
+    if tgt is None:
+        return in_arrays
+    return [a.astype(tgt)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            and a.dtype != tgt else a
+            for a in in_arrays]
+
+
+def init_trainer(trainer):
+    """Attach the loss scaler to a gluon Trainer (amp.py init_trainer)."""
+    if not _STATE["active"]:
+        raise RuntimeError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = _STATE["loss_scaler"]
+    trainer._amp_original_scale = trainer._scale
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale loss before backward; arrange for gradient unscaling in
+    trainer.step (amp.py scale_loss)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Explicitly check overflow + update the dynamic scale; returns True
+    if this step's gradients are safe to apply."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return True
+    params = [p for p in trainer._params if p.grad_req != "null"]
+    overflow = scaler.has_overflow(params)
+    scaler.update_scale(overflow)
+    return not overflow
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None, cast_optional_params=False):
+    """Cast a symbolic model's params for low-precision inference
+    (amp.py convert_model). The symbol itself is unchanged — ops follow
+    their input dtypes in this build's executor."""
+    import numpy as np
+
+    tgt = _np.dtype("float16") if target_dtype == "float16" else "bfloat16"
+    new_args = {k: v.astype(tgt) for k, v in arg_params.items()}
+    new_aux = {k: v.astype(tgt) for k, v in aux_params.items()}
+    return sym, new_args, new_aux
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", **kwargs):
+    """Cast a HybridBlock's params in place for low-precision inference
+    (amp.py convert_hybrid_block)."""
+    block.cast(target_dtype)
+    return block

@@ -1,0 +1,57 @@
+"""Dynamic loss scaling.
+
+Parity: python/mxnet/contrib/amp/loss_scaler.py — scale the loss up before
+backward so fp16 gradients don't flush to zero, check for inf/nan with the
+fused all_finite kernel (src/operator/contrib/all_finite.cc), and adapt the
+scale (halve on overflow, double every ``scale_window`` clean steps).
+bf16 shares fp32's exponent range, so bf16 training normally runs with
+scale 1.0 and this class matters for fp16 parity.
+"""
+from __future__ import annotations
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2. ** 16, scale_factor=2.,
+                 scale_window=2000, tolerance=0.):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient in ``params`` (list of Parameter or NDArray)
+        contains inf/nan. Uses the fused multi_all_finite kernel."""
+        from ..ndarray import ndarray as _nd
+
+        grads = []
+        for p in params:
+            g = getattr(p, "_grad", None)
+            if isinstance(g, _nd.NDArray):
+                grads.append(g)
+            elif isinstance(g, (list, tuple)) and g:
+                grads.extend(g)
+            elif hasattr(p, "list_grad"):
+                try:
+                    grads.extend(p.list_grad())
+                except Exception:
+                    pass
+            elif isinstance(p, _nd.NDArray):
+                grads.append(p)
+        if not grads:
+            return False
+        finite = _nd.imperative_invoke(
+            "multi_all_finite", *grads, num_arrays=len(grads))[0]
+        return not bool(finite.asnumpy().reshape(-1)[0])
+
+    def update_scale(self, overflow):
+        """Dynamic adjustment (loss_scaler.py update_scale)."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

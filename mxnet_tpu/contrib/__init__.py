@@ -1,0 +1,21 @@
+"""Experimental / contributed namespaces.
+
+Parity: python/mxnet/contrib/ — the reference parks AMP, ONNX, quantization,
+tensorboard, and the estimator fit-API here. In this build mx.amp is a
+first-class top-level module; `contrib.amp` aliases it for scripts written
+against the reference layout.
+"""
+from .. import amp  # noqa: F401  (contrib.amp parity alias)
+
+
+def __getattr__(name):
+    import importlib
+
+    lazy = {
+        "tensorboard": ".tensorboard",
+    }
+    if name in lazy:
+        mod = importlib.import_module(lazy[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.contrib' has no attribute {name!r}")

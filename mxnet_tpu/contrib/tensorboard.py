@@ -1,0 +1,37 @@
+"""TensorBoard logging callback.
+
+Parity: python/mxnet/contrib/tensorboard.py (LogMetricsCallback). Uses any
+SummaryWriter-compatible object (tensorboardX / torch.utils.tensorboard —
+torch is available in this environment); constructing without one raises
+with instructions rather than failing at import.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log training speed and metrics to TensorBoard every batch
+    (tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError as e:
+            raise ImportError(
+                "LogMetricsCallback needs a SummaryWriter backend "
+                "(torch.utils.tensorboard or tensorboardX)") from e
+        self.step = 0
+
+    def __call__(self, param):
+        """BatchEndParam callback."""
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.step += 1

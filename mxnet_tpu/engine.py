@@ -1,0 +1,35 @@
+"""Engine control shim.
+
+Parity: python/mxnet/engine.py (bulk/set_bulk_size over the dependency
+engine, include/mxnet/engine.h:311). TPU-native: PJRT's async dispatch is the
+dependency engine — ops return immediately and sequence on buffer futures —
+and XLA fusion inside jitted executables is the op-bulking analogue. The
+bulk-size knobs are therefore accepted for API compatibility and recorded,
+but the actual batching decision belongs to jit tracing (mx.jit.trace /
+hybridize), which compiles whole steps into one executable.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_BULK_SIZE = 0
+
+
+def set_bulk_size(size):
+    """Set maximum number of ops to bulk (engine.py:26). Returns the
+    previous value. On TPU this is advisory — jit tracing supersedes it."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scope bulking hint (engine.py:45)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -40,7 +40,10 @@ def _graph_program(symbol):
         has_train = "_train" in _inspect.signature(op.fn).parameters
         ops_meta.append((n, op, params, has_train))
 
-    def pure_fn(arg_vals, aux_vals, is_train):
+    def pure_fn(arg_vals, aux_vals, is_train, tap=None):
+        # tap: optional callback(node_name, out_index, raw_array) — the
+        # monitor hook (reference GraphExecutor::SetMonitorCallback,
+        # graph_executor.cc:187); only used on eager (non-jitted) passes
         env = {}
         aux_out = list(aux_vals)
         for n in nodes:
@@ -59,6 +62,8 @@ def _graph_program(symbol):
             n_primary = op.n_out(params)
             for i in range(n_primary):
                 env[(id(n), i)] = raw[i]
+                if tap is not None:
+                    tap(n.name, i, raw[i])
             for slot, val in zip(op.mutate, raw[n_primary:]):
                 tgt_node, tgt_slot = n.inputs[slot]
                 env[(id(tgt_node), tgt_slot)] = val
@@ -146,7 +151,13 @@ class Executor:
         return [self.aux_dict[n] for n in self._aux_names]
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-op output tap (reference
+        GraphExecutor::SetMonitorCallback, graph_executor.cc:187). While a
+        callback is installed, forward runs the graph eagerly op-by-op so
+        every intermediate can be observed — the NaiveEngine-style debug
+        mode; clear the callback to return to the fused executable."""
         self.monitor_callback = callback
+        self._monitor_all = monitor_all
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -165,7 +176,17 @@ class Executor:
     def _run_forward(self, is_train):
         arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
         aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
-        outs, new_aux = self._jit_fwd(arg_vals, aux_vals, bool(is_train))
+        if self.monitor_callback is not None:
+            cb = self.monitor_callback
+
+            def tap(name, i, arr):
+                out_name = f"{name}_output" if i == 0 else f"{name}_output{i}"
+                cb(out_name, NDArray(arr, self._ctx))
+
+            outs, new_aux = self._pure(arg_vals, aux_vals, bool(is_train),
+                                       tap=tap)
+        else:
+            outs, new_aux = self._jit_fwd(arg_vals, aux_vals, bool(is_train))
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         for n, v in zip(self._aux_names, new_aux):
             self.aux_dict[n]._data = v

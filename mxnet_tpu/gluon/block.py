@@ -435,7 +435,12 @@ class HybridBlock(Block):
                     else a for a in args)
         fn = self._traced.get(key)
         if fn is None:
-            fn = _jit.trace(lambda *xs: self._call_with_params(*xs))
+            # non-tensor extras (scalars, None, flags) become static args so
+            # TracedFunction never asks them for .shape
+            statics = tuple(i for i, a in enumerate(args)
+                            if not isinstance(a, tensor_types))
+            fn = _jit.trace(lambda *xs: self._call_with_params(*xs),
+                            static_argnums=statics)
             self._traced[key] = fn
         return fn(*args)
 

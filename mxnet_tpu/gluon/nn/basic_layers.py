@@ -143,7 +143,7 @@ class Dense(HybridBlock):
         return "{name}({layout}, {act})".format(
             name=self.__class__.__name__,
             act=self.act if self.act else "linear",
-            layout=f"{shape[0]} -> {shape[1] if len(shape) > 1 else None}")
+            layout=f"{shape[1] if len(shape) > 1 and shape[1] else None} -> {shape[0]}")
 
 
 class Dropout(HybridBlock):

@@ -77,21 +77,12 @@ class _RNNLayer(HybridBlock):
                         **self.__dict__)
 
     def _collect_params_with_prefix(self, prefix=""):
+        # same contract as Block: .data() raises DeferredInitializationError
+        # for params whose shapes are still pending
         if prefix:
             prefix += "."
-        pattern = re_pattern = None
-        def convert_key(m, bidirectional):  # for compatibility with old parameter format
-            d, l, g, t = [m[i] for i in range(4)]
-            if bidirectional:
-                return f"_unfused.{l}.{d}_cell.{g}_{t}"
-            return f"_unfused.{l}.{g}_{t}"
-        import re
-        bidirectional = any(k.startswith("r") for k in self._reg_params)
-        ret = {}
-        for k, val in self._reg_params.items():
-            m = re.match(r"(l|r)(\d+)_(i2h|h2h)_(weight|bias)", k)
-            ret[prefix + k] = val.data() if val._data is not None else None
-        return {k: v for k, v in ret.items() if v is not None}
+        return {prefix + k: val.data() for k, val in self._reg_params.items()
+                if val._data is not None or val._deferred_init}
 
     def state_info(self, batch_size=0):
         raise NotImplementedError

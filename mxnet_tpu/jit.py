@@ -150,7 +150,7 @@ class TracedFunction:
         jitted, pure, state_cells, n_out, single = entry
         state_vals = [c._data for c in state_cells]
         outs, new_state = jitted([a._data for a in dyn], state_vals)
-        ctx = args[0].context if args else None
+        ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
         out_nds = [NDArray(o, ctx) for o in outs]
         if autograd.is_recording():
             # the whole traced program is ONE tape node, exactly like the
@@ -190,11 +190,14 @@ class TracedFunction:
         with TraceSession() as sess:
             for a in args:
                 sess.note_created(a)
-            result = self.fn(*args)
-        # Roll back discovery side-effects: the jitted execution (below, in
-        # __call__) applies each mutation exactly once.
-        for m in sess.mutated:
-            m._data = sess.orig[id(m)]
+            try:
+                result = self.fn(*args)
+            finally:
+                # Roll back discovery side-effects even when fn raises
+                # mid-discovery; the jitted execution (below, in __call__)
+                # applies each mutation exactly once.
+                for m in sess.mutated:
+                    m._data = sess.orig[id(m)]
         single = not isinstance(result, (list, tuple))
         res_list = [result] if single else list(result)
         n_out = len(res_list)

@@ -1,0 +1,104 @@
+"""Monitor: per-op output statistics during training.
+
+Parity: python/mxnet/monitor.py — taps every operator output (and optionally
+weights) via the executor monitor callback
+(GraphExecutor::SetMonitorCallback, graph_executor.cc:187), batching stats
+between tic()/toc(). TPU-native note: while installed, the executor runs
+op-by-op (eager) so intermediates exist as host-visible buffers; uninstall
+to get the fused single-executable path back.
+"""
+from __future__ import annotations
+
+import re
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Monitor outputs, weights, and gradients for debugging.
+
+    Parameters
+    ----------
+    interval : int — max batches between stat collections.
+    stat_func : callable(NDArray)->NDArray, default |x|/size (asum_stat).
+    pattern : regex matched against tapped names.
+    sort : sort output statistics by name.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return x.norm() / x.size ** 0.5
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all=False):
+        """Install the tap on an executor (monitor.py install)."""
+        exe.set_monitor_callback(
+            lambda name, arr: self.stat_helper(name, arr), monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for the current batch (monitor.py tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collection; returns [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append(
+                        (self.step, name, self.stat_func(array)))
+            for name, array in exe.aux_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append(
+                        (self.step, name, self.stat_func(array)))
+        res = []
+        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort \
+            else self.queue
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            if not isinstance(v_list, list):
+                raise MXNetError(f"stat_func should return NDArray or list "
+                                 f"of NDArray, got {type(v_list)}")
+            s = ""
+            for v in v_list:
+                if not isinstance(v, NDArray):
+                    raise MXNetError("the elements of stat function "
+                                     "should be NDArray")
+                s += str(float(v.asnumpy().reshape(-1)[0])) + "\t" \
+                    if v.size == 1 else str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and print the stats (monitor.py toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            print(f"Batch: {n:7d} {k:30s} {v}")

@@ -531,13 +531,69 @@ def _norm_axis(axis):
 # honors `mutate` slots, and records on the autograd tape.
 # ---------------------------------------------------------------------------
 
+class _CastedOp:
+    """Tape-record shim: replays an op with the AMP input casts the dispatch
+    applied, so vjp differentiates through the casts and gradients land in
+    the ORIGINAL (master) dtypes."""
+
+    __slots__ = ("_op", "_spec", "no_grad", "name", "mutate")
+
+    def __init__(self, op, cast_spec):
+        self._op = op
+        self._spec = cast_spec       # per-input dtype str or None
+        self.no_grad = op.no_grad
+        self.name = op.name
+        self.mutate = op.mutate
+
+    def closed(self, params):
+        base = self._op.closed(params)
+        spec = self._spec
+
+        def fn(*xs):
+            xs = [x if d is None else x.astype(d)
+                  for x, d in zip(xs, spec)]
+            return base(*xs)
+
+        return fn
+
+
+_AMP_MOD = None
+
+
+def _amp_mod():
+    """Lazy handle on mxnet_tpu.amp.amp (AMP dispatch hook); resolved once."""
+    global _AMP_MOD
+    if _AMP_MOD is None:
+        from ..amp import amp as _a
+
+        _AMP_MOD = _a
+    return _AMP_MOD
+
+
 def imperative_invoke(opname, *inputs, out=None, **params):
     from .. import autograd
 
     op = _reg.get_op(opname)
     params = op.normalize(params)
     in_arrays = [x._data for x in inputs]
-    ctx = inputs[0].context if inputs else params.pop("ctx", None) or current_context()
+    amp_cast_spec = None
+    if _amp_mod() is not None and _amp_mod().amp_active():
+        orig_arrays = in_arrays
+        in_arrays = _amp_mod().cast_inputs_for(op.name, in_arrays)
+        if in_arrays is not orig_arrays:
+            spec = [None if new is old else str(new.dtype)
+                    for new, old in zip(in_arrays, orig_arrays)]
+            if any(s is not None for s in spec):
+                amp_cast_spec = tuple(spec)
+    # explicit ctx= beats input placement (mx.random.* with ctx=, creation
+    # ops); otherwise follow the first input like the reference's dispatch
+    explicit_ctx = params.pop("ctx", None)
+    if explicit_ctx is not None:
+        ctx = explicit_ctx
+    elif inputs:
+        ctx = inputs[0].context
+    else:
+        ctx = current_context()
     import jax.core as jcore
 
     traced = any(isinstance(a, jcore.Tracer) for a in in_arrays)
@@ -547,16 +603,25 @@ def imperative_invoke(opname, *inputs, out=None, **params):
     outputs = [NDArray(r, ctx) for r in raw[:n_primary]]
     # write mutated aux slots (e.g. BatchNorm running stats, optimizer weights)
     if op.mutate:
+        amp_on = _amp_mod() is not None and _amp_mod().amp_active()
         for slot_name, val in zip(op.mutate, raw[n_primary:]):
             idx = slot_name if isinstance(slot_name, int) else None
             if idx is None:
                 raise MXNetError("mutate slots must be input indices")
+            if amp_on:
+                # AMP may have cast this op's inputs; keep stateful cells
+                # (BatchNorm stats, optimizer state) at their own dtype
+                cur = inputs[idx]._data
+                if (hasattr(val, "dtype") and hasattr(cur, "dtype")
+                        and val.dtype != cur.dtype):
+                    val = val.astype(cur.dtype)
             inputs[idx]._set_data(val)
     from ..jit import _notify_io
 
     _notify_io(inputs, outputs)
     if autograd.is_recording() and not op.no_grad:
-        autograd.record_op(op, params, list(inputs), outputs)
+        rec_op = op if amp_cast_spec is None else _CastedOp(op, amp_cast_spec)
+        autograd.record_op(rec_op, params, list(inputs), outputs)
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o, r in zip(outs, outputs):

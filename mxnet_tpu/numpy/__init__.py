@@ -1,0 +1,258 @@
+"""mx.np — the NumPy-compatible frontend.
+
+Parity: python/mxnet/numpy/ (multiarray.py + 23k LoC of `_np_*` ops under
+src/operator/numpy/). TPU-native design: jax.numpy IS a NumPy
+implementation lowered to XLA, so the `_npi_` kernel layer collapses to a
+delegation table — every function unwraps mx arrays, calls the jnp
+equivalent, and wraps the result back as mx.np.ndarray. True scalars,
+bool dtype, and zero-dim shapes come for free.
+
+Toggle gluon/nd interop with mx.util.set_np() (util.py).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .multiarray import ndarray, array, _wrap, _unwrap, _as_np
+
+__all__ = ["ndarray", "array"]
+
+# dtype aliases / constants (numpy/__init__ parity)
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+integer = _onp.integer
+floating = _onp.floating
+dtype = _onp.dtype
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _delegate(name):
+    def fn(*args, **kwargs):
+        jnp_fn = getattr(_jnp(), name)
+        args = [_unwrap(a) if not isinstance(a, (list, tuple))
+                else type(a)(_unwrap(x) for x in a) for a in args]
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        return _wrap(jnp_fn(*args, **kwargs))
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = f"mx.np.{name} — NumPy-semantics op (delegates to XLA " \
+                 f"via jax.numpy.{name}; parity: src/operator/numpy/)."
+    return fn
+
+
+_DELEGATED = [
+    # creation
+    "zeros", "ones", "empty", "full", "arange", "linspace", "logspace",
+    "eye", "identity", "tri", "tril", "triu", "diag", "diagflat",
+    "zeros_like", "ones_like", "empty_like", "full_like", "copy",
+    # manipulation
+    "reshape", "transpose", "concatenate", "stack", "vstack", "hstack",
+    "dstack", "column_stack", "split", "array_split", "hsplit", "vsplit",
+    "dsplit", "expand_dims", "squeeze", "repeat", "tile", "flip", "fliplr",
+    "flipud", "roll", "rot90", "moveaxis", "swapaxes", "broadcast_to",
+    "broadcast_arrays", "atleast_1d", "atleast_2d", "atleast_3d", "ravel",
+    "append", "delete", "insert", "pad", "take", "take_along_axis",
+    "where", "extract", "tril_indices", "nonzero", "flatnonzero",
+    "unravel_index", "ravel_multi_index", "diag_indices_from",
+    # math — elementwise
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "fmod", "power", "float_power", "sqrt", "cbrt",
+    "square", "absolute", "abs", "fabs", "sign", "exp", "expm1", "exp2",
+    "log", "log2", "log10", "log1p", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "arctan2", "sinh", "cosh", "tanh", "arcsinh",
+    "arccosh", "arctanh", "degrees", "radians", "deg2rad", "rad2deg",
+    "reciprocal", "negative", "positive", "rint", "fix", "floor", "ceil",
+    "trunc", "clip", "maximum", "minimum", "fmax", "fmin", "hypot",
+    "heaviside", "nan_to_num", "real", "imag", "conj", "angle",
+    "logaddexp", "logaddexp2", "copysign", "nextafter", "ldexp", "frexp",
+    "signbit", "spacing", "modf", "divmod", "gcd", "lcm",
+    # reductions / stats
+    "sum", "prod", "mean", "std", "var", "median", "average", "min", "max",
+    "amin", "amax", "ptp", "percentile", "quantile", "nanpercentile",
+    "nanquantile", "nansum", "nanprod", "nanmean", "nanstd", "nanvar",
+    "nanmin", "nanmax", "cumsum", "cumprod", "nancumsum", "nancumprod",
+    "diff", "ediff1d", "gradient", "trapezoid", "argmax", "argmin",
+    "nanargmax", "nanargmin", "count_nonzero",
+    # linear algebra
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "trace",
+    # sorting / searching / counting
+    "sort", "argsort", "lexsort", "partition", "argpartition", "searchsorted",
+    "unique", "bincount", "digitize", "histogram", "histogram2d",
+    "histogramdd", "histogram_bin_edges",
+    # logic
+    "all", "any", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isfinite", "isinf", "isnan", "isneginf", "isposinf", "isclose",
+    "allclose", "array_equal", "array_equiv", "greater", "greater_equal",
+    "less", "less_equal", "equal", "not_equal",
+    # rounding / misc
+    "round", "around", "interp", "convolve", "correlate", "polyval",
+    "vander", "meshgrid", "indices",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+]
+
+for _name in _DELEGATED:
+    globals()[_name] = _delegate(_name)
+__all__ += _DELEGATED
+
+
+def asarray(a, dtype=None, ctx=None):
+    return array(a, dtype=dtype, ctx=ctx)
+
+
+# non-array-returning helpers (kept out of the _wrap table so ints/tuples
+# come back as plain Python values)
+def shape(a):
+    return tuple(_unwrap(a).shape)
+
+
+def ndim(a):
+    return _unwrap(a).ndim
+
+
+def size(a, axis=None):
+    x = _unwrap(a)
+    return x.shape[axis] if axis is not None else x.size
+
+
+def result_type(*args):
+    return _jnp().result_type(*[_unwrap(a) for a in args])
+
+
+def can_cast(from_, to, casting="safe"):
+    return _onp.can_cast(from_, to, casting=casting)
+
+
+def promote_types(t1, t2):
+    return _jnp().promote_types(t1, t2)
+
+
+def asnumpy(a):
+    return a.asnumpy() if hasattr(a, "asnumpy") else _onp.asarray(a)
+
+
+def may_share_memory(a, b, max_work=None):
+    return _unwrap(a) is _unwrap(b)
+
+
+class linalg:
+    """mx.np.linalg (numpy/linalg.py parity) — delegates to jnp.linalg."""
+
+    @staticmethod
+    def _d(name):
+        def fn(*args, **kwargs):
+            import jax.numpy as jnp
+
+            args = [_unwrap(a) for a in args]
+            return _wrap(getattr(jnp.linalg, name)(*args, **kwargs))
+        return fn
+
+
+for _name in ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det",
+              "slogdet", "solve", "lstsq", "eig", "eigh", "eigvals",
+              "eigvalsh", "matrix_rank", "matrix_power", "multi_dot",
+              "tensorinv", "tensorsolve"]:
+    setattr(linalg, _name, staticmethod(linalg._d(_name)))
+
+
+class random:
+    """mx.np.random (numpy/random.py parity) — seeded by mx.random.seed
+    through the shared global key cell."""
+
+    @staticmethod
+    def seed(s):
+        from .. import random as _r
+
+        _r.seed(s)
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+        from .. import random as _r
+
+        return _as_np(_r.uniform(low, high, shape=size,
+                                 dtype=dtype or "float32", ctx=ctx))
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+        from .. import random as _r
+
+        return _as_np(_r.normal(loc, scale, shape=size,
+                                dtype=dtype or "float32", ctx=ctx))
+
+    @staticmethod
+    def randint(low, high=None, size=None, dtype=None, ctx=None):
+        from .. import random as _r
+
+        if high is None:
+            low, high = 0, low
+        return _as_np(_r.randint(low, high, shape=size,
+                                 dtype=dtype or "int32", ctx=ctx))
+
+    @staticmethod
+    def rand(*size):
+        return random.uniform(size=size or None)
+
+    @staticmethod
+    def randn(*size):
+        return random.normal(size=size or None)
+
+    @staticmethod
+    def choice(a, size=None, replace=True, p=None, ctx=None):
+        import jax
+
+        from .. import random as _r
+
+        key_cell = _r.generator_key()
+        import jax.numpy as jnp
+
+        key, sub = jax.random.split(key_cell._data)
+        key_cell._set_data(key)
+        a_val = _unwrap(a)
+        if isinstance(a_val, int):
+            a_val = jnp.arange(a_val)
+        shape = (size,) if isinstance(size, int) else (size or ())
+        out = jax.random.choice(sub, a_val, shape=shape, replace=replace,
+                                p=_unwrap(p) if p is not None else None)
+        return _wrap(out)
+
+    @staticmethod
+    def shuffle(x):
+        from .. import random as _r
+
+        _r.shuffle(x, out=x)
+        return None
+
+
+def __getattr__(name):
+    # any numpy API name not in the table: try jnp before failing, so the
+    # long tail (e.g. np.float_power variants) keeps working
+    import jax.numpy as jnp
+
+    if hasattr(jnp, name):
+        fn = _delegate(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute {name!r}")

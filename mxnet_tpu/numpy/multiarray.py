@@ -1,0 +1,277 @@
+"""mx.np ndarray — the NumPy-semantics array type.
+
+Parity: python/mxnet/numpy/multiarray.py (mx.np.ndarray) over
+src/operator/numpy/. TPU-native design: the nd namespace wraps legacy-MXNet
+semantics (no true scalars, no bool); mx.np.ndarray subclasses the same
+jax.Array cell but follows NumPy rules — zero-dim results, bool dtype,
+numpy-style broadcasting/indexing — by delegating straight to jax.numpy,
+which already implements the NumPy API. The two types share buffers:
+``as_nd_ndarray``/``as_np_ndarray`` convert without copying.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray, from_jax
+from ..context import current_context
+
+__all__ = ["ndarray", "array", "_as_np", "_wrap", "_unwrap"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _unwrap(x):
+    """mx array | scalar | numpy -> jax-compatible value."""
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def _wrap(x, ctx=None):
+    """jax value -> mx.np.ndarray (scalars stay arrays; () shapes allowed)."""
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v, ctx) for v in x)
+    if hasattr(x, "dtype") or isinstance(x, (int, float, complex, bool)):
+        import jax.numpy as jnp
+
+        return ndarray(jnp.asarray(x), ctx)
+    return x
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array (multiarray.py:ndarray).
+
+    Differences from mx.nd.NDArray mirror the reference:
+    - indexing returns zero-dim arrays (true scalar semantics via item())
+    - bool and all numpy dtypes supported
+    - operators broadcast by NumPy rules (jax.numpy implements them)
+    """
+
+    # ------------------------------------------------------------- conversion
+    def as_nd_ndarray(self):
+        return NDArray(self._data, self._ctx)
+
+    def as_np_ndarray(self):
+        return self
+
+    def asnumpy(self):
+        return _onp.asarray(self._data)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    @property
+    def T(self):
+        return _wrap(self._data.T, self._ctx)
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, key):
+        key = _unwrap_key(key)
+        return _wrap(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        key = _unwrap_key(key)
+        val = _unwrap(value)
+        self._set_data(self._data.at[key].set(val))
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # ------------------------------------------------------------- operators
+    def _binop(self, other, fn, reverse=False):
+        a, b = _unwrap(self), _unwrap(other)
+        if reverse:
+            a, b = b, a
+        return _wrap(fn(a, b), self._ctx)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    def __radd__(self, o):
+        return self._binop(o, lambda a, b: a + b, True)
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: a - b, True)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    def __rmul__(self, o):
+        return self._binop(o, lambda a, b: a * b, True)
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: a / b, True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, lambda a, b: a // b)
+
+    def __mod__(self, o):
+        return self._binop(o, lambda a, b: a % b)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b)
+
+    def __neg__(self):
+        return _wrap(-self._data, self._ctx)
+
+    def __abs__(self):
+        return _wrap(abs(self._data), self._ctx)
+
+    def __eq__(self, o):
+        return self._binop(o, lambda a, b: a == b)
+
+    def __ne__(self, o):
+        return self._binop(o, lambda a, b: a != b)
+
+    def __lt__(self, o):
+        return self._binop(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._binop(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._binop(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._binop(o, lambda a, b: a >= b)
+
+    __hash__ = None  # numpy semantics: arrays are unhashable
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an array with more than one "
+                             "element is ambiguous.")
+        return bool(self.asnumpy().reshape(())[()])
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return f"array({self.asnumpy()})"
+
+    # ------------------------------------------------------------- methods
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _wrap(self._data.reshape(shape), self._ctx)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _wrap(self._data.transpose(axes or None), self._ctx)
+
+    def astype(self, dtype, copy=True):
+        return _wrap(self._data.astype(_np_dtype(dtype)), self._ctx)
+
+    def copy(self):
+        return _wrap(_jnp().array(self._data, copy=True), self._ctx)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return _wrap(self._data.sum(axis=axis, dtype=dtype,
+                                    keepdims=keepdims), self._ctx)
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return _wrap(self._data.mean(axis=axis, dtype=dtype,
+                                     keepdims=keepdims), self._ctx)
+
+    def max(self, axis=None, keepdims=False):
+        return _wrap(self._data.max(axis=axis, keepdims=keepdims), self._ctx)
+
+    def min(self, axis=None, keepdims=False):
+        return _wrap(self._data.min(axis=axis, keepdims=keepdims), self._ctx)
+
+    def argmax(self, axis=None):
+        return _wrap(self._data.argmax(axis=axis), self._ctx)
+
+    def argmin(self, axis=None):
+        return _wrap(self._data.argmin(axis=axis), self._ctx)
+
+    def cumsum(self, axis=None, dtype=None):
+        return _wrap(self._data.cumsum(axis=axis, dtype=dtype), self._ctx)
+
+    def flatten(self):
+        return self.reshape((-1,))
+
+    def ravel(self):
+        return self.reshape((-1,))
+
+    def squeeze(self, axis=None):
+        return _wrap(self._data.squeeze(axis), self._ctx)
+
+    def clip(self, a_min=None, a_max=None):
+        return _wrap(self._data.clip(a_min, a_max), self._ctx)
+
+    def round(self, decimals=0):
+        return _wrap(_jnp().round(self._data, decimals), self._ctx)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _wrap(self._data.std(axis=axis, ddof=ddof,
+                                    keepdims=keepdims), self._ctx)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _wrap(self._data.var(axis=axis, ddof=ddof,
+                                    keepdims=keepdims), self._ctx)
+
+    def dot(self, other):
+        return self._binop(other, lambda a, b: _jnp().dot(a, b))
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+
+def _unwrap_key(key):
+    """Indexing keys: mx arrays (incl. boolean masks) -> jax arrays."""
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(_unwrap_key(k) for k in key)
+    return key
+
+
+def _np_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        return _jnp().bfloat16
+    return _onp.dtype(dtype) if not hasattr(dtype, "kind") else dtype
+
+
+def array(object, dtype=None, ctx=None):
+    """Create an mx.np array (multiarray.py array)."""
+    import jax
+
+    jnp = _jnp()
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = data.astype(_np_dtype(dtype))
+        return ndarray(data, ctx)
+    data = jnp.asarray(object, dtype=_np_dtype(dtype))
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device())
+    return ndarray(data, ctx)
+
+
+def _as_np(x):
+    """NDArray -> mx.np.ndarray view (no copy)."""
+    if isinstance(x, ndarray):
+        return x
+    if isinstance(x, NDArray):
+        return ndarray(x._data, x._ctx)
+    return x

@@ -276,10 +276,9 @@ def _cumprod(a, axis=None, dtype=None):
 
 @register("dot")
 def _dot(a, b, transpose_a=False, transpose_b=False):
-    """Parity: src/operator/tensor/dot.cc — MXU-targeted matmul.
-
-    Accumulate in f32 even for bf16 inputs (preferred_element_type) so the
-    MXU's native mixed-precision path is used."""
+    """Parity: src/operator/tensor/dot.cc — MXU-targeted matmul. The MXU
+    accumulates bf16 matmuls in f32 natively; no preferred_element_type
+    (a f32-typed intermediate breaks transpose rules under bf16 AD)."""
     if transpose_a:
         a = a.T if a.ndim == 2 else jnp.moveaxis(a, 0, -1)
     if transpose_b:
@@ -287,9 +286,7 @@ def _dot(a, b, transpose_a=False, transpose_b=False):
     if a.ndim == 1 and b.ndim == 1:
         return jnp.dot(a, b)
     return jax.lax.dot_general(
-        a, b, (((a.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
-    ).astype(jnp.result_type(a.dtype, b.dtype))
+        a, b, (((a.ndim - 1,), (0,)), ((), ())))
 
 
 @register("batch_dot")

@@ -35,10 +35,11 @@ def _pair(v, n=2):
 def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
     """Parity: src/operator/nn/fully_connected-inl.h. weight: (num_hidden, in)."""
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    # no preferred_element_type: the TPU MXU accumulates bf16 matmuls in f32
+    # natively, and a f32-typed intermediate breaks jax's transpose rules
+    # under mixed bf16/f32 autodiff
     out = jax.lax.dot_general(
-        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())))
     if bias is not None and not no_bias:
         out = out + bias
     return out
@@ -66,12 +67,12 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = _pair(dilate or 1, sdims)
     pad = _pair(pad or 0, sdims)
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(data.ndim))
+    # no preferred_element_type: MXU accumulates bf16 convs in f32 natively,
+    # and the f32-typed intermediate breaks conv transpose under bf16 AD
     out = jax.lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+        dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * sdims)
     return out

@@ -140,6 +140,12 @@ def apply_op(name, *arrays, device=None, **params):
 
     if device is None or any(isinstance(a, jcore.Tracer) for a in arrays):
         return op.closed(params)(*arrays)
+    # make ctx placement real: move inputs to the requested device (no-op
+    # when already there) so the executable and its outputs land on ctx —
+    # matters when both a CPU and a TPU backend are live
+    import jax
+
+    arrays = tuple(jax.device_put(a, device) for a in arrays)
     return _eager_fn(op, params, device)(*arrays)
 
 

@@ -1,0 +1,391 @@
+"""Functional optimizers for the sharded training step.
+
+The imperative path (mxnet_tpu/optimizer/optimizer.py) mutates NDArray cells
+via the fused update kernels (ops/optimizer_ops.py — the TPU analogue of the
+reference's optimizer ops, src/operator/optimizer_op.cc). This module
+re-exposes the SAME kernels as pure ``(w, g, state, t) -> (new_w, new_state)``
+functions so the jitted mesh step can thread optimizer state functionally.
+The step counter ``t`` is a traced int32 scalar (not baked at trace time), so
+bias-corrected optimizers (adam/adamax/nadam/ftml/lamb) stay correct across
+steps of one compiled executable.
+
+Registry keyed by the same aliases as mx.optimizer.create.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_update_fn", "FUNCTIONAL_OPTIMIZERS"]
+
+FUNCTIONAL_OPTIMIZERS = {}
+
+
+def _register(*names):
+    def deco(factory):
+        for n in names:
+            FUNCTIONAL_OPTIMIZERS[n] = factory
+        return factory
+    return deco
+
+
+def _kernel(name):
+    from ..ops.registry import get_op
+
+    return get_op(name).fn
+
+
+def _hyper(kw, default_lr):
+    return {
+        "lr": kw.pop("learning_rate", default_lr),
+        "wd": kw.pop("wd", 0.0),
+        "rescale_grad": kw.pop("rescale_grad", 1.0),
+        "clip_gradient": kw.pop("clip_gradient", None),
+    }
+
+
+def _rescale_clip(g, rescale, clip):
+    g = g * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+# Each factory(optimizer_params) returns (init_one, update_one):
+#   init_one(name, w) -> per-param state pytree (tuples/arrays/()),
+#   update_one(w, g, s, t) -> (new_w, new_s); t is a traced int32 step count.
+
+@_register("sgd", "lbsgd")
+def _sgd(kw):
+    h = _hyper(kw, 0.01)
+    momentum = kw.pop("momentum", 0.0)
+    if momentum == 0.0:
+        fn = _kernel("sgd_update")
+
+        def update(w, g, s, t):
+            return fn(w, g, **h)[0], ()
+        return (lambda n, w: ()), update
+    fn = _kernel("sgd_mom_update")
+
+    def update(w, g, s, t):
+        new_w, _, new_mom = fn(w, g, s, momentum=momentum, **h)
+        return new_w, new_mom
+    return (lambda n, w: jnp.zeros_like(w)), update
+
+
+@_register("nag")
+def _nag(kw):
+    h = _hyper(kw, 0.01)
+    momentum = kw.pop("momentum", 0.0)
+    fn = _kernel("nag_mom_update")
+
+    def update(w, g, s, t):
+        new_w, _, new_mom = fn(w, g, s, momentum=momentum, **h)
+        return new_w, new_mom
+    return (lambda n, w: jnp.zeros_like(w)), update
+
+
+@_register("adam")
+def _adam(kw):
+    h = _hyper(kw, 0.001)
+    beta1 = kw.pop("beta1", 0.9)
+    beta2 = kw.pop("beta2", 0.999)
+    epsilon = kw.pop("epsilon", 1e-8)
+    fn = _kernel("adam_update")
+    base_lr = h.pop("lr")
+
+    def update(w, g, s, t):
+        m, v = s
+        # bias correction folded into lr, with traced t (reference
+        # optimizer.py Adam.update does this on the host per call)
+        lr_t = base_lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+        new_w, _, nm, nv = fn(w, g, m, v, lr=lr_t, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, **h)
+        return new_w, (nm, nv)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("adamw")
+def _adamw(kw):
+    h = _hyper(kw, 0.001)
+    beta1 = kw.pop("beta1", 0.9)
+    beta2 = kw.pop("beta2", 0.999)
+    epsilon = kw.pop("epsilon", 1e-8)
+    eta = kw.pop("eta", 1.0)
+    fn = _kernel("adamw_update")
+
+    def update(w, g, s, t):
+        m, v = s
+        new_w, _, nm, nv = fn(w, g, m, v, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, eta=eta, **h)
+        return new_w, (nm, nv)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("ftrl")
+def _ftrl(kw):
+    h = _hyper(kw, 0.1)
+    lamda1 = kw.pop("lamda1", 0.01)
+    beta = kw.pop("beta", 1.0)
+    fn = _kernel("ftrl_update")
+
+    def update(w, g, s, t):
+        z, nacc = s
+        new_w, _, nz, nn = fn(w, g, z, nacc, lamda1=lamda1, beta=beta, **h)
+        return new_w, (nz, nn)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("rmsprop")
+def _rmsprop(kw):
+    h = _hyper(kw, 0.001)
+    gamma1 = kw.pop("gamma1", 0.9)
+    gamma2 = kw.pop("gamma2", 0.9)
+    epsilon = kw.pop("epsilon", 1e-8)
+    centered = kw.pop("centered", False)
+    if not centered:
+        fn = _kernel("rmsprop_update")
+
+        def update(w, g, s, t):
+            new_w, _, nn = fn(w, g, s, gamma1=gamma1, epsilon=epsilon, **h)
+            return new_w, nn
+        return (lambda n, w: jnp.zeros_like(w)), update
+    fn = _kernel("rmspropalex_update")
+
+    def update(w, g, s, t):
+        nacc, gavg, delta = s
+        new_w, _, nn, ng, nd = fn(w, g, nacc, gavg, delta, gamma1=gamma1,
+                                  gamma2=gamma2, epsilon=epsilon, **h)
+        return new_w, (nn, ng, nd)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w),
+                          jnp.zeros_like(w))), update
+
+
+@_register("adagrad")
+def _adagrad(kw):
+    h = _hyper(kw, 0.01)
+    eps = kw.pop("eps", 1e-7)
+
+    def update(w, g, s, t):
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        new_h = s + jnp.square(g)
+        new_w = w - h["lr"] * g / (jnp.sqrt(new_h) + eps)
+        return new_w, new_h
+    return (lambda n, w: jnp.zeros_like(w)), update
+
+
+@_register("adadelta")
+def _adadelta(kw):
+    h = _hyper(kw, 1.0)
+    rho = kw.pop("rho", 0.9)
+    epsilon = kw.pop("epsilon", 1e-5)
+
+    def update(w, g, s, t):
+        acc_g, acc_d = s
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_d + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+        new_acc_d = rho * acc_d + (1 - rho) * jnp.square(delta)
+        return w - h["lr"] * delta, (new_acc_g, new_acc_d)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("adamax")
+def _adamax(kw):
+    h = _hyper(kw, 0.002)
+    beta1 = kw.pop("beta1", 0.9)
+    beta2 = kw.pop("beta2", 0.999)
+
+    def update(w, g, s, t):
+        m, u = s
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        nm = beta1 * m + (1 - beta1) * g
+        nu = jnp.maximum(beta2 * u, jnp.abs(g))
+        lr_t = h["lr"] / (1 - beta1 ** t)
+        return w - lr_t * nm / (nu + 1e-8), (nm, nu)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("nadam")
+def _nadam(kw):
+    h = _hyper(kw, 0.001)
+    beta1 = kw.pop("beta1", 0.9)
+    beta2 = kw.pop("beta2", 0.999)
+    epsilon = kw.pop("epsilon", 1e-8)
+    schedule_decay = kw.pop("schedule_decay", 0.004)
+
+    def momentum_t(t):
+        return beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+
+    def update(w, g, s, t):
+        m, v, m_sched = s
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        mt = momentum_t(t)
+        mt1 = momentum_t(t + 1)
+        new_sched = m_sched * mt
+        g_prime = g / (1 - new_sched)
+        nm = beta1 * m + (1 - beta1) * g
+        nv = beta2 * v + (1 - beta2) * jnp.square(g)
+        m_prime = nm / (1 - new_sched * mt1)
+        v_prime = nv / (1 - beta2 ** t)
+        m_bar = (1 - mt) * g_prime + mt1 * m_prime
+        new_w = w - h["lr"] * m_bar / (jnp.sqrt(v_prime) + epsilon)
+        return new_w, (nm, nv, new_sched)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w),
+                          jnp.ones((), w.dtype))), update
+
+
+@_register("ftml")
+def _ftml(kw):
+    h = _hyper(kw, 0.0025)
+    beta1 = kw.pop("beta1", 0.6)
+    beta2 = kw.pop("beta2", 0.999)
+    epsilon = kw.pop("epsilon", 1e-8)
+
+    def update(w, g, s, t):
+        d, v, z = s
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        nv = beta2 * v + (1 - beta2) * jnp.square(g)
+        d_t = (1 - beta1 ** t) / h["lr"] * (
+            jnp.sqrt(nv / (1 - beta2 ** t)) + epsilon)
+        sigma = d_t - beta1 * d
+        nz = beta1 * z + (1 - beta1) * g - sigma * w
+        return -nz / d_t, (d_t, nv, nz)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w),
+                          jnp.zeros_like(w))), update
+
+
+@_register("signum")
+def _signum(kw):
+    h = _hyper(kw, 0.01)
+    momentum = kw.pop("momentum", 0.9)
+    wd_lh = kw.pop("wd_lh", 0.0)
+    if momentum == 0.0:
+        fn = _kernel("signsgd_update")
+
+        def update(w, g, s, t):
+            return fn(w, g, **h)[0], ()
+        return (lambda n, w: ()), update
+    fn = _kernel("signum_update")
+
+    def update(w, g, s, t):
+        new_w, _, nm = fn(w, g, s, momentum=momentum, wd_lh=wd_lh, **h)
+        return new_w, nm
+    return (lambda n, w: jnp.zeros_like(w)), update
+
+
+@_register("lamb")
+def _lamb(kw):
+    h = _hyper(kw, 0.001)
+    beta1 = kw.pop("beta1", 0.9)
+    beta2 = kw.pop("beta2", 0.999)
+    epsilon = kw.pop("epsilon", 1e-6)
+    lower_bound = kw.pop("lower_bound", -1.0)
+    upper_bound = kw.pop("upper_bound", -1.0)
+    bias_correction = kw.pop("bias_correction", True)
+    p1 = _kernel("lamb_update_phase1")
+    p2 = _kernel("lamb_update_phase2")
+    lr = h.pop("lr")
+
+    def update(w, g, s, t):
+        m, v = s
+        gu = p1(w, g, m, v, beta1=beta1, beta2=beta2, epsilon=epsilon, t=t,
+                bias_correction=bias_correction, **h)
+        nm = beta1 * m + (1 - beta1) * _rescale_clip(
+            g, h["rescale_grad"], h["clip_gradient"])
+        nv = beta2 * v + (1 - beta2) * jnp.square(_rescale_clip(
+            g, h["rescale_grad"], h["clip_gradient"]))
+        r1 = jnp.linalg.norm(w).reshape((1,))
+        r2 = jnp.linalg.norm(gu).reshape((1,))
+        new_w = p2(w, gu, r1, r2, lr=lr, lower_bound=lower_bound,
+                   upper_bound=upper_bound)[0]
+        return new_w, (nm, nv)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.zeros_like(w))), update
+
+
+@_register("lars")
+def _lars(kw):
+    h = _hyper(kw, 0.1)
+    momentum = kw.pop("momentum", 0.9)
+    eta = kw.pop("eta", 0.001)
+    epsilon = kw.pop("epsilon", 1e-8)
+
+    def update(w, g, s, t):
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            eta * w_norm / (g_norm + h["wd"] * w_norm + epsilon), 1.0)
+        lr_layer = h["lr"] * trust
+        new_mom = momentum * s + lr_layer * (g + h["wd"] * w)
+        return w - new_mom, new_mom
+    return (lambda n, w: jnp.zeros_like(w)), update
+
+
+@_register("dcasgd")
+def _dcasgd(kw):
+    h = _hyper(kw, 0.1)
+    momentum = kw.pop("momentum", 0.0)
+    lamda = kw.pop("lamda", 0.04)
+
+    def update(w, g, s, t):
+        mom, prev_w = s
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        comp = g + lamda * g * g * (w - prev_w)
+        new_mom = momentum * mom - h["lr"] * comp
+        new_w = w + new_mom
+        return new_w, (new_mom, new_w)
+    return (lambda n, w: (jnp.zeros_like(w), jnp.array(w))), update
+
+
+@_register("sgld")
+def _sgld(kw):
+    h = _hyper(kw, 0.01)
+
+    def init(name, w):
+        # per-param langevin noise stream; deterministic in the param name
+        seed = abs(hash(name)) % (2 ** 31 - 1)
+        return jax.random.PRNGKey(seed)
+
+    def update(w, g, s, t):
+        key, sub = jax.random.split(s)
+        g = _rescale_clip(g, h["rescale_grad"], h["clip_gradient"])
+        g = g + h["wd"] * w
+        noise = jax.random.normal(sub, w.shape, w.dtype) * jnp.sqrt(h["lr"])
+        return w - 0.5 * h["lr"] * g + noise, key
+    return init, update
+
+
+def make_update_fn(optimizer="sgd", optimizer_params=None):
+    """Build ``(init, update)`` for a whole param dict.
+
+    init(params) -> opt_state (includes the traced step counter)
+    update(params, grads, opt_state) -> (new_params, new_opt_state)
+    """
+    factory = FUNCTIONAL_OPTIMIZERS.get(optimizer)
+    if factory is None:
+        raise ValueError(
+            f"unsupported sharded optimizer '{optimizer}'; functional "
+            f"registry has: {sorted(FUNCTIONAL_OPTIMIZERS)}")
+    init_one, update_one = factory(dict(optimizer_params or {}))
+
+    def init(params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "state": {k: init_one(k, v) for k, v in params.items()}}
+
+    def update(params, grads, opt_state):
+        t = opt_state["t"] + 1
+        new_p, new_s = {}, {}
+        for k in params:
+            new_p[k], new_s[k] = update_one(
+                params[k], grads[k], opt_state["state"][k], t)
+        return new_p, {"t": t, "state": new_s}
+
+    return init, update

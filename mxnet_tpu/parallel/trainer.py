@@ -13,75 +13,11 @@ from __future__ import annotations
 
 import re
 
-from .functional import functional_call, param_arrays, aux_arrays
+from .functional import functional_call, param_arrays, aux_arrays, RNG_KEY
 from .mesh import create_mesh
+from .optim import make_update_fn
 
-__all__ = ["ShardedTrainer", "sgd_init", "make_update_fn"]
-
-
-def _tree_map(f, *trees):
-    return {k: f(*(t[k] for t in trees)) for k in trees[0]}
-
-
-def sgd_init(params):
-    return {k: None for k in params}
-
-
-def make_update_fn(optimizer="sgd", optimizer_params=None):
-    """Functional optimizer update built from the registered fused update
-    ops (ops/optimizer_ops.py — same kernels the imperative path uses)."""
-    import jax.numpy as jnp
-
-    from ..ops.registry import get_op
-
-    kw = dict(optimizer_params or {})
-    lr = kw.pop("learning_rate", 0.01)
-    wd = kw.pop("wd", 0.0)
-    momentum = kw.pop("momentum", 0.0)
-    rescale = kw.pop("rescale_grad", 1.0)
-    clip = kw.pop("clip_gradient", None)
-
-    if optimizer == "sgd" and momentum == 0.0:
-        fn = get_op("sgd_update").fn
-
-        def init(params):
-            return {k: () for k in params}
-
-        def update(w, g, s):
-            new_w = fn(w, g, lr=lr, wd=wd, rescale_grad=rescale,
-                       clip_gradient=clip)[0]
-            return new_w, ()
-    elif optimizer == "sgd":
-        fn = get_op("sgd_mom_update").fn
-
-        def init(params):
-            return {k: jnp.zeros_like(v) for k, v in params.items()}
-
-        def update(w, g, s):
-            new_w, _, new_mom = fn(w, g, s, lr=lr, momentum=momentum, wd=wd,
-                                   rescale_grad=rescale, clip_gradient=clip)
-            return new_w, new_mom
-    elif optimizer == "adam":
-        fn = get_op("adam_update").fn
-        beta1 = kw.pop("beta1", 0.9)
-        beta2 = kw.pop("beta2", 0.999)
-        epsilon = kw.pop("epsilon", 1e-8)
-
-        def init(params):
-            return {k: (jnp.zeros_like(v), jnp.zeros_like(v))
-                    for k, v in params.items()}
-
-        def update(w, g, s):
-            m, v = s
-            new_w, _, new_m, new_v = fn(w, g, m, v, lr=lr, beta1=beta1,
-                                        beta2=beta2, epsilon=epsilon, wd=wd,
-                                        rescale_grad=rescale,
-                                        clip_gradient=clip)
-            return new_w, (new_m, new_v)
-    else:
-        raise ValueError(f"unsupported sharded optimizer '{optimizer}' "
-                         "(sgd / adam; extend make_update_fn)")
-    return init, update
+__all__ = ["ShardedTrainer", "make_update_fn"]
 
 
 class ShardedTrainer:
@@ -97,10 +33,15 @@ class ShardedTrainer:
         unmatched params are replicated. This is where tp/pp/ep shardings
         plug in.
     batch_axis_name : mesh axis the batch dimension is sharded over.
+    dtype : compute dtype policy. None = model dtype (fp32). 'bfloat16'
+        (or 'float16') casts params/activations for forward+backward —
+        fp32 master weights and optimizer state, bf16 MXU math — the TPU
+        counterpart of the reference's AMP (contrib/amp/amp.py:251).
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, param_rules=(), batch_axis_name="dp"):
+                 mesh=None, param_rules=(), batch_axis_name="dp",
+                 dtype=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -110,6 +51,7 @@ class ShardedTrainer:
         self._fwd = functional_call(net, train=True)
         self.params = param_arrays(net)
         self.aux = aux_arrays(net)
+        self._compute_dtype = dtype
         init, update = make_update_fn(optimizer, optimizer_params)
         self.opt_state = init(self.params)
         self._update = update
@@ -132,27 +74,57 @@ class ShardedTrainer:
 
     def _place(self):
         import jax
+        import jax.numpy as jnp
 
-        self.params = {k: jax.device_put(v, self._param_sharding[k])
+        def put(v, sharding):
+            # device_put may alias the input buffer when placement already
+            # matches; always copy so step donation never deletes a buffer
+            # the net (or another trainer) still references. Init-only cost.
+            return jax.device_put(jnp.array(v, copy=True), sharding)
+
+        self.params = {k: put(v, self._param_sharding[k])
                        for k, v in self.params.items()}
-        self.aux = {k: jax.device_put(v, self._aux_sharding[k])
+        self.aux = {k: put(v, self._aux_sharding[k])
                     for k, v in self.aux.items()}
-        self.opt_state = jax.tree.map(
-            lambda v: jax.device_put(v, jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec())), self.opt_state)
+        repl = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec())
+        self.opt_state = jax.tree.map(lambda v: put(v, repl), self.opt_state)
 
     def _build_step(self):
         import jax
+        import jax.numpy as jnp
 
         fwd = self._fwd
         loss_fn = self.loss_fn
         update = self._update
+        cdtype = self._compute_dtype
 
         from ..ndarray.ndarray import NDArray
         from ..jit import TraceSession
 
+        def cast_in(tree):
+            if cdtype is None:
+                return tree
+            return {k: (v.astype(cdtype)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in tree.items()}
+
         def compute_loss(params, aux, x, y):
-            out, new_aux = fwd(params, aux, x)
+            # AMP policy: bf16 params/activations in fwd+bwd; the cast sits
+            # inside the grad so gradients land back in fp32 master dtype
+            cp = cast_in(params)
+            ca = cast_in(aux)
+            if cdtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                x_c = x.astype(cdtype)
+            else:
+                x_c = x
+            out, new_aux = fwd(cp, ca, x_c)
+            if cdtype is not None:
+                out = out.astype(jnp.float32)
+                new_aux = {k: (v.astype(aux[k].dtype)
+                               if jnp.issubdtype(aux[k].dtype, jnp.floating)
+                               else v)
+                           for k, v in new_aux.items()}
             with TraceSession() as sess:
                 out_nd, y_nd = NDArray(out), NDArray(y)
                 sess.note_created(out_nd)
@@ -163,10 +135,7 @@ class ShardedTrainer:
         def step(params, aux, opt_state, x, y):
             (loss, new_aux), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params, aux, x, y)
-            new_params, new_opt = {}, {}
-            for k in params:
-                new_params[k], new_opt[k] = update(
-                    params[k], grads[k], opt_state[k])
+            new_params, new_opt = update(params, grads, opt_state)
             return new_params, new_aux, new_opt, loss
 
         out_shardings = (self._param_sharding, self._aux_sharding,
@@ -197,9 +166,22 @@ class ShardedTrainer:
         return loss
 
     def sync_to_net(self):
-        """Write the sharded parameter state back into the gluon net."""
+        """Write the sharded parameter state back into the gluon net
+        (collapsed to one device so eager ops keep working)."""
+        import jax
+
+        from .functional import RNG_KEY
+        from .. import random as _random
+
+        dev = self.mesh.devices.flat[0]
+
+        def fetch(v):
+            return jax.device_put(v, dev)
+
         for name, p in self.net.collect_params().items():
             if name in self.params:
-                p.data()._set_data(self.params[name])
+                p.data()._set_data(fetch(self.params[name]))
             elif name in self.aux:
-                p.data()._set_data(self.aux[name])
+                p.data()._set_data(fetch(self.aux[name]))
+        if RNG_KEY in self.aux:
+            _random.generator_key()._set_data(fetch(self.aux[RNG_KEY]))

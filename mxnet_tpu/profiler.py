@@ -1,0 +1,206 @@
+"""Profiler frontend.
+
+Parity: python/mxnet/profiler.py (set_config :33, set_state :89, dump/dumps
+:151, pause/resume :193-209) over src/profiler/profiler.h:251. TPU-native:
+events come from the XLA/jax profiler (xplane traces viewable in
+TensorBoard/Perfetto — the modern analogue of the reference's
+chrome://tracing JSON dump), plus lightweight host-side scopes/counters kept
+in-process for `dumps()` aggregate tables.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "profiler_set_config", "profiler_set_state", "Task",
+           "Frame", "Event", "Counter", "Marker", "scope"]
+
+_LOCK = threading.Lock()
+_CONFIG = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": True, "profile_api": True,
+           "aggregate_stats": True}
+_STATE = "stop"
+_TRACE_DIR = None
+_EVENTS = []          # host-side (name, start, dur) events
+_COUNTERS = {}
+_PAUSED = False
+
+
+def set_config(**kwargs):
+    """Configure the profiler (profiler.py:33). ``filename`` names the
+    output; everything else toggles collection categories."""
+    _CONFIG.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts the jax trace collector, 'stop' ends it
+    (profiler.py:89)."""
+    global _STATE, _TRACE_DIR
+    import jax
+
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    with _LOCK:
+        if state == "run" and _STATE == "stop":
+            _TRACE_DIR = _CONFIG.get("trace_dir") or os.path.join(
+                os.path.dirname(os.path.abspath(
+                    _CONFIG.get("filename", "profile.json"))) or ".",
+                "jax-trace")
+            try:
+                jax.profiler.start_trace(_TRACE_DIR)
+            except Exception:
+                _TRACE_DIR = None  # tracing unsupported on this backend
+        elif state == "stop" and _STATE == "run":
+            if _TRACE_DIR is not None:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+        _STATE = state
+
+
+profiler_set_state = set_state
+
+
+def state():
+    return _STATE
+
+
+def pause(profile_process="worker"):
+    """Suspend host-side event collection (profiler.py:193)."""
+    global _PAUSED
+    _PAUSED = True
+
+
+def resume(profile_process="worker"):
+    global _PAUSED
+    _PAUSED = False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write collected host events as chrome://tracing JSON to
+    ``filename`` (the xplane trace from set_state lands in trace_dir)."""
+    events = []
+    with _LOCK:
+        for name, t0, dur, cat in _EVENTS:
+            events.append({"name": name, "cat": cat, "ph": "X",
+                           "ts": t0 * 1e6, "dur": dur * 1e6,
+                           "pid": 0, "tid": 0})
+        for name, value in _COUNTERS.items():
+            events.append({"name": name, "ph": "C", "ts": time.time() * 1e6,
+                           "pid": 0, "args": {name: value}})
+    with open(_CONFIG.get("filename", "profile.json"), "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats as a printable table (profiler.py:151)."""
+    with _LOCK:
+        agg = {}
+        for name, _, dur, _cat in _EVENTS:
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + dur, cnt + 1)
+        if reset:
+            _EVENTS.clear()
+    rows = sorted(agg.items(), key=lambda kv: kv[1][0], reverse=not ascending)
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (tot, cnt) in rows:
+        lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}"
+                     f"{tot / cnt * 1e3:>12.3f}")
+    return "\n".join(lines)
+
+
+class _Record:
+    """Common base for profiler objects (Task/Frame/Event — profiler.py)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None or _PAUSED:
+            return
+        dur = time.perf_counter() - self._t0
+        with _LOCK:
+            _EVENTS.append((self.name, self._t0, dur,
+                            type(self).__name__.lower()))
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Record):
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Frame(_Record):
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Event(_Record):
+    pass
+
+
+class Marker:
+    """Instant marker (profiler.py Marker.mark)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _LOCK:
+            _EVENTS.append((self.name, time.perf_counter(), 0.0, "marker"))
+
+
+class Counter:
+    """Named counter (profiler.py Counter)."""
+
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        set_value = value
+        with _LOCK:
+            _COUNTERS[name] = set_value
+
+    def set_value(self, value):
+        with _LOCK:
+            _COUNTERS[self.name] = value
+
+    def increment(self, delta=1):
+        with _LOCK:
+            _COUNTERS[self.name] = _COUNTERS.get(self.name, 0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+
+@contextlib.contextmanager
+def scope(name="<unk>:", append_mode=False):
+    """Profiler scope annotating jax ops (maps to jax named_scope so device
+    events in the xplane trace carry the name)."""
+    import jax
+
+    ev = Event(name)
+    ev.start()
+    try:
+        with jax.named_scope(name.rstrip(":")):
+            yield
+    finally:
+        ev.stop()

@@ -44,10 +44,20 @@ def seed(seed_state, ctx="all"):
         _KEY._set_data(raw)
 
 
-def _invoke(opname, *arrays, **kw):
+def _invoke(opname, *arrays, ctx=None, out=None, **kw):
+    """Dispatch a sampling op placed on ``ctx`` (or ``out``'s context, or the
+    current context) — NOT on the key cell's device, which is wherever the
+    previous sample ran."""
+    from .context import current_context
     from .ndarray.ndarray import imperative_invoke
 
-    return imperative_invoke(opname, *arrays, **kw)[0]
+    if ctx is None:
+        ctx = out.context if out is not None else current_context()
+    r = imperative_invoke(opname, *arrays, ctx=ctx, **kw)[0]
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
 
 
 def _shape(shape):
@@ -61,13 +71,10 @@ def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
 
     if isinstance(low, NDArray):
         return _invoke("_sample_uniform", low, high, _key_cell(),
-                       shape=_shape(shape), dtype=dtype)
-    r = _invoke("_random_uniform", _key_cell(), shape=_shape(shape),
-                dtype=str(dtype), low=float(low), high=float(high))
-    if out is not None:
-        out._set_data(r._data)
-        return out
-    return r
+                       shape=_shape(shape), dtype=dtype, ctx=ctx)
+    return _invoke("_random_uniform", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), low=float(low), high=float(high),
+                   ctx=ctx, out=out)
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
@@ -75,13 +82,10 @@ def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
 
     if isinstance(loc, NDArray):
         return _invoke("_sample_normal", loc, scale, _key_cell(),
-                       shape=_shape(shape), dtype=dtype)
-    r = _invoke("_random_normal", _key_cell(), shape=_shape(shape),
-                dtype=str(dtype), loc=float(loc), scale=float(scale))
-    if out is not None:
-        out._set_data(r._data)
-        return out
-    return r
+                       shape=_shape(shape), dtype=dtype, ctx=ctx)
+    return _invoke("_random_normal", _key_cell(), shape=_shape(shape),
+                   dtype=str(dtype), loc=float(loc), scale=float(scale),
+                   ctx=ctx, out=out)
 
 
 def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
@@ -90,7 +94,7 @@ def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
 
 def randint(low, high, shape=None, dtype="int32", ctx=None):
     return _invoke("_random_randint", _key_cell(), shape=_shape(shape),
-                   dtype=str(dtype), low=int(low), high=int(high))
+                   dtype=str(dtype), low=int(low), high=int(high), ctx=ctx)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
@@ -98,24 +102,25 @@ def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
 
     if isinstance(alpha, NDArray):
         return _invoke("_sample_gamma", alpha, beta, _key_cell(),
-                       shape=_shape(shape), dtype=dtype)
+                       shape=_shape(shape), dtype=dtype, ctx=ctx)
     return _invoke("_random_gamma", _key_cell(), shape=_shape(shape),
-                   dtype=str(dtype), alpha=float(alpha), beta=float(beta))
+                   dtype=str(dtype), alpha=float(alpha), beta=float(beta),
+                   ctx=ctx)
 
 
 def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
     return _invoke("_random_exponential", _key_cell(), shape=_shape(shape),
-                   dtype=str(dtype), lam=1.0 / float(scale))
+                   dtype=str(dtype), lam=1.0 / float(scale), ctx=ctx)
 
 
 def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
     return _invoke("_random_poisson", _key_cell(), shape=_shape(shape),
-                   dtype=str(dtype), lam=float(lam))
+                   dtype=str(dtype), lam=float(lam), ctx=ctx)
 
 
 def bernoulli(p=0.5, shape=None, dtype="float32", ctx=None):
     return _invoke("_random_bernoulli", _key_cell(), shape=_shape(shape),
-                   dtype=str(dtype), p=float(p))
+                   dtype=str(dtype), p=float(p), ctx=ctx)
 
 
 def multinomial(data, shape=None, get_prob=False, dtype="int32"):
@@ -124,8 +129,4 @@ def multinomial(data, shape=None, get_prob=False, dtype="int32"):
 
 
 def shuffle(data, out=None):
-    r = _invoke("_shuffle", data, _key_cell())
-    if out is not None:
-        out._set_data(r._data)
-        return out
-    return r
+    return _invoke("_shuffle", data, _key_cell(), ctx=data.context, out=out)

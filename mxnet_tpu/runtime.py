@@ -1,0 +1,82 @@
+"""Runtime feature detection.
+
+Parity: python/mxnet/runtime.py (feature_list/Features over src/libinfo.cc).
+TPU-native: features reflect the live JAX/PJRT environment instead of
+compile-time cmake flags — the build has no compile-time variants, so the
+flags describe which backends/capabilities this process can actually use.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+Feature = collections.namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    import jax
+
+    feats = {}
+
+    def add(name, enabled):
+        feats[name] = Feature(name, bool(enabled))
+
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        platforms = set()
+    add("TPU", any(p not in ("cpu",) for p in platforms))
+    add("CPU", True)
+    add("CUDA", False)
+    add("CUDNN", False)
+    add("MKLDNN", False)
+    add("OPENCV", _has("PIL"))
+    add("BLAS_OPEN", True)          # XLA's dot lowering
+    add("LAPACK", True)             # jnp.linalg
+    add("F16C", True)               # bf16/f16 casts are native
+    add("JIT", True)                # XLA jit
+    add("PALLAS", _has("jax.experimental.pallas"))
+    add("DIST_KVSTORE", True)       # kvstore + jax.distributed bootstrap
+    add("INT64_TENSOR_SIZE", False)  # x64 disabled by default
+    add("SIGNAL_HANDLER", True)
+    add("PROFILER", True)           # mx.profiler over jax.profiler
+    return feats
+
+
+def _has(mod):
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec(mod) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+class Features(dict):
+    """Mapping name -> Feature, like the reference's Features (runtime.py)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def __repr__(self):
+        return "[" + ", ".join(
+            f"{f.name}{'' if f.enabled else ' (disabled)'}"
+            for f in self.values()) + "]"
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"feature '{feature_name}' does not exist")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    """Check the library for compile-time/runtime features.
+
+    Returns a list of Feature objects (parity: runtime.py feature_list)."""
+    if Features.instance is None:
+        Features.instance = Features()
+    return list(Features.instance.values())

@@ -546,7 +546,7 @@ def _array_param_names(op):
             return names, True
         if p.default is p.empty or p.name in ("bias", "state_cell", "rng_key",
                                               "sequence_length", "like"):
-            if p.kind == p.POSITIONAL_OR_KEYWORD:
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
                 names.append(p.name)
         else:
             break

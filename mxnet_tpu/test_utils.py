@@ -1,0 +1,351 @@
+"""Testing utilities — THE parity-acceptance harness.
+
+Parity: python/mxnet/test_utils.py — assert_almost_equal (:534),
+check_numeric_gradient (:981, finite differences vs the autograd/backward
+gradients), check_symbolic_forward/backward (:1124, :1205), and
+check_consistency (:1422, one symbol run on several ctx/dtype combos and
+cross-compared — the reference's cpu-vs-gpu acceptance mechanism, used here
+as cpu-vs-tpu and fp32-vs-bf16).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "random_arrays",
+           "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+           "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "simple_forward",
+           "assert_exception", "numeric_grad", "default_rtol_atol",
+           "effective_dtype"]
+
+_DEFAULT_CTX = None
+
+
+def default_context():
+    return _DEFAULT_CTX if _DEFAULT_CTX is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def effective_dtype(data):
+    """bf16 arrays compare at bf16 tolerance even when materialized as f32."""
+    d = _as_np(data)
+    return d.dtype
+
+
+_DTYPE_TOL = {
+    np.dtype(np.float16): (1e-2, 1e-4),
+    np.dtype(np.float32): (1e-4, 1e-6),
+    np.dtype(np.float64): (1e-7, 1e-9),
+}
+
+
+def default_rtol_atol(*arrays):
+    rtols, atols = zip(*[_DTYPE_TOL.get(np.dtype(effective_dtype(a)),
+                                        (1e-2, 1e-4)) for a in arrays])
+    return max(rtols), max(atols)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    if rtol is None or atol is None:
+        drtol, datol = default_rtol_atol(a, b)
+        rtol = rtol if rtol is not None else drtol
+        atol = atol if atol is not None else datol
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Parity: test_utils.py:534 — tolerance defaults derived from dtype."""
+    a_np, b_np = _as_np(a), _as_np(b)
+    if rtol is None or atol is None:
+        drtol, datol = default_rtol_atol(a_np, b_np)
+        rtol = rtol if rtol is not None else drtol
+        atol = atol if atol is not None else datol
+    if a_np.shape != b_np.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{a_np.shape} vs {names[1]}{b_np.shape}")
+    if np.allclose(a_np.astype(np.float64) if a_np.dtype.kind == "f" else a_np,
+                   b_np.astype(np.float64) if b_np.dtype.kind == "f" else b_np,
+                   rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    with np.errstate(invalid="ignore", divide="ignore"):
+        denom = np.maximum(np.abs(a_np) + np.abs(b_np), atol)
+        rel = np.abs(a_np.astype(np.float64) - b_np.astype(np.float64)) / denom
+    idx = np.unravel_index(np.argmax(rel), rel.shape) if rel.size else ()
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ (rtol={rtol}, atol={atol}): "
+        f"max rel err {rel.max() if rel.size else 'n/a'} at {idx}: "
+        f"{a_np[idx] if rel.size else a_np} vs {b_np[idx] if rel.size else b_np}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, ctx=None, dtype="float32"):
+    """Parity: test_utils.py:377 (dense only; sparse is out of scope v1)."""
+    return nd.array(np.random.uniform(-1, 1, size=shape).astype(dtype),
+                    ctx=ctx or default_context())
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype(np.float32) if s else
+              np.float32(np.random.randn()) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind a symbol with the given inputs and run one forward."""
+    ctx = ctx or default_context()
+    shapes = {k: v.shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx=ctx, **shapes)
+    for k, v in inputs.items():
+        exe.arg_dict[k][:] = v
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _bind(sym, ctx, location, aux_states, grad_req="write"):
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    loc_nd = {k: (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+              for k, v in location.items()}
+    aux_nd = None
+    if aux_states is not None:
+        aux_names = sym.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        aux_nd = {k: (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+                  for k, v in aux_states.items()}
+    grads = {k: nd.zeros(v.shape, ctx=ctx, dtype=v.dtype)
+             for k, v in loc_nd.items()} if grad_req != "null" else None
+    exe = sym.bind(ctx=ctx, args=loc_nd, args_grad=grads,
+                   grad_req=grad_req, aux_states=aux_nd)
+    return exe, loc_nd
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences over an executor's inputs
+    (parity: test_utils.py numeric_grad)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().astype(np.float64)
+        g = np.zeros_like(base)
+        flat = base.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps / 2
+            executor.arg_dict[name][:] = base.astype(arr.dtype)
+            out_p = executor.forward(is_train=use_forward_train)[0].asnumpy()
+            flat[i] = orig - eps / 2
+            executor.arg_dict[name][:] = base.astype(arr.dtype)
+            out_m = executor.forward(is_train=use_forward_train)[0].asnumpy()
+            flat[i] = orig
+            executor.arg_dict[name][:] = base.astype(arr.dtype)
+            gflat[i] = (out_p.astype(np.float64).sum()
+                        - out_m.astype(np.float64).sum()) / eps
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=None, atol=None, grad_nodes=None, ctx=None):
+    """Finite-difference gradient check against the executor's backward
+    (parity: test_utils.py:981). Sums outputs to a scalar objective, so the
+    analytic gradient is backward with all-ones head grads."""
+    ctx = ctx or default_context()
+    rtol = 1e-2 if rtol is None else rtol
+    atol = 1e-4 if atol is None else atol
+    exe, loc_nd = _bind(sym, ctx, location, aux_states)
+    outs = exe.forward(is_train=True)
+    head_grads = [nd.ones(o.shape, ctx=ctx, dtype=o.dtype) for o in outs]
+    exe.backward(head_grads)
+    analytic = {k: g.asnumpy() for k, g in
+                zip(sym.list_arguments(), exe.grad_arrays) if g is not None}
+    numeric = numeric_grad(exe, loc_nd, aux_states, eps=numeric_eps)
+    names = grad_nodes if grad_nodes is not None else list(loc_nd)
+    for name in names:
+        if name not in analytic:
+            continue
+        assert_almost_equal(analytic[name], numeric[name], rtol=rtol,
+                            atol=atol,
+                            names=(f"analytic d{name}", f"numeric d{name}"))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=None, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False):
+    """Forward outputs vs expected numpy arrays (test_utils.py:1124)."""
+    ctx = ctx or default_context()
+    exe, _ = _bind(sym, ctx, location, aux_states, grad_req="null")
+    outs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"),
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=None,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Backward grads vs expected numpy arrays (test_utils.py:1205)."""
+    ctx = ctx or default_context()
+    exe, _ = _bind(sym, ctx, location, aux_states, grad_req=grad_req)
+    exe.forward(is_train=True)
+    og = [g if isinstance(g, NDArray) else nd.array(g, ctx=ctx)
+          for g in (out_grads if isinstance(out_grads, (list, tuple))
+                    else [out_grads])]
+    exe.backward(og)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    got = dict(zip(sym.list_arguments(), exe.grad_arrays))
+    for name, e in expected.items():
+        if e is None:
+            continue
+        assert_almost_equal(got[name], e, rtol=rtol, atol=atol,
+                            names=(f"d{name}", f"expected d{name}"))
+    return {k: (v.asnumpy() if v is not None else None)
+            for k, v in got.items()}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, rtol=None, atol=None,
+                      raise_on_err=True, use_uniform=False):
+    """Run one symbol on several ctx/dtype combos and cross-compare outputs
+    and gradients (parity: test_utils.py:1422 — the cpu-vs-gpu, here
+    cpu-vs-tpu / fp32-vs-bf16, acceptance mechanism).
+
+    ctx_list: list of dicts like {'ctx': mx.cpu(), 'type_dict':
+    {'data': np.float32}, <input shapes as kwargs>}.
+    """
+    assert len(ctx_list) > 1, "need at least two contexts to compare"
+    tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+           np.dtype(np.float64): 1e-5}
+
+    arg_names = sym.list_arguments()
+    output_names = sym.list_outputs()
+    aux_names = sym.list_auxiliary_states()
+
+    # generate inputs at the highest precision, share across all runs
+    spec0 = dict(ctx_list[0])
+    spec0.pop("ctx"); spec0.pop("type_dict", None)
+    shapes = spec0
+    rng = np.random
+    base_inputs = {}
+    for name in arg_names:
+        if name in shapes:
+            base_inputs[name] = (
+                rng.uniform(size=shapes[name]) * scale if use_uniform
+                else rng.normal(size=shapes[name]) * scale)
+    if arg_params:
+        base_inputs.update({k: np.asarray(v) for k, v in arg_params.items()})
+    else:
+        # parameters too (anything not an explicit input shape): infer
+        inferred, _, aux_shapes = sym.infer_shape(**shapes)
+        for name, shp in zip(arg_names, inferred):
+            if name not in base_inputs:
+                base_inputs[name] = rng.normal(size=shp) * scale
+    _, _, aux_shapes = sym.infer_shape(**shapes)
+    base_aux = {}
+    if aux_params:
+        base_aux = {k: np.asarray(v) for k, v in aux_params.items()}
+    else:
+        for name, shp in zip(aux_names, aux_shapes):
+            base_aux[name] = np.zeros(shp)
+
+    results = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        type_dict = spec.pop("type_dict", {})
+        dtype = np.dtype(list(type_dict.values())[0]) if type_dict \
+            else np.dtype(np.float32)
+        loc = {k: v.astype(dtype) for k, v in base_inputs.items()}
+        aux = {k: v.astype(dtype) for k, v in base_aux.items()} or None
+        exe, _ = _bind(sym, ctx, loc, aux, grad_req=grad_req)
+        outs = exe.forward(is_train=grad_req != "null")
+        grads = {}
+        if grad_req != "null":
+            exe.backward([nd.ones(o.shape, ctx=ctx, dtype=o.dtype)
+                          for o in outs])
+            grads = {k: (g.asnumpy() if g is not None else None)
+                     for k, g in zip(arg_names, exe.grad_arrays)}
+        results.append({"dtype": dtype,
+                        "outputs": [o.asnumpy() for o in outs],
+                        "grads": grads})
+
+    # compare everything against the highest-precision run
+    ref_i = int(np.argmax([np.finfo(r["dtype"]).resolution ** -1
+                           for r in results]))
+    ref = results[ref_i]
+    errs = []
+    for i, res in enumerate(results):
+        if i == ref_i:
+            continue
+        t = max(tol[res["dtype"]], tol[ref["dtype"]])
+        rt = rtol if rtol is not None else t
+        at = atol if atol is not None else t
+        for j, (o, oref) in enumerate(zip(res["outputs"], ref["outputs"])):
+            try:
+                assert_almost_equal(o, oref, rtol=rt, atol=at,
+                                    names=(f"ctx[{i}] {output_names[j]}",
+                                           f"ctx[{ref_i}] {output_names[j]}"))
+            except AssertionError as e:
+                errs.append(str(e))
+        for name in res["grads"]:
+            if res["grads"][name] is None or ref["grads"].get(name) is None:
+                continue
+            try:
+                assert_almost_equal(res["grads"][name], ref["grads"][name],
+                                    rtol=rt, atol=at,
+                                    names=(f"ctx[{i}] d{name}",
+                                           f"ctx[{ref_i}] d{name}"))
+            except AssertionError as e:
+                errs.append(str(e))
+    if errs and raise_on_err:
+        raise AssertionError("\n".join(errs))
+    return results
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Parity: test_utils.py assert_exception."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"did not raise {exception_type}")

@@ -1,0 +1,159 @@
+"""Network visualization.
+
+Parity: python/mxnet/visualization.py — print_summary (layer table with
+parameter counts) and plot_network (graphviz digraph). Works on this build's
+Symbol JSON graph; graphviz rendering is optional (falls back with a clear
+error if the package is missing, like the reference).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _conf(symbol):
+    conf = json.loads(symbol.tojson())
+    return conf["nodes"], conf.get("heads", [])
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print a table of layers/shapes/params (visualization.py print_summary)."""
+    show_shape = shape is not None
+    shape_dict = {}
+    if show_shape:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    nodes, _ = _conf(symbol)
+    heads = set()
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, f in enumerate(fields):
+            line += str(f)
+            line = line[:pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            for item in node.get("inputs", []):
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        attrs = node.get("attrs", {}) or {}
+        if op == "null":
+            # parameter node: count from inferred shape
+            key = node["name"]
+            if show_shape and key in shape_dict:
+                cur_param = 1
+                for s in shape_dict[key]:
+                    cur_param *= s
+        name = node["name"]
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [f"{name}({op})",
+                  "x".join(str(s) for s in out_shape) if out_shape else "",
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for conn in pre_node[1:]:
+            print_row(["", "", "", conn], positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        name = node["name"]
+        if op != "null":
+            key = name + "_output"
+            if show_shape and key in shape_dict:
+                out_shape = list(shape_dict[key])
+        elif show_shape and name in shape_dict:
+            out_shape = list(shape_dict[name])
+        print_layer_summary(node, out_shape)
+        print(("=" if i == len(nodes) - 1 else "_") * line_length)
+    print(f"Total params: {total_params[0]}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol graph
+    (visualization.py plot_network). Requires the `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the `graphviz` python package; it is "
+            "not bundled in this environment — use print_summary for a "
+            "text rendering") from e
+    nodes, _ = _conf(symbol)
+    draw_shape = shape is not None
+    shape_dict = {}
+    if draw_shape:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = {"name": name}
+        label = name
+        if op == "null":
+            if name.endswith(("_weight", "_bias", "_beta", "_gamma",
+                              "_moving_mean", "_moving_var",
+                              "_running_mean", "_running_var")):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attrs["fillcolor"] = "#8dd3c7"
+            label = name
+        else:
+            params = node.get("attrs", {}) or {}
+            label = f"{op}\n{name}"
+            attrs["fillcolor"] = {
+                "Convolution": "#fb8072", "FullyConnected": "#fb8072",
+                "BatchNorm": "#bebada", "Activation": "#ffffb3",
+                "Pooling": "#80b1d3", "Concat": "#fdb462",
+            }.get(op, "#fccde5")
+        dot.node(name=name, label=label, **{**node_attr, **attrs})
+    name2idx = {n["name"]: i for i, n in enumerate(nodes)}
+    for node in nodes:
+        if node["op"] == "null" or node["name"] in hidden_nodes:
+            continue
+        for item in node.get("inputs", []):
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = input_name + ("_output" if input_node["op"] != "null"
+                                    else "")
+                if key in shape_dict:
+                    attrs["label"] = "x".join(
+                        str(s) for s in shape_dict[key])
+            dot.edge(tail_name=node["name"], head_name=input_name, **attrs)
+    return dot

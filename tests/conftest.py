@@ -7,10 +7,19 @@ reference's multi-GPU tests used real GPUs.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = os.environ.get("MXNET_TPU_TEST_PLATFORM", "cpu")
+_platform = os.environ.get("MXNET_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The interpreter may have imported jax already (sitecustomize), in which
+# case the env var is too late for jax.config defaults — but the backend
+# itself initializes lazily, so jax.config.update still lands.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,247 @@
+"""Mesh-sharded training tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's multi-GPU/distributed acceptance pattern
+(tests/nightly/dist_sync_kvstore.py:30 — identical aggregated values on all
+workers): here the assertion is dp-sharded training numerics == single-device
+training numerics, since GSPMD's compiler-placed collectives replace the
+explicit kvstore push/pull.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.functional import RNG_KEY
+
+
+def _mlp(hidden=16, classes=8, dropout=0.0):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"))
+    if dropout:
+        net.add(nn.Dropout(dropout))
+    net.add(nn.Dense(classes))
+    return net
+
+
+def _init(net, batch=8, feat=12, seed=7):
+    mx.random.seed(seed)
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.zeros((batch, feat))
+    net(x)  # materialize deferred shapes
+    return net
+
+
+def _batch(batch=8, feat=12, classes=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(batch, feat).astype(np.float32)
+    y = (rng.rand(batch) * classes).astype(np.float32)
+    return x, y
+
+
+def test_dp_trainer_step():
+    net = _init(_mlp())
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh)
+    x, y = _batch()
+    before = {k: np.asarray(v) for k, v in trainer.params.items()}
+    losses = [float(np.asarray(trainer.step(x, y))) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    changed = [k for k in before
+               if not np.allclose(before[k], np.asarray(trainer.params[k]))]
+    assert changed, "no parameter moved after 3 steps"
+
+
+def test_dp_matches_single_device():
+    # One net, two trainers capturing identical initial params: dp=8
+    # sharded step must reproduce the dp=1 step's updated params.
+    net = _init(_mlp())
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    t1 = parallel.ShardedTrainer(
+        net, loss, "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+        mesh=parallel.create_mesh({"dp": 1}, jax.devices()[:1]))
+    t8 = parallel.ShardedTrainer(
+        net, loss, "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+        mesh=parallel.create_mesh({"dp": 8}))
+    x, y = _batch(batch=16)
+    for _ in range(2):
+        l1 = t1.step(x, y)
+        l8 = t8.step(x, y)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l8), rtol=1e-5)
+    for k in t1.params:
+        np.testing.assert_allclose(
+            np.asarray(t1.params[k]), np.asarray(t8.params[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_dp_tp_param_rules():
+    import re
+
+    net = _init(_mlp(hidden=16, classes=8))
+    mesh = parallel.create_mesh({"dp": 4, "tp": 2})
+    # shard the classifier projection's output dim over tp
+    wname = [n for n in net.collect_params() if n.endswith("_weight")][-1]
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh,
+        param_rules=[(re.escape(wname) + "$", P("tp", None))])
+    x, y = _batch(batch=8)
+    l = trainer.step(x, y)
+    assert np.isfinite(np.asarray(l))
+    # the rule actually applied
+    assert trainer._param_sharding[wname].spec == P("tp", None)
+
+
+def test_tp_matches_replicated():
+    net = _init(_mlp())
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    t_rep = parallel.ShardedTrainer(
+        net, loss, "sgd", {"learning_rate": 0.2},
+        mesh=parallel.create_mesh({"dp": 8}))
+    t_tp = parallel.ShardedTrainer(
+        net, loss, "sgd", {"learning_rate": 0.2},
+        mesh=parallel.create_mesh({"dp": 4, "tp": 2}),
+        param_rules=[(r".*_weight$", P("tp", None))])
+    x, y = _batch(batch=8)
+    l_rep = t_rep.step(x, y)
+    l_tp = t_tp.step(x, y)
+    np.testing.assert_allclose(np.asarray(l_rep), np.asarray(l_tp), rtol=1e-5)
+    for k in t_rep.params:
+        np.testing.assert_allclose(
+            np.asarray(t_rep.params[k]), np.asarray(t_tp.params[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_adam_path():
+    net = _init(_mlp())
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh)
+    x, y = _batch()
+    l0 = float(np.asarray(trainer.step(x, y)))
+    l1 = float(np.asarray(trainer.step(x, y)))
+    assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_sync_to_net_roundtrip():
+    net = _init(_mlp())
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh)
+    x, y = _batch()
+    trainer.step(x, y)
+    trainer.sync_to_net()
+    for name, p in net.collect_params().items():
+        if name in trainer.params:
+            np.testing.assert_allclose(
+                np.asarray(p.data().asnumpy()),
+                np.asarray(trainer.params[name]), rtol=1e-6, err_msg=name)
+    # eager forward on the synced net still works
+    out = net(mx.nd.array(x))
+    assert np.all(np.isfinite(out.asnumpy()))
+
+
+def test_rng_key_threads_through_step():
+    # Dropout inside the jitted sharded step: the threaded RNG key must
+    # advance every step (fresh masks) and must not leak a tracer into the
+    # eager global key (ADVICE.md high finding).
+    net = _init(_mlp(dropout=0.5))
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.0}, mesh=mesh)  # lr=0: only dropout varies loss
+    assert RNG_KEY in trainer.aux
+    x, y = _batch()
+    k0 = np.asarray(trainer.aux[RNG_KEY])
+    l0 = float(np.asarray(trainer.step(x, y)))
+    k1 = np.asarray(trainer.aux[RNG_KEY])
+    l1 = float(np.asarray(trainer.step(x, y)))
+    k2 = np.asarray(trainer.aux[RNG_KEY])
+    assert not np.array_equal(k0, k1) and not np.array_equal(k1, k2), \
+        "RNG key did not advance across steps"
+    assert l0 != l1, "identical dropout masks across steps (baked key)"
+    # eager sampling must still work after jitted tracing
+    s = mx.random.uniform(shape=(3,))
+    assert np.all(np.isfinite(s.asnumpy()))
+
+
+@pytest.mark.parametrize("opt,params", [
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("rmsprop", {"learning_rate": 1e-3}),
+    ("rmsprop", {"learning_rate": 1e-3, "centered": True}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("adadelta", {}),
+    ("adamax", {}),
+    ("nadam", {}),
+    ("ftml", {}),
+    ("ftrl", {}),
+    ("signum", {"learning_rate": 0.01}),
+    ("lamb", {}),
+    ("lars", {"learning_rate": 0.05}),
+    ("dcasgd", {"learning_rate": 0.05}),
+    ("sgld", {"learning_rate": 1e-3}),
+])
+def test_functional_optimizer_registry(opt, params):
+    net = _init(_mlp())
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), opt, params, mesh=mesh)
+    x, y = _batch()
+    before = {k: np.asarray(v) for k, v in trainer.params.items()}
+    for _ in range(2):
+        l = trainer.step(x, y)
+    assert np.isfinite(np.asarray(l)), opt
+    moved = [k for k in before
+             if not np.allclose(before[k], np.asarray(trainer.params[k]))]
+    assert moved, f"{opt}: no parameter moved"
+
+
+def test_adam_step_counter_threads():
+    # bias correction uses a TRACED t: it must advance across steps of one
+    # compiled executable instead of baking the trace-time value
+    net = _init(_mlp())
+    t = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2},
+        mesh=parallel.create_mesh({"dp": 1}, jax.devices()[:1]))
+    x, y = _batch()
+    for _ in range(3):
+        t.step(x, y)
+    assert int(np.asarray(t.opt_state["t"])) == 3
+
+
+def test_bf16_compute_policy():
+    net = _init(_mlp())
+    mesh = parallel.create_mesh({"dp": 8})
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh, dtype="bfloat16")
+    x, y = _batch()
+    losses = [float(np.asarray(trainer.step(x, y))) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    # master weights stay fp32
+    for k, v in trainer.params.items():
+        assert v.dtype == np.float32, (k, v.dtype)
+    # training moves in the right direction-ish: loss not exploding
+    assert losses[-1] < losses[0] * 2
+
+
+def test_functional_call_purity():
+    net = _init(_mlp())
+    fwd = parallel.functional_call(net, train=False)
+    params = parallel.param_arrays(net)
+    aux = parallel.aux_arrays(net)
+    x, _ = _batch()
+    out_eager = net(mx.nd.array(x)).asnumpy()
+    out_fn, _ = jax.jit(fwd)(params, aux, x)
+    np.testing.assert_allclose(out_eager, np.asarray(out_fn), rtol=1e-5)
+    # cells restored: net params unchanged, eager path still matches
+    np.testing.assert_allclose(out_eager, net(mx.nd.array(x)).asnumpy(),
+                               rtol=1e-6)

@@ -1,0 +1,249 @@
+"""Every advertised module imports and does its job.
+
+VERDICT r1 weak #4: the lazy table in mxnet_tpu/__init__.py must not lie.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+LAZY_NAMES = ["sym", "symbol", "gluon", "module", "optimizer", "metric",
+              "io", "kv", "kvstore", "initializer", "lr_scheduler",
+              "callback", "image", "recordio", "model", "np", "numpy",
+              "parallel", "profiler", "amp", "util", "runtime",
+              "test_utils", "executor", "monitor", "visualization",
+              "contrib", "engine"]
+
+
+@pytest.mark.parametrize("name", LAZY_NAMES)
+def test_lazy_surface_imports(name):
+    mod = getattr(mx, name)
+    assert mod is not None
+
+
+def test_runtime_feature_list():
+    feats = mx.runtime.feature_list()
+    names = {f.name for f in feats}
+    assert {"TPU", "CPU", "JIT", "PROFILER"} <= names
+    assert mx.runtime.Features().is_enabled("JIT")
+
+
+def test_engine_bulk():
+    prev = mx.engine.set_bulk_size(16)
+    assert mx.engine.set_bulk_size(prev) == 16
+    with mx.engine.bulk(8):
+        pass
+
+
+def test_util_np_toggles():
+    assert not mx.util.is_np_array()
+    mx.util.set_np()
+    assert mx.util.is_np_array() and mx.util.is_np_shape()
+    mx.util.reset_np()
+    assert not mx.util.is_np_array()
+
+    @mx.util.use_np
+    def f():
+        return mx.util.is_np_array()
+
+    assert f() and not mx.util.is_np_array()
+
+
+def test_profiler_scopes_and_dumps(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"))
+    with mx.profiler.Task("unit-task"):
+        mx.nd.zeros((4,)).asnumpy()
+    with mx.profiler.scope("unit-scope"):
+        (mx.nd.ones((4,)) * 2).asnumpy()
+    s = mx.profiler.dumps()
+    assert "unit-task" in s and "unit-scope" in s
+    mx.profiler.dump()
+    assert (tmp_path / "prof.json").exists()
+
+
+def test_monitor_taps_executor():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.FullyConnected(data, w, no_bias=True, num_hidden=3,
+                                name="fc")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 4), w=(3, 4))
+    seen = []
+    mon = mx.monitor.Monitor(1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.arg_dict["data"][:] = np.ones((2, 4), np.float32)
+    exe.arg_dict["w"][:] = np.ones((3, 4), np.float32)
+    exe.forward()
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert any("fc" in n and "output" in n for n in names), names
+    # uninstalling returns to the fused path
+    exe.set_monitor_callback(None)
+    outs = exe.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full((2, 3), 4.0))
+
+
+def test_visualization_print_summary(capsys):
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    mx.visualization.print_summary(out, shape={"data": (2, 4)})
+    cap = capsys.readouterr().out
+    assert "fc" in cap and "Total params" in cap
+
+
+# ----------------------------------------------------------------- mx.np
+
+def test_np_basic_semantics():
+    a = mx.np.array([[1., 2.], [3., 4.]])
+    assert a[0, 0].shape == ()          # true zero-dim, not (1,)
+    assert float(a[0, 0].item()) == 1.0
+    b = a > 2                            # bool dtype
+    assert b.asnumpy().dtype == np.bool_
+    # boolean mask indexing
+    sel = a[b]
+    np.testing.assert_allclose(sel.asnumpy(), [3., 4.])
+    # setitem
+    a[0, 0] = 9.0
+    assert float(a[0, 0].item()) == 9.0
+
+
+def test_np_op_subset():
+    a = mx.np.array([[1., 2.], [3., 4.]])
+    b = mx.np.array([[1., 0.], [0., 1.]])
+    np.testing.assert_allclose(
+        mx.np.einsum("ij,jk->ik", a, b).asnumpy(), a.asnumpy())
+    np.testing.assert_allclose(
+        mx.np.cumsum(a, axis=1).asnumpy(), np.cumsum(a.asnumpy(), axis=1))
+    np.testing.assert_allclose(
+        mx.np.percentile(a, 50).asnumpy(), np.percentile(a.asnumpy(), 50))
+    np.testing.assert_allclose(
+        mx.np.linalg.norm(a).asnumpy(), np.linalg.norm(a.asnumpy()),
+        rtol=1e-6)
+    u = mx.np.unique(mx.np.array([1, 1, 2, 3, 3]))
+    np.testing.assert_allclose(u.asnumpy(), [1, 2, 3])
+
+
+def test_np_random_seeded():
+    mx.np.random.seed(7)
+    a = mx.np.random.uniform(size=(3,))
+    mx.np.random.seed(7)
+    b = mx.np.random.uniform(size=(3,))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_np_nd_interop():
+    a = mx.nd.array([[1., 2.]])
+    an = mx.np.array(a)
+    assert isinstance(an, mx.np.ndarray)
+    back = an.as_nd_ndarray()
+    np.testing.assert_allclose(back.asnumpy(), a.asnumpy())
+
+
+# ----------------------------------------------------------------- mx.amp
+
+def test_amp_bf16_imperative():
+    import jax.numpy as jnp
+
+    mx.amp.init(target_dtype="bfloat16")
+    try:
+        x = mx.nd.ones((4, 8))
+        w = mx.nd.ones((3, 8))
+        out = mx.nd.FullyConnected(x, w, no_bias=True, num_hidden=3)
+        assert out._data.dtype == jnp.bfloat16
+        # fp32-pinned op stays fp32
+        s = mx.nd.softmax(out)
+        assert s._data.dtype == jnp.float32
+    finally:
+        mx.amp.reset()
+    # off again
+    out = mx.nd.FullyConnected(x, w, no_bias=True, num_hidden=3)
+    assert out._data.dtype == jnp.float32
+
+
+def test_amp_trainer_loss_scaler():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.amp.init(target_dtype="float16")
+    try:
+        net = nn.Dense(4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        mx.amp.init_trainer(trainer)
+        scaler = trainer._amp_loss_scaler
+        x = mx.nd.ones((2, 3))
+
+        def one_step():
+            with mx.autograd.record():
+                out = net(x)
+                loss = out.sum()
+                with mx.amp.scale_loss(loss, trainer) as scaled:
+                    scaled.backward()
+            ok = mx.amp.unscale(trainer)
+            if ok:
+                trainer.step(2)
+            return ok
+
+        scale0 = scaler.loss_scale
+        stepped = one_step()
+        if not stepped:
+            # overflow path: dynamic scaler must back off...
+            assert scaler.loss_scale < scale0
+            # ...until a clean step goes through
+            for _ in range(20):
+                if one_step():
+                    break
+            else:
+                raise AssertionError("scaler never recovered")
+        assert scaler.loss_scale >= 1.0
+    finally:
+        mx.amp.reset()
+
+
+def test_amp_convert_hybrid_block():
+    from mxnet_tpu.gluon import nn
+    import jax.numpy as jnp
+
+    net = nn.Dense(4)
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    mx.amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    for _, p in net.collect_params().items():
+        assert p.data()._data.dtype == jnp.bfloat16
+
+
+# ------------------------------------------------------------ mx.test_utils
+
+def test_assert_almost_equal():
+    mx.test_utils.assert_almost_equal(np.ones(3), np.ones(3))
+    with pytest.raises(AssertionError):
+        mx.test_utils.assert_almost_equal(np.ones(3), np.zeros(3))
+
+
+def test_check_numeric_gradient():
+    data = mx.sym.var("data")
+    out = mx.sym.tanh(data)
+    loc = {"data": np.random.RandomState(0).randn(2, 3).astype(np.float32)}
+    mx.test_utils.check_numeric_gradient(out, loc, ctx=mx.cpu())
+
+
+def test_check_symbolic_forward_backward():
+    data = mx.sym.var("data")
+    out = mx.sym.square(data)
+    x = np.array([[1., 2., 3.]], dtype=np.float32)
+    mx.test_utils.check_symbolic_forward(out, {"data": x}, [x ** 2],
+                                         ctx=mx.cpu())
+    mx.test_utils.check_symbolic_backward(out, {"data": x},
+                                          [np.ones_like(x)],
+                                          {"data": 2 * x}, ctx=mx.cpu())
+
+
+def test_check_consistency_dtypes():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    mx.test_utils.check_consistency(
+        fc,
+        [{"ctx": mx.cpu(), "data": (3, 5), "type_dict": {"data": np.float32}},
+         {"ctx": mx.cpu(), "data": (3, 5), "type_dict": {"data": np.float16}}])
