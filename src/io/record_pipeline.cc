@@ -1,0 +1,529 @@
+// mxnet_tpu native data pipeline.
+//
+// Capability parity with the reference's ImageRecordIter stack
+// (src/io/iter_image_recordio_2.cc: record parsing :708, decode/augment
+// workers, double-buffered batches :880) re-designed as a standalone C++
+// library driven from Python over a flat C ABI (ctypes — no pybind11).
+//
+// Design (TPU-first): the consumer is a jitted training step that eats a
+// whole host batch at once, so the unit of hand-off is a fully-assembled
+// NCHW/NHWC float32 batch buffer, not per-sample tensors.  A fixed ring of
+// `prefetch` batch slots is filled by a pool of decode workers; the Python
+// side borrows a READY slot zero-copy (numpy frombuffer), copies it into a
+// pinned jax array, and releases the slot back to the ring.
+//
+// Record framing matches mxnet_tpu/recordio.py (and the reference's
+// dmlc-core RecordIO): [u32 magic][u32 cflag<<29|len][payload][pad to 4B],
+// payload = IRHeader{u32 flag; f32 label; u64 id; u64 id2} +
+// flag*f32 extended labels + encoded image bytes.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <opencv2/core.hpp>
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct RecordRef {
+  uint64_t offset;  // file offset of the 8-byte frame header
+  uint32_t length;  // payload length (without frame header / padding)
+};
+
+// ---------------------------------------------------------------------------
+// Config (mirrored as a ctypes.Structure in record_pipeline.py — keep the
+// field order and types in sync).
+// ---------------------------------------------------------------------------
+struct PipelineConfig {
+  int32_t batch_size;
+  int32_t channels, height, width;  // output sample shape
+  int32_t label_width;
+  int32_t shuffle;
+  uint32_t seed;
+  int32_t num_threads;
+  int32_t prefetch;  // batch slots in the ring, >= 2
+  // augmentation
+  int32_t rand_mirror;
+  int32_t rand_crop;           // random (vs center) crop after resize
+  int32_t random_resized_crop; // area/aspect-ratio sampled crop
+  float min_area, max_area;    // as fraction of source area
+  float min_aspect, max_aspect;
+  int32_t resize;  // resize shorter side to this first (0 = off)
+  float mean[4];
+  float std[4];
+  int32_t part_index, num_parts;  // dataset sharding for distributed
+  int32_t round_batch;  // 1: wrap to fill the last batch (report pad)
+  int32_t layout;       // 0 = NCHW, 1 = NHWC
+};
+
+struct BatchSlot {
+  enum State { FREE, FILLING, READY, BORROWED };
+  State state = FREE;
+  int64_t batch_id = -1;   // which epoch batch this slot holds
+  int32_t filled = 0;      // samples completed by workers
+  int32_t pad = 0;
+  std::vector<float> data;
+  std::vector<float> label;
+};
+
+class Pipeline {
+ public:
+  Pipeline(std::string rec_path, std::string idx_path, PipelineConfig cfg)
+      : cfg_(cfg), rec_path_(std::move(rec_path)) {
+    if (cfg_.prefetch < 2) cfg_.prefetch = 2;
+    if (cfg_.num_threads < 1) cfg_.num_threads = 1;
+    if (cfg_.channels != 1 && cfg_.channels != 3)
+      throw std::runtime_error("channels must be 1 (grayscale) or 3 (RGB)");
+    for (int c = 0; c < 4; ++c)
+      if (cfg_.std[c] == 0.f) cfg_.std[c] = 1.f;
+    LoadIndex(idx_path);
+    Shard();
+    if (records_.empty()) throw std::runtime_error("no records in shard");
+    order_.resize(records_.size());
+    for (size_t i = 0; i < order_.size(); ++i) order_[i] = i;
+    n_batches_ = cfg_.round_batch
+                     ? (records_.size() + cfg_.batch_size - 1) / cfg_.batch_size
+                     : records_.size() / cfg_.batch_size;
+    if (n_batches_ == 0)
+      throw std::runtime_error("fewer records than batch_size and round_batch=0");
+    slots_.resize(cfg_.prefetch);
+    const size_t dsz = (size_t)cfg_.batch_size * cfg_.channels * cfg_.height *
+                       cfg_.width;
+    for (auto& s : slots_) {
+      s.data.resize(dsz);
+      s.label.resize((size_t)cfg_.batch_size * cfg_.label_width);
+    }
+    StartEpoch(/*first=*/true);
+    for (int t = 0; t < cfg_.num_threads; ++t)
+      workers_.emplace_back([this, t] { WorkerLoop(t); });
+  }
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  int64_t size() const { return (int64_t)records_.size(); }
+  int64_t batches_per_epoch() const { return n_batches_; }
+
+  // Returns slot index >= 0 with pointers, or -1 at epoch end.
+  int Next(float** data, float** label, int* pad) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (next_consume_ >= n_batches_) return -1;
+    const int64_t want = next_consume_;
+    const int si = (int)(want % slots_.size());
+    cv_ready_.wait(lk, [&] {
+      return stop_ ||
+             (slots_[si].state == BatchSlot::READY &&
+              slots_[si].batch_id == want);
+    });
+    if (stop_) return -1;
+    BatchSlot& s = slots_[si];
+    s.state = BatchSlot::BORROWED;
+    *data = s.data.data();
+    *label = s.label.data();
+    *pad = s.pad;
+    ++next_consume_;
+    return si;
+  }
+
+  void Release(int slot) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      BatchSlot& s = slots_[slot];
+      if (s.state != BatchSlot::BORROWED) return;
+      s.state = BatchSlot::FREE;
+      s.batch_id = -1;
+      s.filled = 0;
+    }
+    cv_work_.notify_all();
+  }
+
+  void Reset() {
+    std::unique_lock<std::mutex> lk(mu_);
+    // Cancel the in-flight epoch: bump the generation so workers abandon
+    // claimed samples, then wait until no worker is still decoding into a
+    // slot buffer before reusing the slots (workers parked in cv_work_
+    // don't touch slot memory, so they don't count).
+    ++generation_;
+    cv_work_.notify_all();
+    cv_quiesce_.wait(lk, [&] { return decoding_ == 0 || stop_; });
+    for (auto& s : slots_) {
+      if (s.state != BatchSlot::BORROWED) {
+        s.state = BatchSlot::FREE;
+        s.batch_id = -1;
+        s.filled = 0;
+      }
+    }
+    StartEpoch(/*first=*/false);
+    lk.unlock();
+    cv_work_.notify_all();
+  }
+
+ private:
+  void LoadIndex(const std::string& idx_path) {
+    std::ifstream rec(rec_path_, std::ios::binary);
+    if (!rec) throw std::runtime_error("cannot open " + rec_path_);
+    if (!idx_path.empty()) {
+      std::ifstream idx(idx_path);
+      if (idx) {
+        // idx lines: "<key>\t<offset>"; offsets point at frame headers.
+        // A stale/truncated idx (offset past EOF, bad magic) must not
+        // silently truncate the dataset — fall back to a full scan.
+        std::string line;
+        bool ok = true;
+        while (ok && std::getline(idx, line)) {
+          if (line.empty()) continue;
+          const size_t tab = line.find('\t');
+          if (tab == std::string::npos) continue;
+          uint64_t off;
+          try {
+            off = std::stoull(line.substr(tab + 1));
+          } catch (const std::exception&) {
+            ok = false;
+            break;
+          }
+          rec.seekg((std::streamoff)off);
+          uint32_t hdr[2];
+          if (!rec.read(reinterpret_cast<char*>(hdr), 8) ||
+              hdr[0] != kMagic) {
+            ok = false;
+            break;
+          }
+          records_.push_back({off, hdr[1] & ((1u << 29) - 1)});
+        }
+        if (ok && !records_.empty()) return;
+        std::fprintf(stderr,
+                     "[mxtpu_io] warning: index file %s is stale or "
+                     "unreadable; scanning %s sequentially\n",
+                     idx_path.c_str(), rec_path_.c_str());
+        records_.clear();
+      }
+    }
+    // Sequential scan of the framing.
+    rec.clear();
+    rec.seekg(0);
+    uint64_t off = 0;
+    uint32_t hdr[2];
+    while (rec.read(reinterpret_cast<char*>(hdr), 8)) {
+      if (hdr[0] != kMagic) throw std::runtime_error("bad magic in rec");
+      const uint32_t len = hdr[1] & ((1u << 29) - 1);
+      records_.push_back({off, len});
+      const uint64_t skip = (len + 3u) & ~3u;
+      rec.seekg((std::streamoff)(off + 8 + skip));
+      off += 8 + skip;
+    }
+  }
+
+  void Shard() {
+    if (cfg_.num_parts <= 1) return;
+    std::vector<RecordRef> mine;
+    for (size_t i = cfg_.part_index; i < records_.size();
+         i += cfg_.num_parts)
+      mine.push_back(records_[i]);
+    records_.swap(mine);
+  }
+
+  void StartEpoch(bool first) {
+    if (!first) ++epoch_;
+    if (cfg_.shuffle) {
+      std::mt19937 rng(cfg_.seed + (uint32_t)epoch_);
+      std::shuffle(order_.begin(), order_.end(), rng);
+    }
+    next_sample_ = 0;
+    next_consume_ = 0;
+  }
+
+  // Claim a (batch, position) unit of work; blocks until the target slot is
+  // claimable for the head batch. Returns false only on stop.
+  //
+  // The wait predicate must be exactly the claimability condition: a
+  // predicate that is true while the head slot still holds an older,
+  // unconsumed batch makes wait() return immediately *without releasing the
+  // mutex*, and the claimer then spins holding the lock — starving the
+  // worker that would complete that older batch (observed as a one-core
+  // livelock).
+  bool ClaimSample(std::unique_lock<std::mutex>& lk, int64_t* sample,
+                   int* slot, uint64_t* gen) {
+    const int64_t total = n_batches_ * (int64_t)cfg_.batch_size;
+    for (;;) {
+      if (stop_) return false;
+      *gen = generation_;
+      const int64_t s = next_sample_;
+      if (s < total) {
+        const int64_t b = s / cfg_.batch_size;
+        const int si = (int)(b % slots_.size());
+        BatchSlot& bs = slots_[si];
+        if (bs.state == BatchSlot::FREE) {
+          bs.state = BatchSlot::FILLING;
+          bs.batch_id = b;
+          bs.filled = 0;
+          bs.pad = (int)std::max<int64_t>(
+              0,
+              (b + 1) * (int64_t)cfg_.batch_size - (int64_t)records_.size());
+        }
+        if (bs.state == BatchSlot::FILLING && bs.batch_id == b) {
+          *sample = s;
+          *slot = si;
+          ++next_sample_;
+          return true;
+        }
+      }
+      // Epoch exhausted, or the head slot still holds an unconsumed earlier
+      // batch: sleep until that exact situation changes.
+      cv_work_.wait(lk, [&] {
+        if (stop_ || generation_ != *gen) return true;
+        const int64_t s2 = next_sample_;
+        if (s2 >= total) return false;  // parked until Reset()
+        const int64_t b2 = s2 / cfg_.batch_size;
+        const BatchSlot& bs2 = slots_[(size_t)(b2 % (int64_t)slots_.size())];
+        return bs2.state == BatchSlot::FREE ||
+               (bs2.state == BatchSlot::FILLING && bs2.batch_id == b2);
+      });
+    }
+  }
+
+  void WorkerLoop(int tid) {
+    // Each worker keeps its own file handle (pread-style seeks) and RNG.
+    (void)tid;
+    std::ifstream rec(rec_path_, std::ios::binary);
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      int64_t sample;
+      int si;
+      uint64_t gen;
+      if (!ClaimSample(lk, &sample, &si, &gen)) break;
+      const int64_t b = sample / cfg_.batch_size;
+      const int pos = (int)(sample % cfg_.batch_size);
+      const size_t rec_i =
+          order_[(size_t)(sample % (int64_t)records_.size())];
+      const uint64_t ep = (uint64_t)epoch_;
+      ++decoding_;
+      lk.unlock();
+
+      std::mt19937 rng(cfg_.seed * 2654435761u + (uint32_t)ep * 97 +
+                       (uint32_t)sample);
+      bool ok = DecodeInto(rec, records_[rec_i], si, pos, rng);
+      if (!ok) {
+        // Slot buffers are reused across batches, so a failed decode must
+        // actively clear its region — otherwise the position would serve
+        // stale pixels/label from an earlier batch.
+        BatchSlot& bs = slots_[si];
+        const size_t ssz = (size_t)cfg_.channels * cfg_.height * cfg_.width;
+        std::memset(bs.data.data() + (size_t)pos * ssz, 0,
+                    ssz * sizeof(float));
+        std::memset(bs.label.data() + (size_t)pos * cfg_.label_width, 0,
+                    (size_t)cfg_.label_width * sizeof(float));
+        std::fprintf(stderr,
+                     "[mxtpu_io] warning: record %zu failed to decode; "
+                     "serving zeros\n", rec_i);
+      }
+
+      lk.lock();
+      --decoding_;
+      if (decoding_ == 0) cv_quiesce_.notify_all();
+      if (generation_ != gen) continue;  // epoch was cancelled mid-decode
+      BatchSlot& bs = slots_[si];
+      if (bs.batch_id == b && bs.state == BatchSlot::FILLING) {
+        if (++bs.filled == cfg_.batch_size) {
+          bs.state = BatchSlot::READY;
+          cv_ready_.notify_all();
+        }
+      }
+    }
+  }
+
+  bool DecodeInto(std::ifstream& rec, const RecordRef& r, int slot, int pos,
+                  std::mt19937& rng) {
+    std::vector<uint8_t> buf(r.length);
+    rec.clear();
+    rec.seekg((std::streamoff)(r.offset + 8));
+    if (!rec.read(reinterpret_cast<char*>(buf.data()), r.length)) return false;
+    if (buf.size() < 24) return false;
+    uint32_t flag;
+    float label0;
+    std::memcpy(&flag, buf.data(), 4);
+    std::memcpy(&label0, buf.data() + 4, 4);
+    size_t img_off = 24;
+    BatchSlot& bs = slots_[slot];
+    float* lab = bs.label.data() + (size_t)pos * cfg_.label_width;
+    if (flag > 0) {
+      img_off += (size_t)flag * 4;
+      if (img_off > buf.size()) return false;
+      for (int i = 0; i < cfg_.label_width; ++i) {
+        float v = 0.f;
+        if ((uint32_t)i < flag) std::memcpy(&v, buf.data() + 24 + i * 4, 4);
+        lab[i] = v;
+      }
+    } else {
+      lab[0] = label0;
+      for (int i = 1; i < cfg_.label_width; ++i) lab[i] = 0.f;
+    }
+
+    cv::Mat raw(1, (int)(buf.size() - img_off), CV_8UC1, buf.data() + img_off);
+    cv::Mat img = cv::imdecode(
+        raw, cfg_.channels == 1 ? cv::IMREAD_GRAYSCALE : cv::IMREAD_COLOR);
+    if (img.empty()) return false;
+    if (cfg_.channels == 3) cv::cvtColor(img, img, cv::COLOR_BGR2RGB);
+
+    img = Augment(img, rng);
+
+    // Normalize + layout into the batch buffer.
+    const int H = cfg_.height, W = cfg_.width, C = cfg_.channels;
+    float* out = bs.data.data() + (size_t)pos * C * H * W;
+    const bool mirror =
+        cfg_.rand_mirror && std::uniform_int_distribution<int>(0, 1)(rng);
+    for (int y = 0; y < H; ++y) {
+      const uint8_t* row = img.ptr<uint8_t>(y);
+      for (int x = 0; x < W; ++x) {
+        const int sx = mirror ? (W - 1 - x) : x;
+        for (int c = 0; c < C; ++c) {
+          const float v =
+              ((float)row[sx * C + c] - cfg_.mean[c]) / cfg_.std[c];
+          if (cfg_.layout == 0)  // NCHW
+            out[(size_t)c * H * W + (size_t)y * W + x] = v;
+          else  // NHWC
+            out[((size_t)y * W + x) * C + c] = v;
+        }
+      }
+    }
+    return true;
+  }
+
+  cv::Mat Augment(cv::Mat img, std::mt19937& rng) {
+    const int H = cfg_.height, W = cfg_.width;
+    if (cfg_.random_resized_crop) {
+      // Inception-style area/aspect sampled crop (10 tries, then fallback
+      // to a center crop of the largest fitting region).
+      std::uniform_real_distribution<float> ud(0.f, 1.f);
+      const float src_area = (float)img.rows * img.cols;
+      for (int attempt = 0; attempt < 10; ++attempt) {
+        const float area =
+            src_area * (cfg_.min_area +
+                        ud(rng) * (cfg_.max_area - cfg_.min_area));
+        const float log_lo = std::log(cfg_.min_aspect);
+        const float log_hi = std::log(cfg_.max_aspect);
+        const float aspect = std::exp(log_lo + ud(rng) * (log_hi - log_lo));
+        const int cw = (int)std::lround(std::sqrt(area * aspect));
+        const int ch = (int)std::lround(std::sqrt(area / aspect));
+        if (cw <= img.cols && ch <= img.rows && cw > 0 && ch > 0) {
+          const int x = std::uniform_int_distribution<int>(
+              0, img.cols - cw)(rng);
+          const int y = std::uniform_int_distribution<int>(
+              0, img.rows - ch)(rng);
+          cv::Mat crop = img(cv::Rect(x, y, cw, ch));
+          cv::Mat outm;
+          cv::resize(crop, outm, cv::Size(W, H), 0, 0, cv::INTER_LINEAR);
+          return outm;
+        }
+      }
+      const int side = std::min(img.rows, img.cols);
+      const int x = (img.cols - side) / 2, y = (img.rows - side) / 2;
+      cv::Mat crop = img(cv::Rect(x, y, side, side));
+      cv::Mat outm;
+      cv::resize(crop, outm, cv::Size(W, H), 0, 0, cv::INTER_LINEAR);
+      return outm;
+    }
+    if (cfg_.resize > 0) {
+      const float scale =
+          (float)cfg_.resize / (float)std::min(img.rows, img.cols);
+      cv::Mat resized;
+      cv::resize(img, resized,
+                 cv::Size(std::max(W, (int)std::lround(img.cols * scale)),
+                          std::max(H, (int)std::lround(img.rows * scale))),
+                 0, 0, cv::INTER_LINEAR);
+      img = resized;
+    }
+    if (img.rows == H && img.cols == W) return img;
+    if (img.rows < H || img.cols < W) {
+      cv::Mat outm;
+      cv::resize(img, outm, cv::Size(W, H), 0, 0, cv::INTER_LINEAR);
+      return outm;
+    }
+    int x, y;
+    if (cfg_.rand_crop) {
+      x = std::uniform_int_distribution<int>(0, img.cols - W)(rng);
+      y = std::uniform_int_distribution<int>(0, img.rows - H)(rng);
+    } else {
+      x = (img.cols - W) / 2;
+      y = (img.rows - H) / 2;
+    }
+    return img(cv::Rect(x, y, W, H)).clone();
+  }
+
+  PipelineConfig cfg_;
+  std::string rec_path_;
+  std::vector<RecordRef> records_;
+  std::vector<size_t> order_;
+  int64_t n_batches_ = 0;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_ready_, cv_quiesce_;
+  std::vector<BatchSlot> slots_;
+  std::vector<std::thread> workers_;
+  int64_t next_sample_ = 0;   // next (batch*B+pos) unit to claim
+  int64_t next_consume_ = 0;  // next batch the consumer will take
+  int64_t epoch_ = 0;
+  uint64_t generation_ = 0;
+  int decoding_ = 0;
+  bool stop_ = false;
+};
+
+thread_local std::string g_err;
+
+}  // namespace
+
+extern "C" {
+
+const char* mxtpu_last_error() { return g_err.c_str(); }
+
+void* mxtpu_pipeline_create(const char* rec_path, const char* idx_path,
+                            const PipelineConfig* cfg) {
+  try {
+    return new Pipeline(rec_path, idx_path ? idx_path : "", *cfg);
+  } catch (const std::exception& e) {
+    g_err = e.what();
+    return nullptr;
+  }
+}
+
+int mxtpu_pipeline_next(void* h, float** data, float** label, int* pad) {
+  return static_cast<Pipeline*>(h)->Next(data, label, pad);
+}
+
+void mxtpu_pipeline_release(void* h, int slot) {
+  static_cast<Pipeline*>(h)->Release(slot);
+}
+
+void mxtpu_pipeline_reset(void* h) { static_cast<Pipeline*>(h)->Reset(); }
+
+int64_t mxtpu_pipeline_size(void* h) {
+  return static_cast<Pipeline*>(h)->size();
+}
+
+int64_t mxtpu_pipeline_batches(void* h) {
+  return static_cast<Pipeline*>(h)->batches_per_epoch();
+}
+
+void mxtpu_pipeline_destroy(void* h) { delete static_cast<Pipeline*>(h); }
+
+}  // extern "C"

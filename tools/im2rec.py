@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""im2rec — build RecordIO image datasets (capability parity with the
+reference's tools/im2rec.py).
+
+Two modes:
+
+  List:  python tools/im2rec.py --list prefix image_root
+         Walks image_root, assigns integer labels per subdirectory (sorted),
+         writes ``prefix.lst`` lines of "index\\tlabel\\trelative/path".
+
+  Pack:  python tools/im2rec.py prefix image_root
+         Reads ``prefix.lst``, encodes each image (optionally resized /
+         re-encoded JPEG), writes ``prefix.rec`` + ``prefix.idx`` readable by
+         ImageRecordIter and MXIndexedRecordIO.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, shuffle=True, seed=0, train_ratio=1.0):
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for c in classes:
+            for dirpath, _, files in os.walk(os.path.join(root, c)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        items.append((float(label_of[c]), rel))
+    else:  # flat directory: label 0
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                items.append((0.0, f))
+    if shuffle:
+        random.Random(seed).shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    splits = [(prefix + ".lst", items[:n_train])]
+    if train_ratio < 1.0:
+        splits.append((prefix + "_val.lst", items[n_train:]))
+    for path, part in splits:
+        with open(path, "w") as out:
+            for i, (label, rel) in enumerate(part):
+                out.write(f"{i}\t{label:g}\t{rel}\n")
+    print(f"wrote {len(items)} entries across {len(splits)} list file(s); "
+          f"{len(classes)} classes")
+    return label_of
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, color=1):
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack as _pack
+
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        try:
+            payload = _encode(path, resize, quality, color)
+        except Exception as e:  # noqa: BLE001 - skip unreadable images
+            print(f"skipping {rel}: {e}", file=sys.stderr)
+            continue
+        label = labels[0] if len(labels) == 1 else labels
+        rec.write_idx(idx, _pack(IRHeader(0, label, idx, 0), payload))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count}")
+    rec.close()
+    print(f"packed {count} records -> {prefix}.rec")
+
+
+def _encode(path, resize, quality, color):
+    if resize <= 0 and color == 1 and \
+            os.path.splitext(path)[1].lower() in (".jpg", ".jpeg"):
+        with open(path, "rb") as f:
+            return f.read()  # already-JPEG color input: keep original bytes
+    from io import BytesIO
+
+    from PIL import Image
+
+    img = Image.open(path)
+    img = img.convert("RGB" if color else "L")
+    if resize > 0:
+        scale = resize / min(img.size)
+        img = img.resize((max(1, round(img.size[0] * scale)),
+                          max(1, round(img.size[1] * scale))))
+    bio = BytesIO()
+    img.save(bio, format="JPEG", quality=quality)
+    return bio.getvalue()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side before encoding (0 = keep)")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1, choices=[0, 1])
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.list:
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
+                  seed=args.seed, train_ratio=args.train_ratio)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, color=args.color)
+
+
+if __name__ == "__main__":
+    main()
