@@ -76,13 +76,20 @@ class Context:
         jax = _jax()
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu")
+            # Addressable devices only: under jax.distributed, jax.devices()
+            # is the GLOBAL list and device 0 may belong to another process.
+            devs = [d for d in jax.devices("cpu")
+                    if d.process_index == jax.process_index()]
         else:  # tpu / gpu both mean "the local accelerator"
             devs = _accelerator_devices()
+            if devs:
+                local = [d for d in devs
+                         if d.process_index == jax.process_index()]
+                devs = local or devs
             if not devs:
                 # Fall back to whatever the default platform offers (CPU when
                 # running the test suite with JAX_PLATFORMS=cpu).
-                devs = jax.devices()
+                devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"{self}: only {len(devs)} device(s) of this type are visible"

@@ -7,9 +7,10 @@ unit of hand-off is a whole assembled float32 batch, produced by the native
 C++ library in src/io/record_pipeline.cc (thread-pool decode + a ring of
 prefetched batch slots) and borrowed zero-copy over ctypes.
 
-A pure-Python fallback (PIL decode on a thread pool) provides the same
-semantics when the native library can't be built, so the API is always
-available; throughput work belongs to the native path.
+A pure-Python fallback (_PyPipeline: PIL decode, batches assembled on a
+thread pool) provides the same semantics when the native library can't be
+built, so the API is always available; throughput work belongs to the
+native path.
 """
 from __future__ import annotations
 
@@ -147,7 +148,9 @@ def _build_config(batch_size, data_shape, label_width, shuffle, seed,
     cfg.resize = resize
     for i in range(4):
         cfg.mean[i] = mean[i] if i < len(mean) else 0.0
-        cfg.std[i] = std[i] if i < len(std) else 1.0
+        # std=0 means "unset" in the reference's parameterization; coerce
+        # here so the native and Python backends agree.
+        cfg.std[i] = (std[i] or 1.0) if i < len(std) else 1.0
     cfg.part_index, cfg.num_parts = part_index, num_parts
     cfg.round_batch = int(bool(round_batch))
     cfg.layout = layout
@@ -287,14 +290,11 @@ class _NativePipeline:
         if slot < 0:
             return None
         try:
-            n = 1
-            for d in self._dshape:
-                n *= d
-            data = _np.ctypeslib.as_array(data_p, shape=(n,)).reshape(
-                self._dshape).copy()
-            label = _np.ctypeslib.as_array(
-                label_p, shape=(self._lshape[0] * self._lshape[1],)).reshape(
-                self._lshape).copy()
+            # One host copy out of the borrowed slot. Deliberately NOT a
+            # zero-copy device_put: on the CPU backend jax may alias the
+            # host buffer indefinitely, which would race with slot reuse.
+            data = _np.ctypeslib.as_array(data_p, shape=self._dshape).copy()
+            label = _np.ctypeslib.as_array(label_p, shape=self._lshape).copy()
         finally:
             self._lib.mxtpu_pipeline_release(self._h, slot)
         return data, label, pad.value
@@ -313,9 +313,10 @@ class _PyPipeline:
 
     def __init__(self, rec_path, cfg):
         self._cfg = cfg
-        self._records = []  # (offset, length)
+        self._records = []  # offset of each logical record's first frame
         with open(rec_path, "rb") as f:
             off = 0
+            in_split = False
             while True:
                 hdr = f.read(8)
                 if len(hdr) < 8:
@@ -323,16 +324,30 @@ class _PyPipeline:
                 magic, fl = struct.unpack("<II", hdr)
                 if magic != 0xced7230a:
                     raise MXNetError("bad record magic")
+                cflag = fl >> 29
                 length = fl & ((1 << 29) - 1)
-                self._records.append((off, length))
+                if not in_split:
+                    self._records.append(off)
+                    in_split = cflag == 1  # kBegin
+                elif cflag == 3:  # kEnd
+                    in_split = False
+                elif cflag != 2:  # not kMiddle
+                    raise MXNetError("bad record framing")
                 skip = (length + 3) & ~3
                 f.seek(off + 8 + skip)
                 off += 8 + skip
+            if in_split:
+                raise MXNetError("truncated split record")
+        self._rec_path = rec_path
+        self._tls = threading.local()
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, cfg.num_threads))
         if cfg.num_parts > 1:
             self._records = self._records[cfg.part_index::cfg.num_parts]
         if not self._records:
             raise MXNetError("no records in shard")
-        self._f = open(rec_path, "rb")
         self.num_samples = len(self._records)
         bs = cfg.batch_size
         self.num_batches = ((self.num_samples + bs - 1) // bs
@@ -352,6 +367,38 @@ class _PyPipeline:
                 self._cfg.seed + self._epoch).shuffle(self._order)
         self._cursor = 0
 
+    def _file(self):
+        # One handle per pool thread: seek/read pairs must not interleave.
+        f = getattr(self._tls, "f", None)
+        if f is None:
+            f = open(self._rec_path, "rb")
+            self._tls.f = f
+        return f
+
+    def _read_logical(self, off):
+        """Read the logical record at `off`, re-joining split chunks with
+        the magic word at each seam (same rules as MXRecordIO.read)."""
+        chunks = None
+        f = self._file()
+        f.seek(off)
+        while True:
+            magic, fl = struct.unpack("<II", f.read(8))
+            if magic != 0xced7230a:
+                raise MXNetError("bad record magic")
+            cflag, length = fl >> 29, fl & ((1 << 29) - 1)
+            buf = f.read(length)
+            pad = (-length) % 4
+            if pad:
+                f.read(pad)
+            if chunks is None:
+                if cflag == 0:
+                    return buf
+                chunks = [buf]
+            else:
+                chunks.append(buf)
+                if cflag == 3:
+                    return struct.pack("<I", 0xced7230a).join(chunks)
+
     def _decode(self, rec_i, rng):
         from io import BytesIO
 
@@ -360,9 +407,7 @@ class _PyPipeline:
         from ..recordio import unpack
 
         cfg = self._cfg
-        off, length = self._records[rec_i]
-        self._f.seek(off + 8)
-        buf = self._f.read(length)
+        buf = self._read_logical(self._records[rec_i])
         header, payload = unpack(buf)
         lab = _np.atleast_1d(_np.asarray(header.label, dtype=_np.float32))
         label = _np.zeros(cfg.label_width, dtype=_np.float32)
@@ -429,13 +474,18 @@ class _PyPipeline:
                          dtype=_np.float32)
         label = _np.zeros((bs, cfg.label_width), dtype=_np.float32)
         pad = max(0, (b + 1) * bs - self.num_samples)
-        for pos in range(bs):
+
+        def _one(pos):
             sample = b * bs + pos
             rec_i = self._order[sample % self.num_samples]
             rng = _np.random.RandomState(
                 (cfg.seed * 2654435761 + self._epoch * 97 + sample)
                 & 0xFFFFFFFF)
             data[pos], label[pos] = self._decode(rec_i, rng)
+
+        # Per-sample RNGs are independently seeded, so pool scheduling
+        # doesn't affect determinism.
+        list(self._pool.map(_one, range(bs)))
         self._cursor += 1
         return data, label, pad
 

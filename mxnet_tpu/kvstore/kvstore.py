@@ -33,7 +33,9 @@ def create(name="local"):
                 "kvstore 'dist_async' has no TPU equivalent (ps-lite "
                 "asynchronous server is dropped); using synchronous "
                 "allreduce semantics instead.")
-        return KVStoreTPU(name)
+        from .dist import KVStoreDist
+
+        return KVStoreDist(name)
     raise MXNetError(f"unknown kvstore type {name!r}")
 
 
@@ -78,6 +80,7 @@ class KVStore:
         keys, values = _pairs(key, value)
         for k, v in zip(keys, values):
             merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            merged = self._global_merge(merged)
             if k not in self._data:
                 self._data[k] = merged.copy()
                 continue
@@ -135,6 +138,11 @@ class KVStore:
             raise MXNetError("no updater is set")
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
+
+    def _global_merge(self, merged):
+        """Hook for cross-process aggregation; identity for local stores
+        (KVStoreDist overrides with an allreduce)."""
+        return merged
 
     def _reduce(self, values):
         merged = values[0]

@@ -102,19 +102,37 @@ class MXRecordIO:
             self.record.write(b"\x00" * pad)
 
     def read(self):
+        """Read one logical record. Handles split records (cflag
+        kBegin=1/kMiddle=2/kEnd=3): chunks are re-joined with the magic word
+        re-inserted at each seam, matching the dmlc-core reader."""
         assert not self.writable
         self._check_pid(allow_reset=True)
-        hdr = self.record.read(8)
-        if len(hdr) < 8:
-            return None
-        magic, fl = struct.unpack("<II", hdr)
-        assert magic == _kMagic, "invalid record magic"
-        _, length = _decode_flag_len(fl)
-        buf = self.record.read(length)
-        pad = (-length) % 4
-        if pad:
-            self.record.read(pad)
-        return buf
+        chunks = None
+        while True:
+            hdr = self.record.read(8)
+            if len(hdr) < 8:
+                if chunks is not None:
+                    raise ValueError("truncated split record")
+                return None
+            magic, fl = struct.unpack("<II", hdr)
+            assert magic == _kMagic, "invalid record magic"
+            cflag, length = _decode_flag_len(fl)
+            buf = self.record.read(length)
+            pad = (-length) % 4
+            if pad:
+                self.record.read(pad)
+            if chunks is None:
+                if cflag == 0:
+                    return buf
+                if cflag != 1:
+                    raise ValueError(f"unexpected continuation flag {cflag}")
+                chunks = [buf]
+            else:
+                if cflag not in (2, 3):
+                    raise ValueError(f"unexpected record flag {cflag}")
+                chunks.append(buf)
+                if cflag == 3:
+                    return struct.pack("<I", _kMagic).join(chunks)
 
     def tell(self):
         return self.record.tell()

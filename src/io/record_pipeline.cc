@@ -39,9 +39,15 @@ namespace {
 
 constexpr uint32_t kMagic = 0xced7230a;
 
+// One logical record. Writers split payloads containing the magic word into
+// kBegin(1)/kMiddle(2)/kEnd(3) chunks (cflag = top 3 bits of the length
+// word); readers re-join the chunks with the magic re-inserted at each seam.
+// `offset` is the first frame header; `n_chunks` == 1 for plain (cflag 0)
+// records; `length` is the logical payload length after re-joining.
 struct RecordRef {
-  uint64_t offset;  // file offset of the 8-byte frame header
-  uint32_t length;  // payload length (without frame header / padding)
+  uint64_t offset;
+  uint32_t length;
+  uint32_t n_chunks;
 };
 
 // ---------------------------------------------------------------------------
@@ -180,6 +186,37 @@ class Pipeline {
   }
 
  private:
+  // Scan one logical record starting at `off` (which must be a frame with
+  // cflag 0 or kBegin). On success fills `out` and sets `next_off` to the
+  // first byte after the record. Returns false on malformed framing.
+  static bool ScanLogicalRecord(std::ifstream& rec, uint64_t off,
+                                RecordRef* out, uint64_t* next_off) {
+    uint32_t logical_len = 0, n_chunks = 0;
+    uint64_t cur = off;
+    for (;;) {
+      rec.clear();
+      rec.seekg((std::streamoff)cur);
+      uint32_t hdr[2];
+      if (!rec.read(reinterpret_cast<char*>(hdr), 8) || hdr[0] != kMagic)
+        return false;
+      const uint32_t cflag = hdr[1] >> 29;
+      const uint32_t len = hdr[1] & ((1u << 29) - 1);
+      if (n_chunks == 0) {
+        if (cflag != 0 && cflag != 1) return false;  // must start a record
+        logical_len = len;
+      } else {
+        if (cflag != 2 && cflag != 3) return false;  // must continue one
+        logical_len += 4 + len;  // the magic word is re-inserted at the seam
+      }
+      ++n_chunks;
+      cur += 8 + ((len + 3u) & ~3u);
+      if (cflag == 0 || cflag == 3) break;
+    }
+    *out = {off, logical_len, n_chunks};
+    *next_off = cur;
+    return true;
+  }
+
   void LoadIndex(const std::string& idx_path) {
     std::ifstream rec(rec_path_, std::ios::binary);
     if (!rec) throw std::runtime_error("cannot open " + rec_path_);
@@ -195,21 +232,19 @@ class Pipeline {
           if (line.empty()) continue;
           const size_t tab = line.find('\t');
           if (tab == std::string::npos) continue;
-          uint64_t off;
+          uint64_t off, next;
+          RecordRef r;
           try {
             off = std::stoull(line.substr(tab + 1));
           } catch (const std::exception&) {
             ok = false;
             break;
           }
-          rec.seekg((std::streamoff)off);
-          uint32_t hdr[2];
-          if (!rec.read(reinterpret_cast<char*>(hdr), 8) ||
-              hdr[0] != kMagic) {
+          if (!ScanLogicalRecord(rec, off, &r, &next)) {
             ok = false;
             break;
           }
-          records_.push_back({off, hdr[1] & ((1u << 29) - 1)});
+          records_.push_back(r);
         }
         if (ok && !records_.empty()) return;
         std::fprintf(stderr,
@@ -221,16 +256,16 @@ class Pipeline {
     }
     // Sequential scan of the framing.
     rec.clear();
-    rec.seekg(0);
+    rec.seekg(0, std::ios::end);
+    const uint64_t fsize = (uint64_t)rec.tellg();
     uint64_t off = 0;
-    uint32_t hdr[2];
-    while (rec.read(reinterpret_cast<char*>(hdr), 8)) {
-      if (hdr[0] != kMagic) throw std::runtime_error("bad magic in rec");
-      const uint32_t len = hdr[1] & ((1u << 29) - 1);
-      records_.push_back({off, len});
-      const uint64_t skip = (len + 3u) & ~3u;
-      rec.seekg((std::streamoff)(off + 8 + skip));
-      off += 8 + skip;
+    while (off + 8 <= fsize) {
+      RecordRef r;
+      uint64_t next;
+      if (!ScanLogicalRecord(rec, off, &r, &next))
+        throw std::runtime_error("bad record framing in " + rec_path_);
+      records_.push_back(r);
+      off = next;
     }
   }
 
@@ -352,12 +387,42 @@ class Pipeline {
     }
   }
 
+  // Read a logical record's payload, re-joining split chunks with the magic
+  // word re-inserted at each seam (inverse of the dmlc-core writer split).
+  static bool ReadPayload(std::ifstream& rec, const RecordRef& r,
+                          std::vector<uint8_t>* buf) {
+    buf->resize(r.length);
+    rec.clear();
+    if (r.n_chunks == 1) {
+      rec.seekg((std::streamoff)(r.offset + 8));
+      return bool(rec.read(reinterpret_cast<char*>(buf->data()), r.length));
+    }
+    uint64_t cur = r.offset;
+    size_t w = 0;
+    for (uint32_t c = 0; c < r.n_chunks; ++c) {
+      rec.seekg((std::streamoff)cur);
+      uint32_t hdr[2];
+      if (!rec.read(reinterpret_cast<char*>(hdr), 8) || hdr[0] != kMagic)
+        return false;
+      const uint32_t len = hdr[1] & ((1u << 29) - 1);
+      if (c > 0) {  // seam: the split point was a magic word in the payload
+        if (w + 4 > buf->size()) return false;
+        std::memcpy(buf->data() + w, &kMagic, 4);
+        w += 4;
+      }
+      if (w + len > buf->size()) return false;
+      if (!rec.read(reinterpret_cast<char*>(buf->data() + w), len))
+        return false;
+      w += len;
+      cur += 8 + ((len + 3u) & ~3u);
+    }
+    return w == buf->size();
+  }
+
   bool DecodeInto(std::ifstream& rec, const RecordRef& r, int slot, int pos,
                   std::mt19937& rng) {
-    std::vector<uint8_t> buf(r.length);
-    rec.clear();
-    rec.seekg((std::streamoff)(r.offset + 8));
-    if (!rec.read(reinterpret_cast<char*>(buf.data()), r.length)) return false;
+    std::vector<uint8_t> buf;
+    if (!ReadPayload(rec, r, &buf)) return false;
     if (buf.size() < 24) return false;
     uint32_t flag;
     float label0;

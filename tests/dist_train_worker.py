@@ -1,0 +1,54 @@
+"""Gluon data-parallel training across processes (run via tools/launch.py).
+
+Each rank trains the same seeded model on different data through
+Trainer(kvstore='dist_sync'); gradients are allreduced, so parameters must
+stay bitwise-identical on every rank (the cifar10_dist example contract).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+
+
+def main():
+    outdir = sys.argv[1]
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    mx.random.seed(5)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    x0 = mx.nd.zeros((4, 8))
+    net(x0)  # materialize
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(100 + rank)  # different data per rank
+    for step in range(3):
+        x = mx.nd.array(rng.rand(4, 8).astype(np.float32))
+        y = mx.nd.array((rng.rand(4) * 3).astype(np.float32))
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+
+    params = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    np.savez(os.path.join(outdir, f"train_rank{rank}.npz"), **params)
+    print(f"train rank {rank}/{nw} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -188,3 +188,92 @@ def test_native_matches_python(rec_dataset):
                                       bp.label[0].asnumpy())
         # decoders differ (libjpeg vs PIL) + resize interpolation: loose tol
         assert np.abs(bn.data[0].asnumpy() - bp.data[0].asnumpy()).mean() < 8.0
+
+
+def _write_split_record(f, payload):
+    """Write `payload` the way the dmlc-core writer does when it contains the
+    magic word: split at each magic occurrence into kBegin/kMiddle/kEnd
+    chunks (the magic bytes themselves are dropped and re-inserted on read)."""
+    import struct
+
+    magic = struct.pack("<I", 0xced7230a)
+    chunks = payload.split(magic)
+    assert len(chunks) > 1
+    for i, chunk in enumerate(chunks):
+        cflag = 1 if i == 0 else (3 if i == len(chunks) - 1 else 2)
+        f.write(magic)
+        f.write(struct.pack("<I", (cflag << 29) | len(chunk)))
+        f.write(chunk)
+        f.write(b"\x00" * ((-len(chunk)) % 4))
+
+
+def test_split_record_roundtrip(tmp_path):
+    """Records whose payload contains the magic word arrive split across
+    chunks (dmlc-core writer behavior); both readers must re-join them."""
+    import struct
+
+    import mxnet_tpu.recordio as recordio
+
+    magic = struct.pack("<I", 0xced7230a)
+    payload = b"A" * 10 + magic + b"B" * 7 + magic + b"C" * 3
+    plain = b"D" * 9
+    path = tmp_path / "split.rec"
+    with open(path, "wb") as f:
+        _write_split_record(f, payload)
+        f.write(magic)
+        f.write(struct.pack("<I", len(plain)))
+        f.write(plain)
+        f.write(b"\x00" * ((-len(plain)) % 4))
+
+    r = recordio.MXRecordIO(str(path), "r")
+    assert r.read() == payload
+    assert r.read() == plain
+    assert r.read() is None
+    r.close()
+
+
+@pytest.mark.parametrize("force_python", _modes())
+def test_split_record_pipeline(tmp_path, force_python):
+    """An image record split on an embedded magic word decodes correctly
+    through the pipeline."""
+    import struct
+
+    from io import BytesIO
+
+    import mxnet_tpu.recordio as recordio
+    from PIL import Image
+
+    magic = struct.pack("<I", 0xced7230a)
+    # Deterministically embed the magic in the payload: an extended label
+    # whose float32 bit pattern IS the magic word forces the writer split.
+    magic_float = struct.unpack("<f", magic)[0]
+    bio = BytesIO()
+    Image.fromarray(np.full((24, 24, 3), 120, np.uint8)).save(
+        bio, format="JPEG", quality=97)
+    payload = recordio.pack(
+        recordio.IRHeader(0, [3.0, magic_float], 0, 0), bio.getvalue())
+    assert magic in payload
+    path = tmp_path / "m.rec"
+    with open(path, "wb") as f:
+        _write_split_record(f, payload)
+        # a couple of plain records around it
+        for v in (1.0, 2.0):
+            bio = BytesIO()
+            Image.fromarray(np.full((24, 24, 3), int(40 * v), np.uint8)
+                            ).save(bio, format="JPEG")
+            rec = recordio.pack(recordio.IRHeader(0, v, 0, 0), bio.getvalue())
+            assert magic not in rec
+            f.write(magic)
+            f.write(struct.pack("<I", len(rec)))
+            f.write(rec)
+            f.write(b"\x00" * ((-len(rec)) % 4))
+
+    it = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 24, 24),
+                         batch_size=3, shuffle=False,
+                         force_python=force_python)
+    assert it.num_samples == 3
+    batch = next(iter(it))
+    labels = sorted(batch.label[0].asnumpy().tolist())
+    assert labels == [1.0, 2.0, 3.0]
+    data = batch.data[0].asnumpy()
+    assert np.isfinite(data).all() and data.max() > 0

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""launch.py — start a multi-process distributed training job.
+
+Capability parity with the reference's tools/launch.py (dmlc-core tracker,
+`--launcher {local,ssh,mpi,...}`): the TPU build keeps the `local` launcher
+(spawn N worker processes on this host, used by tests and single-host
+multi-chip jobs) and delegates multi-host pod scheduling to the cluster's
+own orchestrator (GKE/xpk), which sets the same env protocol per host.
+
+Usage:
+    python tools/launch.py -n 2 [--port P] python train.py --epochs 1 ...
+
+Each worker gets: DMLC_ROLE=worker, DMLC_WORKER_ID, DMLC_NUM_WORKER,
+DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT — consumed by
+mxnet_tpu.kvstore.dist.init_distributed (jax.distributed bootstrap).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(n, cmd, port=None, env_extra=None):
+    port = port or free_port()
+    procs = []
+    try:
+        for rank in range(n):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_WORKER_ID": str(rank),
+                "DMLC_NUM_WORKER": str(n),
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(port),
+            })
+            procs.append(subprocess.Popen(cmd, env=env))
+        # Poll all workers: if any dies, tear the whole job down at once
+        # (surviving ranks would otherwise hang in collectives waiting for
+        # the dead peer — the dmlc tracker does the same).
+        import time
+
+        rc = 0
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code != 0:
+                    rc = rc or code
+                    for q in live:
+                        q.send_signal(signal.SIGTERM)
+            time.sleep(0.1)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local", choices=["local"],
+                    help="only 'local' is provided; pod-scale jobs are "
+                         "scheduled by the cluster orchestrator")
+    ap.add_argument("--port", type=int, default=None,
+                    help="coordinator port (default: pick a free one)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+    sys.exit(launch_local(args.num_workers, cmd, port=args.port))
+
+
+if __name__ == "__main__":
+    main()
