@@ -312,6 +312,8 @@ class _PyPipeline:
     """Pure-Python fallback with identical batch semantics (PIL decode)."""
 
     def __init__(self, rec_path, cfg):
+        from ..recordio import _decode_flag_len, _kMagic
+
         self._cfg = cfg
         self._records = []  # offset of each logical record's first frame
         with open(rec_path, "rb") as f:
@@ -322,10 +324,9 @@ class _PyPipeline:
                 if len(hdr) < 8:
                     break
                 magic, fl = struct.unpack("<II", hdr)
-                if magic != 0xced7230a:
+                if magic != _kMagic:
                     raise MXNetError("bad record magic")
-                cflag = fl >> 29
-                length = fl & ((1 << 29) - 1)
+                cflag, length = _decode_flag_len(fl)
                 if not in_split:
                     self._records.append(off)
                     in_split = cflag == 1  # kBegin
@@ -376,28 +377,13 @@ class _PyPipeline:
         return f
 
     def _read_logical(self, off):
-        """Read the logical record at `off`, re-joining split chunks with
-        the magic word at each seam (same rules as MXRecordIO.read)."""
-        chunks = None
+        """Read the logical record at `off` (recordio.read_logical_record is
+        the single framing parser)."""
+        from ..recordio import read_logical_record
+
         f = self._file()
         f.seek(off)
-        while True:
-            magic, fl = struct.unpack("<II", f.read(8))
-            if magic != 0xced7230a:
-                raise MXNetError("bad record magic")
-            cflag, length = fl >> 29, fl & ((1 << 29) - 1)
-            buf = f.read(length)
-            pad = (-length) % 4
-            if pad:
-                f.read(pad)
-            if chunks is None:
-                if cflag == 0:
-                    return buf
-                chunks = [buf]
-            else:
-                chunks.append(buf)
-                if cflag == 3:
-                    return struct.pack("<I", 0xced7230a).join(chunks)
+        return read_logical_record(f)
 
     def _decode(self, rec_i, rng):
         from io import BytesIO

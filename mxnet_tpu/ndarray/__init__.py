@@ -76,7 +76,13 @@ _populate()
 
 
 def __getattr__(name):
-    # late-registered ops (e.g. contrib) resolve lazily
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".contrib", __name__)
+        setattr(_MODULE, "contrib", mod)
+        return mod
+    # late-registered ops resolve lazily
     try:
         get_op(name)
     except Exception:

@@ -1,0 +1,35 @@
+"""mx.nd.contrib — short names for `_contrib_*` registered ops.
+
+Parity: python/mxnet/ndarray/contrib.py (the reference generates this
+namespace from op names prefixed `_contrib_`; same rule here).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+_MODULE = _sys.modules[__name__]
+_PREFIX = "_contrib_"
+
+
+def _resolve(name):
+    from . import __getattr__ as _nd_getattr
+
+    try:
+        return _nd_getattr(_PREFIX + name)
+    except AttributeError:
+        return _nd_getattr(name)
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    fn = _resolve(name)
+    setattr(_MODULE, name, fn)
+    return fn
+
+
+def __dir__():
+    from ..ops.registry import list_ops
+
+    return sorted(n[len(_PREFIX):] for n in list_ops()
+                  if n.startswith(_PREFIX))

@@ -11,5 +11,6 @@ from . import nn as _nn                # noqa: F401  neural-net kernels
 from . import rnn as _rnn              # noqa: F401  fused RNN
 from . import optimizer_ops as _opt    # noqa: F401  optimizer updates
 from . import random_ops as _rand      # noqa: F401  samplers
+from . import detection as _det        # noqa: F401  SSD/R-CNN contrib ops
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]

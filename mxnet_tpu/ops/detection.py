@@ -1,0 +1,406 @@
+"""Object-detection operators (SSD/R-CNN family).
+
+Capability parity with the reference's contrib detection ops —
+multibox_prior/multibox_target/multibox_detection
+(src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc), box_nms (src/operator/contrib/bounding_box.cc) and
+ROIAlign (src/operator/contrib/roi_align.cc) — re-designed for XLA: no
+dynamic shapes anywhere. Suppressed/invalid results are encoded in-place
+(-1 rows) exactly like the reference, which keeps every output statically
+shaped; NMS is a top-k prefilter + O(k^2) pairwise-IoU mask swept by a
+`lax.fori_loop`, which XLA vectorizes far better than the reference's
+per-box CUDA scan.
+
+Matching note: MultiBoxTarget uses the standard SSD assignment (per-gt
+argmax anchor union IoU>threshold) rather than the reference's M-round
+greedy bipartite loop; the two differ only when one anchor is the argmax of
+several ground truths, and train to the same quality.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _lax():
+    from jax import lax
+
+    return lax
+
+
+def _pair_iou(a, b):
+    """IoU between two corner-format box sets: a (N,4), b (M,4) -> (N,M)."""
+    jnp = _jnp()
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], b[None, :, 3]
+    ix = jnp.maximum(
+        jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    iy = jnp.maximum(
+        jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = ix * iy
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_MultiBoxPrior", no_grad=True,
+          aliases=("MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate anchor boxes over the feature-map grid of `data` (B,C,H,W).
+
+    Returns (1, H*W*(len(sizes)+len(ratios)-1), 4) corner-format anchors.
+    Parity: src/operator/contrib/multibox_prior.cc (anchor layout: for each
+    cell, (size_i, ratio_0) for all i then (size_0, ratio_j) for j>0).
+    """
+    jnp = _jnp()
+    sizes = tuple(float(s) for s in _listify(sizes))
+    ratios = tuple(float(r) for r in _listify(ratios))
+    steps = tuple(float(s) for s in _listify(steps))
+    offsets = tuple(float(o) for o in _listify(offsets))
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+
+    half_wh = []
+    for s in sizes:
+        r = ratios[0]
+        half_wh.append((s * _np.sqrt(r) / 2.0, s / _np.sqrt(r) / 2.0))
+    for r in ratios[1:]:
+        s = sizes[0]
+        half_wh.append((s * _np.sqrt(r) / 2.0, s / _np.sqrt(r) / 2.0))
+    half = jnp.asarray(half_wh, dtype=jnp.float32)  # (A, 2) half w,h
+
+    cx = cx[..., None]
+    cy = cy[..., None]
+    anchors = jnp.stack(
+        [cx - half[None, None, :, 0], cy - half[None, None, :, 1],
+         cx + half[None, None, :, 0], cy + half[None, None, :, 1]],
+        axis=-1)  # (H, W, A, 4)
+    anchors = anchors.reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _listify(v):
+    if isinstance(v, (int, float)):
+        return (v,)
+    return tuple(v)
+
+
+def _encode_loc(gt, anchor, variances):
+    """Center-offset encoding of gt boxes against anchors (corner in)."""
+    jnp = _jnp()
+    aw = anchor[:, 2] - anchor[:, 0]
+    ah = anchor[:, 3] - anchor[:, 1]
+    acx = (anchor[:, 0] + anchor[:, 2]) / 2
+    acy = (anchor[:, 1] + anchor[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    return jnp.stack([
+        (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0],
+        (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1],
+        jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2],
+        jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3],
+    ], axis=-1)
+
+
+def _decode_loc(pred, anchor, variances):
+    jnp = _jnp()
+    aw = anchor[:, 2] - anchor[:, 0]
+    ah = anchor[:, 3] - anchor[:, 1]
+    acx = (anchor[:, 0] + anchor[:, 2]) / 2
+    acy = (anchor[:, 1] + anchor[:, 3]) / 2
+    cx = pred[:, 0] * variances[0] * aw + acx
+    cy = pred[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(pred[:, 2] * variances[2]) * aw
+    h = jnp.exp(pred[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_MultiBoxTarget", num_outputs=3, no_grad=True,
+          aliases=("MultiBoxTarget",))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets.
+
+    anchor (1,N,4) corner; label (B,M,5) rows [cls, xmin,ymin,xmax,ymax]
+    (cls<0 = padding); cls_pred (B, num_cls+1, N) for hard-negative mining.
+    Returns loc_target (B,N*4), loc_mask (B,N*4), cls_target (B,N).
+    Parity: src/operator/contrib/multibox_target.cc.
+    """
+    import jax
+
+    jnp = _jnp()
+    variances = tuple(float(v) for v in _listify(variances))
+    anc = anchor.reshape(-1, 4)
+    n = anc.shape[0]
+
+    def one(lab, cpred):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _pair_iou(anc, gt)  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)  # per-anchor best gt
+        best_iou = jnp.max(iou, axis=1)
+        # per-gt best anchor (bipartite half): anchor a is forced-matched to
+        # gt g when a == argmax_a iou[a, g]
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        # invalid gts must not write: redirect their scatter index out of
+        # bounds and drop it
+        ba_safe = jnp.where(valid, best_anchor, n)
+        forced = jnp.zeros((n,), bool).at[ba_safe].set(True, mode="drop")
+        forced_gt = jnp.zeros((n,), jnp.int32).at[ba_safe].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
+        matched = forced | (best_iou >= overlap_threshold)
+        match_gt = jnp.where(forced, forced_gt, best_gt)
+
+        gt_cls = lab[match_gt, 0]
+        cls_t = jnp.where(matched, gt_cls + 1.0, 0.0)
+
+        loc_t = _encode_loc(gt[match_gt], anc, variances)
+        loc_m = jnp.repeat(matched[:, None], 4, axis=1).astype(loc_t.dtype)
+        loc_t = loc_t * loc_m
+
+        if negative_mining_ratio > 0:
+            # hardness of a negative = max non-background class prob
+            neg_cand = (~matched) & (best_iou < negative_mining_thresh)
+            hardness = jnp.max(cpred[1:, :], axis=0)
+            hardness = jnp.where(neg_cand, hardness, -jnp.inf)
+            num_pos = jnp.sum(matched.astype(jnp.int32))
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.asarray(minimum_negative_samples, jnp.int32))
+            # rank of each candidate among hardness (desc): selected if
+            # rank < num_neg
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((n,), jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+            selected_neg = neg_cand & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(selected_neg, 0.0,
+                                        float(ignore_label)))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+def _nms_sweep(boxes, scores, ids, keep0, overlap_thresh, force_suppress):
+    """Sequential NMS over score-sorted entries via fori_loop on a pairwise
+    IoU mask. boxes (K,4) sorted by score desc; returns keep mask (K,)."""
+    jnp = _jnp()
+    lax = _lax()
+    iou = _pair_iou(boxes, boxes)
+    same_cls = (ids[:, None] == ids[None, :]) | bool(force_suppress)
+    suppress = (iou > overlap_thresh) & same_cls  # (K, K)
+    k = boxes.shape[0]
+
+    def body(i, keep):
+        # if i is kept, drop every later j it suppresses
+        drop = suppress[i] & (jnp.arange(k) > i) & keep[i]
+        return keep & ~drop
+
+    return lax.fori_loop(0, k, body, keep0)
+
+
+@register("_contrib_MultiBoxDetection", no_grad=True,
+          aliases=("MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS: cls_prob (B,C,N), loc_pred (B,N*4), anchor (1,N,4) ->
+    (B, N, 6) rows [cls_id, score, xmin, ymin, xmax, ymax], suppressed = -1.
+    Parity: src/operator/contrib/multibox_detection.cc.
+    """
+    import jax
+
+    jnp = _jnp()
+    variances = tuple(float(v) for v in _listify(variances))
+    anc = anchor.reshape(-1, 4)
+    n = anc.shape[0]
+    k = int(nms_topk) if nms_topk and nms_topk > 0 else min(n, 400)
+    k = min(k, n)
+
+    def one(cprob, lpred):
+        # class & score per anchor (background excluded)
+        fg = jnp.concatenate(
+            [cprob[:background_id], cprob[background_id + 1:]], axis=0)
+        # output class ids are 0-based over foreground classes (reference
+        # convention: background row removed before the argmax)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        boxes = _decode_loc(lpred.reshape(-1, 4), anc, variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        score_m = jnp.where(valid, score, -1.0)
+        # top-k prefilter keeps NMS quadratic term small and static
+        top_score, top_idx = jax.lax.top_k(score_m, k)
+        top_boxes = boxes[top_idx]
+        top_ids = cls_id[top_idx]
+        keep0 = top_score > threshold
+        keep = _nms_sweep(top_boxes, top_score, top_ids, keep0,
+                          nms_threshold, force_suppress)
+        out_rows = jnp.where(
+            keep[:, None],
+            jnp.concatenate([top_ids[:, None], top_score[:, None],
+                             top_boxes], axis=1),
+            jnp.full((k, 6), -1.0))
+        out = jnp.full((n, 6), -1.0)
+        out = out.at[jnp.arange(k)].set(out_rows)
+        return out
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+@register("_contrib_box_nms", no_grad=True, aliases=("box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, background_id=-1,
+             force_suppress=False, in_format="corner", out_format="corner"):
+    """Generic NMS over (..., N, K) box tensors; suppressed rows become -1.
+    Parity: src/operator/contrib/bounding_box.cc (BoxNMS).
+    """
+    import jax
+
+    jnp = _jnp()
+    shape = data.shape
+    n, width = shape[-2], shape[-1]
+    flat = data.reshape((-1, n, width))
+    cs = int(coord_start)
+    limit = int(topk) if topk and topk > 0 else n
+
+    def to_corner(b):
+        if in_format == "center":
+            x, y, w, h = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                             axis=-1)
+        return b
+
+    def from_corner(b):
+        if out_format == "center":
+            x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+            return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1,
+                              y2 - y1], axis=-1)
+        return b
+
+    def one(rows):
+        score = rows[:, score_index]
+        ids = (rows[:, id_index] if id_index >= 0
+               else jnp.zeros((n,)))
+        valid = score > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= ids != background_id
+        score_m = jnp.where(valid, score, -jnp.inf)
+        order = jnp.argsort(-score_m)
+        rows_s = rows[order]
+        boxes = to_corner(rows_s[:, cs:cs + 4])
+        ids_s = ids[order]
+        keep0 = jnp.isfinite(score_m[order]) & \
+            (jnp.arange(n) < limit)
+        keep = _nms_sweep(boxes, score_m[order], ids_s, keep0,
+                          overlap_thresh, force_suppress)
+        if out_format != in_format:
+            coords = (from_corner(boxes) if out_format == "center"
+                      else boxes)
+            rows_s = rows_s.at[:, cs:cs + 4].set(coords)
+        out_rows = jnp.where(keep[:, None], rows_s,
+                             jnp.full((n, width), -1.0))
+        return out_rows
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign",))
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False):
+    """ROI Align (bilinear, exact): data (B,C,H,W), rois (R,5)
+    [batch_idx, x1, y1, x2, y2] in image coords.
+    Returns (R, C, PH, PW). Parity: src/operator/contrib/roi_align.cc
+    (Mask R-CNN-style continuous-coordinate pooling); differentiable —
+    the VJP flows through the bilinear gather (the reference ships a
+    hand-written backward kernel; jax.vjp derives it).
+    """
+    import jax
+
+    jnp = _jnp()
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    ph, pw = int(ph), int(pw)
+    b, c, h, w = data.shape
+    sr = int(sample_ratio) if sample_ratio and sample_ratio > 0 else 2
+    if position_sensitive:
+        c_out = c // (ph * pw)
+        assert c_out * ph * pw == c, (
+            "position_sensitive ROIAlign needs channels divisible by "
+            "pooled_h*pooled_w")
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: sr x sr points per bin, averaged
+        gy = y1 + (jnp.arange(ph * sr, dtype=jnp.float32) + 0.5) * (bin_h / sr)
+        gx = x1 + (jnp.arange(pw * sr, dtype=jnp.float32) + 0.5) * (bin_w / sr)
+        img = data[bi]  # (C, H, W)
+
+        def bilinear(yy, xx):
+            # Reference convention (roi_align.cc PreCalcForBilinear): no
+            # half-pixel shift — y_low = floor(y); samples strictly outside
+            # [-1, H] x [-1, W] contribute zero; -1 < y < 0 clamps to 0.
+            outside = (yy < -1.0) | (yy > h) | (xx < -1.0) | (xx > w)
+            y = jnp.clip(yy, 0.0, h - 1)
+            x = jnp.clip(xx, 0.0, w - 1)
+            y0 = jnp.floor(y)
+            x0 = jnp.floor(x)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            y1i = jnp.minimum(y0i + 1, h - 1)
+            x1i = jnp.minimum(x0i + 1, w - 1)
+            ly = y - y0
+            lx = x - x0
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                   v10 * ly * (1 - lx) + v11 * ly * lx)
+            return jnp.where(outside, 0.0, val)
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        samples = jax.vmap(jax.vmap(bilinear))(yy, xx)  # (PH*sr, PW*sr, C)
+        samples = samples.reshape(ph, sr, pw, sr, c)
+        pooled = samples.mean(axis=(1, 3))  # (PH, PW, C)
+        if position_sensitive:
+            # R-FCN-style: bin (i, j) reads channel group g*PH*PW + i*PW + j
+            pooled = pooled.reshape(ph, pw, c_out, ph * pw)
+            bin_idx = (jnp.arange(ph)[:, None] * pw +
+                       jnp.arange(pw)[None, :])  # (PH, PW)
+            pooled = jnp.take_along_axis(
+                pooled, bin_idx[:, :, None, None], axis=3)[..., 0]
+        return jnp.transpose(pooled, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)

@@ -31,6 +31,45 @@ def _decode_flag_len(v):
     return v >> 29, v & ((1 << 29) - 1)
 
 
+def read_logical_record(fileobj):
+    """Read one logical record from `fileobj` at its current position.
+
+    Handles split records (cflag kBegin=1/kMiddle=2/kEnd=3, produced when a
+    payload contains the magic word): chunks are re-joined with the magic
+    word re-inserted at each seam, matching the dmlc-core reader. Returns
+    None at EOF. This is THE framing parser — the data pipeline
+    (io/record_pipeline.py) delegates here; src/io/record_pipeline.cc
+    mirrors the same rules natively.
+    """
+    chunks = None
+    while True:
+        hdr = fileobj.read(8)
+        if len(hdr) < 8:
+            if chunks is not None:
+                raise ValueError("truncated split record")
+            return None
+        magic, fl = struct.unpack("<II", hdr)
+        if magic != _kMagic:
+            raise ValueError("invalid record magic")
+        cflag, length = _decode_flag_len(fl)
+        buf = fileobj.read(length)
+        pad = (-length) % 4
+        if pad:
+            fileobj.read(pad)
+        if chunks is None:
+            if cflag == 0:
+                return buf
+            if cflag != 1:
+                raise ValueError(f"unexpected continuation flag {cflag}")
+            chunks = [buf]
+        else:
+            if cflag not in (2, 3):
+                raise ValueError(f"unexpected record flag {cflag}")
+            chunks.append(buf)
+            if cflag == 3:
+                return struct.pack("<I", _kMagic).join(chunks)
+
+
 class MXRecordIO:
     """Reads/writes sequential RecordIO files (recordio.py:37)."""
 
@@ -102,37 +141,11 @@ class MXRecordIO:
             self.record.write(b"\x00" * pad)
 
     def read(self):
-        """Read one logical record. Handles split records (cflag
-        kBegin=1/kMiddle=2/kEnd=3): chunks are re-joined with the magic word
-        re-inserted at each seam, matching the dmlc-core reader."""
+        """Read one logical record (split records re-joined; see
+        read_logical_record)."""
         assert not self.writable
         self._check_pid(allow_reset=True)
-        chunks = None
-        while True:
-            hdr = self.record.read(8)
-            if len(hdr) < 8:
-                if chunks is not None:
-                    raise ValueError("truncated split record")
-                return None
-            magic, fl = struct.unpack("<II", hdr)
-            assert magic == _kMagic, "invalid record magic"
-            cflag, length = _decode_flag_len(fl)
-            buf = self.record.read(length)
-            pad = (-length) % 4
-            if pad:
-                self.record.read(pad)
-            if chunks is None:
-                if cflag == 0:
-                    return buf
-                if cflag != 1:
-                    raise ValueError(f"unexpected continuation flag {cflag}")
-                chunks = [buf]
-            else:
-                if cflag not in (2, 3):
-                    raise ValueError(f"unexpected record flag {cflag}")
-                chunks.append(buf)
-                if cflag == 3:
-                    return struct.pack("<I", _kMagic).join(chunks)
+        return read_logical_record(self.record)
 
     def tell(self):
         return self.record.tell()

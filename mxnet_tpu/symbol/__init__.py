@@ -27,6 +27,12 @@ _populate()
 
 
 def __getattr__(name):
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".contrib", __name__)
+        setattr(_MODULE, "contrib", mod)
+        return mod
     from ..ops.registry import get_op
 
     try:

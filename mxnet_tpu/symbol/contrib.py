@@ -1,0 +1,35 @@
+"""mx.sym.contrib — short names for `_contrib_*` registered ops.
+
+Parity: python/mxnet/symbol/contrib.py (generated from `_contrib_`-prefixed
+op names).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+_MODULE = _sys.modules[__name__]
+_PREFIX = "_contrib_"
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    from ..ops.registry import get_op
+    from .symbol import make_symbol_creator
+
+    for candidate in (_PREFIX + name, name):
+        try:
+            get_op(candidate)
+        except Exception:
+            continue
+        c = make_symbol_creator(candidate)
+        setattr(_MODULE, name, c)
+        return c
+    raise AttributeError(name)
+
+
+def __dir__():
+    from ..ops.registry import list_ops
+
+    return sorted(n[len(_PREFIX):] for n in list_ops()
+                  if n.startswith(_PREFIX))

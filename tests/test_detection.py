@@ -1,0 +1,321 @@
+"""SSD/detection stack tests: multibox ops, box_nms, ROIAlign, det
+augmenters, ImageDetIter, and an end-to-end SSD-style training step.
+
+Mirrors the reference's tests/python/unittest/test_operator.py multibox and
+bounding-box cases plus test_image.py ImageDetIter coverage.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def np_iou(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+class TestMultiBoxPrior:
+    def test_count_and_layout(self):
+        x = mx.nd.zeros((2, 8, 4, 6))
+        anchors = mx.nd.contrib.MultiBoxPrior(
+            x, sizes=(0.4, 0.2), ratios=(1, 2, 0.5))
+        # A = len(sizes) + len(ratios) - 1 = 4 per cell
+        assert anchors.shape == (1, 4 * 6 * 4, 4)
+        a = anchors.asnumpy()[0].reshape(4, 6, 4, 4)
+        # first cell center = (0.5/6, 0.5/4); first anchor size .4 ratio 1
+        cx, cy = 0.5 / 6, 0.5 / 4
+        np.testing.assert_allclose(
+            a[0, 0, 0], [cx - 0.2, cy - 0.2, cx + 0.2, cy + 0.2], atol=1e-6)
+        # ratio-2 anchor is wider than tall
+        w = a[0, 0, 2, 2] - a[0, 0, 2, 0]
+        h = a[0, 0, 2, 3] - a[0, 0, 2, 1]
+        assert w > h
+
+    def test_clip_and_steps(self):
+        x = mx.nd.zeros((1, 1, 2, 2))
+        anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=(1.5,), clip=True)
+        a = anchors.asnumpy()
+        assert a.min() >= 0.0 and a.max() <= 1.0
+        stepped = mx.nd.contrib.MultiBoxPrior(
+            x, sizes=(0.1,), steps=(0.3, 0.4), offsets=(0.0, 0.0))
+        s = stepped.asnumpy()[0].reshape(2, 2, 1, 4)
+        np.testing.assert_allclose(
+            (s[1, 1, 0, :2] + s[1, 1, 0, 2:]) / 2, [0.4, 0.3], atol=1e-6)
+
+
+class TestMultiBoxTarget:
+    def test_matching(self):
+        anc = mx.nd.array(np.array(
+            [[[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 1.0, 1.0],
+              [0.0, 0.6, 0.3, 0.9]]], np.float32))
+        lab = mx.nd.array(np.array(
+            [[[2, 0.05, 0.05, 0.45, 0.42], [-1, 0, 0, 0, 0]]], np.float32))
+        cls_pred = mx.nd.zeros((1, 4, 3))
+        lt, lm, ct = mx.nd.contrib.MultiBoxTarget(anc, lab, cls_pred)
+        ct = ct.asnumpy()[0]
+        lm = lm.asnumpy()[0].reshape(3, 4)
+        assert ct[0] == 3.0  # class 2 -> target 3 (bg is 0)
+        assert ct[1] == 0.0 and ct[2] == 0.0
+        np.testing.assert_array_equal(lm[0], 1.0)
+        np.testing.assert_array_equal(lm[1:], 0.0)
+
+    def test_forced_match_low_iou(self):
+        # gt overlaps no anchor above threshold; its best anchor must still
+        # be matched (bipartite half)
+        anc = mx.nd.array(np.array(
+            [[[0.0, 0.0, 0.1, 0.1], [0.8, 0.8, 1.0, 1.0]]], np.float32))
+        lab = mx.nd.array(np.array(
+            [[[0, 0.4, 0.4, 0.6, 0.6]]], np.float32))
+        cls_pred = mx.nd.zeros((1, 2, 2))
+        _, lm, ct = mx.nd.contrib.MultiBoxTarget(
+            anc, lab, cls_pred, overlap_threshold=0.5)
+        ct = ct.asnumpy()[0]
+        assert (ct == 1.0).sum() == 1  # exactly one forced positive
+
+    def test_encode_decode_roundtrip(self):
+        anc_np = np.array([[[0.1, 0.2, 0.5, 0.7]]], np.float32)
+        gt = np.array([[[0, 0.15, 0.25, 0.55, 0.75]]], np.float32)
+        anc = mx.nd.array(anc_np)
+        lab = mx.nd.array(gt)
+        cls_pred = mx.nd.zeros((1, 2, 1))
+        lt, lm, ct = mx.nd.contrib.MultiBoxTarget(anc, lab, cls_pred)
+        # decoding the loc target through MultiBoxDetection recovers the gt
+        cls_prob = mx.nd.array(np.array([[[0.0], [1.0]]], np.float32))
+        det = mx.nd.contrib.MultiBoxDetection(
+            cls_prob, lt, anc, threshold=0.01, nms_topk=1, clip=False)
+        got = det.asnumpy()[0, 0]
+        np.testing.assert_allclose(got[2:], gt[0, 0, 1:], atol=1e-5)
+
+    def test_negative_mining(self):
+        anc = mx.nd.array(np.tile(
+            np.array([[0.0, 0.0, 0.1, 0.1]], np.float32), (8, 1))[None])
+        anc = mx.nd.array(np.array([[
+            [0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+            [0.1, 0.5, 0.4, 0.9], [0.6, 0.1, 0.9, 0.4]]], np.float32))
+        lab = mx.nd.array(np.array(
+            [[[1, 0.02, 0.02, 0.42, 0.41]]], np.float32))
+        # anchor 1 has high predicted fg prob -> hardest negative
+        cp = np.zeros((1, 3, 4), np.float32)
+        cp[0, 1, 1] = 0.9
+        cp[0, 1, 2] = 0.1
+        _, _, ct = mx.nd.contrib.MultiBoxTarget(
+            anc, lab, mx.nd.array(cp), negative_mining_ratio=1.0)
+        ct = ct.asnumpy()[0]
+        assert ct[0] == 2.0           # the positive
+        assert ct[1] == 0.0           # hardest negative kept as background
+        assert (ct == -1.0).sum() == 2  # the rest ignored
+
+
+class TestDetectionNMS:
+    def test_multibox_detection_nms(self):
+        anc = mx.nd.array(np.array([[
+            [0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52],
+            [0.6, 0.6, 0.9, 0.9]]], np.float32))
+        cp = np.zeros((1, 2, 3), np.float32)
+        cp[0, 1] = [0.9, 0.8, 0.7]  # one fg class
+        det = mx.nd.contrib.MultiBoxDetection(
+            mx.nd.array(cp), mx.nd.zeros((1, 12)), anc,
+            nms_threshold=0.5, nms_topk=3)
+        rows = det.asnumpy()[0]
+        kept = rows[rows[:, 0] >= 0]
+        assert kept.shape[0] == 2  # overlapping pair collapsed
+        np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9])
+
+    def test_box_nms_class_aware(self):
+        rows = np.array([[
+            [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+            [1, 0.8, 0.1, 0.1, 0.5, 0.5],   # same box, different class
+            [0, 0.7, 0.11, 0.11, 0.51, 0.51]]], np.float32)
+        out = mx.nd.contrib.box_nms(
+            mx.nd.array(rows), overlap_thresh=0.5, id_index=0).asnumpy()[0]
+        kept = out[out[:, 0] >= 0]
+        assert kept.shape[0] == 2  # class-aware: classes survive separately
+        forced = mx.nd.contrib.box_nms(
+            mx.nd.array(rows), overlap_thresh=0.5, id_index=0,
+            force_suppress=True).asnumpy()[0]
+        assert (forced[:, 0] >= 0).sum() == 1
+
+    def test_box_nms_valid_thresh_and_topk(self):
+        rows = np.array([[
+            [0, 0.9, 0.1, 0.1, 0.2, 0.2],
+            [0, 0.05, 0.4, 0.4, 0.5, 0.5],
+            [0, 0.8, 0.6, 0.6, 0.7, 0.7],
+            [0, 0.7, 0.8, 0.8, 0.9, 0.9]]], np.float32)
+        out = mx.nd.contrib.box_nms(
+            mx.nd.array(rows), valid_thresh=0.1, topk=2,
+            id_index=0).asnumpy()[0]
+        kept = out[out[:, 0] >= 0]
+        np.testing.assert_allclose(sorted(kept[:, 1]), [0.8, 0.9])
+
+
+class TestROIAlign:
+    def test_values_vs_naive(self):
+        h = w = 6
+        data_np = np.arange(h * w, dtype=np.float32).reshape(1, 1, h, w)
+        rois = np.array([[0, 1.0, 1.0, 5.0, 5.0]], np.float32)
+        out = mx.nd.contrib.ROIAlign(
+            mx.nd.array(data_np), mx.nd.array(rois),
+            pooled_size=(2, 2), spatial_scale=1.0, sample_ratio=2)
+        got = out.asnumpy()[0, 0]
+        assert got.shape == (2, 2)
+        # monotone ramp: pooled quadrants keep the ramp ordering
+        assert got[0, 0] < got[0, 1] < got[1, 1]
+        assert got[0, 0] < got[1, 0] < got[1, 1]
+
+    def test_gradient_flows(self):
+        data = mx.nd.array(np.random.RandomState(0).rand(1, 2, 8, 8)
+                           .astype(np.float32))
+        rois = mx.nd.array(np.array([[0, 1, 1, 6, 6]], np.float32))
+        data.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                                         spatial_scale=1.0)
+            loss = out.sum()
+        loss.backward()
+        g = data.grad.asnumpy()
+        assert np.abs(g).sum() > 0
+        # gradient mass concentrates inside the roi
+        assert np.abs(g[0, :, 2:6, 2:6]).sum() > 0.5 * np.abs(g).sum()
+
+
+class TestDetAugmenters:
+    def test_flip_boxes(self):
+        img = np.zeros((10, 10, 3), np.float32)
+        label = np.array([[1, 0.1, 0.2, 0.4, 0.6]], np.float32)
+        aug = mx.image.DetHorizontalFlipAug(p=1.0)
+        _, out = aug(img, label)
+        np.testing.assert_allclose(out[0], [1, 0.6, 0.2, 0.9, 0.6],
+                                   atol=1e-6)
+
+    def test_random_crop_keeps_valid_labels(self):
+        np.random.seed(0)
+        img = np.random.rand(40, 40, 3).astype(np.float32)
+        label = np.array([[0, 0.3, 0.3, 0.7, 0.7],
+                          [-1, 0, 0, 0, 0]], np.float32)
+        aug = mx.image.DetRandomCropAug(min_object_covered=0.5,
+                                        area_range=(0.5, 1.0))
+        for _ in range(10):
+            im2, lab2 = aug(img, label)
+            valid = lab2[lab2[:, 0] >= 0]
+            assert valid.shape[0] >= 1
+            assert (valid[:, 1:5] >= -1e-6).all()
+            assert (valid[:, 1:5] <= 1 + 1e-6).all()
+            assert (valid[:, 3] > valid[:, 1]).all()
+            assert (valid[:, 4] > valid[:, 2]).all()
+
+    def test_random_pad_shrinks_boxes(self):
+        np.random.seed(1)
+        img = np.full((20, 20, 3), 255, np.float32)
+        label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+        aug = mx.image.DetRandomPadAug(area_range=(2.0, 3.0))
+        im2, lab2 = aug(img, label)
+        assert im2.shape[0] >= 20 and im2.shape[1] >= 20
+        w = lab2[0, 3] - lab2[0, 1]
+        h = lab2[0, 4] - lab2[0, 2]
+        assert w < 1.0 or h < 1.0
+
+
+@pytest.fixture(scope="module")
+def det_dataset(tmp_path_factory):
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("det")
+    entries = []
+    rng = np.random.RandomState(3)
+    for i in range(12):
+        img = np.full((32, 32, 3), 30, np.uint8)
+        # one bright square object; label encodes its box
+        x0, y0 = rng.randint(2, 12, 2)
+        w, h = rng.randint(8, 16, 2)
+        img[y0:y0 + h, x0:x0 + w] = 220
+        Image.fromarray(img).save(root / f"d{i}.jpg", quality=95)
+        entries.append((np.array(
+            [[0, x0 / 32, y0 / 32, (x0 + w) / 32, (y0 + h) / 32]],
+            np.float32), f"d{i}.jpg"))
+    return str(root), entries
+
+
+class TestImageDetIter:
+    def test_batches(self, det_dataset):
+        root, entries = det_dataset
+        it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                                   imglist=entries, path_root=root)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        assert batch.label[0].shape == (4, 1, 5)
+        lab = batch.label[0].asnumpy()
+        assert (lab[:, 0, 0] == 0).all()
+        assert (lab[:, 0, 1:] >= 0).all() and (lab[:, 0, 1:] <= 1).all()
+
+    def test_epoch_and_augmented(self, det_dataset):
+        root, entries = det_dataset
+        it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                                   imglist=entries, path_root=root,
+                                   rand_mirror=True, rand_crop=0.5,
+                                   min_object_covered=0.5)
+        n = 0
+        for batch in it:
+            n += 1
+            if n > 10:
+                break
+        assert n == 3
+
+
+def test_ssd_smoke_train():
+    """A minimal SSD head (features -> cls/loc preds + priors + targets +
+    losses) trains one step end to end and detects."""
+    from mxnet_tpu import gluon
+
+    B, C_fg, H = 2, 3, 16
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(B, 3, H, H).astype(np.float32))
+    label = mx.nd.array(
+        np.array([[[0, 0.1, 0.1, 0.45, 0.5]],
+                  [[2, 0.5, 0.55, 0.9, 0.95]]], np.float32))
+
+    class TinySSD(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.feat = gluon.nn.Conv2D(16, 3, strides=2, padding=1,
+                                        activation="relu")
+            self.cls = gluon.nn.Conv2D(4 * (C_fg + 1), 3, padding=1)
+            self.loc = gluon.nn.Conv2D(4 * 4, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            f = self.feat(x)
+            anchors = F.contrib.MultiBoxPrior(
+                f, sizes=(0.3, 0.15), ratios=(1, 2, 0.5))
+            cp = self.cls(f).transpose((0, 2, 3, 1)).reshape(
+                (0, -1, C_fg + 1)).transpose((0, 2, 1))
+            lp = self.loc(f).transpose((0, 2, 3, 1)).reshape((0, -1))
+            return anchors, cp, lp
+
+    net = TinySSD()
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    losses = []
+    for step in range(3):
+        with mx.autograd.record():
+            anchors, cp, lp = net(x)
+            with mx.autograd.pause():
+                sm = mx.nd.softmax(cp, axis=1)
+                lt, lm, ct = mx.nd.contrib.MultiBoxTarget(
+                    anchors, label, sm, negative_mining_ratio=3.0)
+            l_cls = cls_loss(cp, ct)
+            l_loc = mx.nd.smooth_l1((lp - lt) * lm, scalar=1.0).mean()
+            loss = l_cls.mean() + l_loc
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+    # inference path
+    anchors, cp, lp = net(x)
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.softmax(cp, axis=1), lp, anchors, nms_topk=20)
+    assert det.shape[0] == B and det.shape[2] == 6
