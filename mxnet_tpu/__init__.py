@@ -58,7 +58,7 @@ def __getattr__(name):
         "util": ".util", "runtime": ".runtime", "test_utils": ".test_utils",
         "executor": ".executor", "monitor": ".monitor",
         "visualization": ".visualization", "contrib": ".contrib",
-        "engine": ".engine",
+        "engine": ".engine", "operator": ".operator",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

@@ -61,7 +61,9 @@ class Initializer:
     def __call__(self, desc, arr):
         if not isinstance(desc, str):
             raise TypeError("call signature: (InitDesc, NDArray)")
-        if desc.endswith("weight"):
+        if desc.endswith("parameters"):  # fused RNN flat param vector
+            self._init_rnn(desc, arr)
+        elif desc.endswith("weight"):
             self._init_weight(desc, arr)
         elif desc.endswith("bias"):
             self._init_bias(desc, arr)
@@ -84,6 +86,16 @@ class Initializer:
 
     def _init_bias(self, _, arr):
         arr[:] = 0.0
+
+    def _init_rnn(self, name, arr):
+        """Fused RNN parameter vectors (sym.RNN `*_parameters`) are flat
+        (gates x in/hidden weights + biases); 2-D initializers can't apply
+        shape heuristics, so use the reference's FusedRNN default: small
+        uniform (initializer.py InitRNN pattern)."""
+        from . import random as _random
+
+        scale = 0.07
+        arr[:] = _random.uniform(-scale, scale, arr.shape)
 
     def _init_gamma(self, _, arr):
         arr[:] = 1.0

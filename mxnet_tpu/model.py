@@ -83,7 +83,14 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             kvstore.pull(name, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
-            updates[k].append((index * num_device + k, g, w))
+            # Key optimizer state by NAME when names are known: positional
+            # indices are not stable across modules that share one updater
+            # (BucketingModule buckets may order arguments differently).
+            if param_names is not None and num_device == 1:
+                key = param_names[index]
+            else:
+                key = index * num_device + k
+            updates[k].append((key, g, w))
     for dev_updates in updates:
         for upd in dev_updates:
             updater(*upd)

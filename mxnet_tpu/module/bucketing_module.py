@@ -1,0 +1,201 @@
+"""BucketingModule — variable-length training over bucketed shapes.
+
+Capability parity with python/mxnet/module/bucketing_module.py:40. The
+reference binds one executor group per bucket against shared memory; here
+each bucket is a Module whose shape-specialized XLA executables live in the
+per-bucket executor cache (SURVEY.md §7 hard part 3: dynamic shapes →
+shape-keyed executable caches), and parameters are kept coherent by syncing
+the live values into a bucket's module on every switch — the optimizer
+state lives in a single shared Updater keyed by parameter name, so momentum
+etc. follow the parameters across buckets.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self._params_dirty = False
+        self._monitor = None
+
+    # ------------------------------------------------------------- helpers
+    def _gen_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names=data_names,
+                      label_names=label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    # ---------------------------------------------------------------- bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        assert shared_module is None, \
+            "shared_module is not supported for BucketingModule"
+        self.binded = True
+        self.for_training = for_training
+        self._grad_req = grad_req
+        self._inputs_need_grad = inputs_need_grad
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False, grad_req=grad_req)
+        self._buckets = {self._default_bucket_key: module}
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Make `bucket_key` current, binding its module on first use
+        (bucketing_module.py:switch_bucket)."""
+        assert self.binded, "call bind before switching buckets"
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self._inputs_need_grad, force_rebind=False,
+                        grad_req=self._grad_req)
+            if self.params_initialized:
+                module.init_params(arg_params=self._arg_snapshot(),
+                                   aux_params=self._aux_snapshot(),
+                                   allow_missing=False, force_init=True)
+            if getattr(self._curr_module, 'optimizer_initialized', False):
+                module.borrow_optimizer(self._curr_module)
+            self._buckets[bucket_key] = module
+        if bucket_key != self._curr_bucket_key:
+            # carry the live parameter values into the target bucket
+            new_module = self._buckets[bucket_key]
+            if self.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                new_module.set_params(arg, aux, allow_missing=False,
+                                      force_init=True)
+            self._curr_module = new_module
+            self._curr_bucket_key = bucket_key
+            if self._monitor is not None:
+                self._curr_module.install_monitor(self._monitor)
+
+    def _arg_snapshot(self):
+        return self._curr_module.get_params()[0]
+
+    def _aux_snapshot(self):
+        return self._curr_module.get_params()[1]
+
+    # -------------------------------------------------------------- params
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        self._curr_module.init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._curr_module.set_params(arg_params, aux_params,
+                                     allow_missing=allow_missing,
+                                     force_init=force_init,
+                                     allow_extra=allow_extra)
+        self.params_initialized = True
+
+    # ----------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params,
+                                         force_init=force_init)
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod.borrow_optimizer(self._curr_module)
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------- compute
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key", None)
+        if bucket_key is None:
+            bucket_key = self._default_bucket_key
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        self._monitor = mon
+        self._curr_module.install_monitor(mon)
+
+    def save_optimizer_states(self, fname):
+        self._curr_module.save_optimizer_states(fname)
+
+    def load_optimizer_states(self, fname):
+        self._curr_module.load_optimizer_states(fname)

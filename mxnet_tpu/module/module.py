@@ -105,9 +105,18 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
+        """Full-batch output shapes, inferred from the bound input shapes
+        (NOT from per-device executors, whose batch dim is the per-context
+        slice — the reference reports the concatenated shape)."""
         assert self.binded
-        return [(n, tuple(o.shape)) for n, o in
-                zip(self._output_names, self._execs[0].outputs)]
+        shapes = {}
+        for desc in list(self._data_shapes) + list(self._label_shapes or []):
+            name = desc[0] if isinstance(desc, (tuple, list)) else desc.name
+            shape = (tuple(desc[1]) if isinstance(desc, (tuple, list))
+                     else tuple(desc.shape))
+            shapes[name] = shape
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
 
     # ----------------------------------------------------------------- bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -264,6 +273,7 @@ class Module(BaseModule):
         if not update_on_kvstore:
             self._updater = opt.get_updater(self._optimizer)
         self.optimizer_initialized = True
+
 
     # ------------------------------------------------------------ execution
     def forward(self, data_batch, is_train=None):

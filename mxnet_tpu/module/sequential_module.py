@@ -1,0 +1,149 @@
+"""SequentialModule — chain Modules head-to-tail.
+
+Capability parity with python/mxnet/module/sequential_module.py: each
+child consumes the previous child's outputs as data; backward feeds input
+gradients upstream. Used to compose a symbolic body with e.g. a
+PythonLossModule head.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+from .base_module import BaseModule
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        return self._modules[0].data_names
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names
+
+    @property
+    def data_shapes(self):
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        for m in self._modules:
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=True,
+                          force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        if not self._modules:
+            raise MXNetError("SequentialModule is empty; call add() first")
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, False)
+            m.bind(cur_shapes,
+                   label_shapes if take_labels else None,
+                   for_training=for_training,
+                   inputs_need_grad=inputs_need_grad or i > 0,
+                   force_rebind=force_rebind, grad_req=grad_req)
+            # next module consumes this one's outputs, renamed to its
+            # data_names (META_AUTO_WIRING semantics)
+            if i + 1 < len(self._modules):
+                nxt = self._modules[i + 1]
+                cur_shapes = [
+                    DataDesc(name, shape) for name, (_, shape) in
+                    zip(nxt.data_names, m.output_shapes)]
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        batch = data_batch
+        for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
+            m.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            take_labels = self._metas[i + 1].get(self.META_TAKE_LABELS, False)
+            batch = DataBatch(
+                data=m.get_outputs(),
+                label=data_batch.label if take_labels else None,
+                pad=data_batch.pad,
+                provide_data=[DataDesc(n, o.shape) for n, o in zip(
+                    self._modules[i + 1].data_names, m.get_outputs())],
+                provide_label=(data_batch.provide_label
+                               if take_labels else None))
+
+    def backward(self, out_grads=None):
+        grads = out_grads
+        for i in range(len(self._modules) - 1, -1, -1):
+            m = self._modules[i]
+            m.backward(out_grads=grads)
+            if i > 0:  # the bottom module's input grads are never consumed
+                grads = m.get_input_grads()
+
+    def update(self):
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for m, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                m.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        for m in self._modules:
+            m.install_monitor(mon)

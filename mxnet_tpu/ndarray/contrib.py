@@ -1,7 +1,10 @@
-"""mx.nd.contrib — short names for `_contrib_*` registered ops.
+"""mx.nd.contrib — short names for `_contrib_*` registered ops, plus eager
+control flow (foreach / while_loop / cond).
 
-Parity: python/mxnet/ndarray/contrib.py (the reference generates this
-namespace from op names prefixed `_contrib_`; same rule here).
+Parity: python/mxnet/ndarray/contrib.py — the reference's eager control
+flow is likewise a Python loop over array slices (contrib.py foreach :216,
+while_loop :361, cond :529); the symbolic counterparts in
+symbol/contrib.py lower to lax.scan/while_loop/cond.
 """
 from __future__ import annotations
 
@@ -9,6 +12,103 @@ import sys as _sys
 
 _MODULE = _sys.modules[__name__]
 _PREFIX = "_contrib_"
+
+
+def _listify(x):
+    if x is None:
+        return [], False
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def foreach(body, data, init_states, name=None):
+    """Eager scan: body(data_slice, states) -> (outputs, new_states);
+    returns (stacked_outputs, final_states)."""
+    from . import stack
+
+    from ..base import MXNetError
+
+    data_list, data_is_list = _listify(data)
+    states, state_is_list = _listify(init_states)
+    n = data_list[0].shape[0]
+    if n == 0:
+        raise MXNetError("foreach over zero-length data: output shapes are "
+                         "unknowable eagerly (the symbolic foreach handles "
+                         "this via lax.scan)")
+    collected = None
+    out_is_list = False
+    for i in range(n):
+        slices = [d[i] for d in data_list]
+        outs, states_new = body(
+            slices if data_is_list else slices[0],
+            states if state_is_list else (states[0] if states else []))
+        out_list, out_is_list = _listify(outs)
+        states, _ = _listify(states_new)
+        if collected is None:
+            collected = [[] for _ in out_list]
+        for k, o in enumerate(out_list):
+            collected[k].append(o)
+    stacked = [stack(*c, axis=0) for c in (collected or [])]
+    return (stacked if out_is_list else stacked[0],
+            states if state_is_list else (states[0] if states else []))
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """Eager while loop; step outputs are stacked and zero-padded to
+    max_iterations rows (reference contract)."""
+    from ..base import MXNetError
+    from . import concat, stack, zeros
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    states, state_is_list = _listify(loop_vars)
+    collected = None
+    out_is_list = False
+    steps = 0
+    while steps < max_iterations and bool(
+            cond(*states).asnumpy().reshape(-1)[0]):
+        outs, new_states = func(*states)
+        out_list, out_is_list = _listify(outs)
+        states, _ = _listify(new_states)
+        if collected is None:
+            collected = [[] for _ in out_list]
+        for k, o in enumerate(out_list):
+            collected[k].append(o)
+        steps += 1
+    if collected is None:
+        # Zero iterations: probe func once (result discarded) purely to
+        # learn the output structure, then return all-zero buffers matching
+        # the symbolic while_loop's fixed-buffer semantics. The probe runs
+        # the body outside the loop guard; a body that is invalid there
+        # surfaces as this error instead.
+        try:
+            outs, _ = func(*states)
+        except Exception as e:
+            raise MXNetError(
+                "while_loop made zero iterations and the output shapes "
+                f"could not be probed (body raised: {e})") from e
+        out_list, out_is_list = _listify(outs)
+        zero_bufs = [zeros((max_iterations,) + tuple(o.shape),
+                           dtype=o.dtype) for o in out_list]
+        return (zero_bufs if out_is_list else zero_bufs[0],
+                states if state_is_list else states[0])
+    stacked = []
+    for c in collected:
+        s = stack(*c, axis=0)
+        if steps < max_iterations:
+            pad = zeros((max_iterations - steps,) + tuple(c[0].shape),
+                        dtype=c[0].dtype)
+            s = concat(s, pad, dim=0)
+        stacked.append(s)
+    return (stacked if out_is_list else stacked[0],
+            states if state_is_list else states[0])
+
+
+def cond(pred, then_func, else_func, name=None):
+    """Eager conditional: pred is a boolean scalar NDArray."""
+    taken = bool(pred.asnumpy().reshape(-1)[0])
+    return then_func() if taken else else_func()
 
 
 def _resolve(name):

@@ -1,0 +1,223 @@
+"""mx.operator — the Python custom-operator escape hatch.
+
+Capability parity with python/mxnet/operator.py:435-711 (CustomOp,
+CustomOpProp, register; backed in the reference by the CustomOperator
+callback thread, src/operator/custom/custom-inl.h:52). The TPU-native
+design follows SURVEY.md §2.2 custom/: the user's numpy forward/backward
+run on the host behind `jax.pure_callback`, and a `jax.custom_vjp` pairs
+them so the op composes with autograd, jit, and the symbolic executor —
+one mechanism for every frontend instead of the reference's per-engine
+dispatch.
+
+Example (the reference's tutorial op)::
+
+    @mx.operator.register("sigmoid")
+    class SigmoidProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ['data']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes): return Sigmoid()
+
+    y = mx.nd.Custom(x, op_type="sigmoid")
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_PROPS: dict[str, type] = {}
+
+
+class CustomOp:
+    """User-defined forward/backward over host numpy-backed NDArrays
+    (operator.py:435)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign `src` to `dst` honoring the write/add/null request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Op metadata + factory (operator.py:~520)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],), ()
+
+    def infer_type(self, in_type):
+        return (in_type, (in_type[0],) * len(self.list_outputs()),
+                (in_type[0],) * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under `op_type`
+    (operator.py:register :711)."""
+
+    def do_register(prop_cls):
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_prop(op_type):
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError(f"custom op {op_type!r} is not registered "
+                         f"(known: {sorted(_CUSTOM_PROPS)})")
+    return _CUSTOM_PROPS[op_type]
+
+
+class _HostArray:
+    """Minimal NDArray-like view handed to CustomOp methods: numpy storage
+    with the small API surface custom ops use (asnumpy, shape, dtype,
+    slicing assignment)."""
+
+    __slots__ = ("_a",)
+
+    def __init__(self, arr):
+        self._a = _np.asarray(arr)
+
+    def asnumpy(self):
+        return self._a
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def __getitem__(self, k):
+        return self._a[k]
+
+    def __setitem__(self, k, v):
+        self._a[k] = _np.asarray(getattr(v, "_a", v))
+
+    def __array__(self, dtype=None, copy=None):
+        return self._a if dtype is None else self._a.astype(dtype)
+
+
+def _as_str_kwargs(kwargs):
+    """The reference passes Custom kwargs to the Prop as strings."""
+    return {k: str(v) for k, v in kwargs.items()}
+
+
+def _custom_nout(params):
+    kwargs = {k: v for k, v in params.items()
+              if k not in ("op_type", "_train")}
+    prop = get_prop(params["op_type"])(**_as_str_kwargs(kwargs))
+    return len(prop.list_outputs())
+
+
+@_register_op("Custom", num_outputs=_custom_nout)
+def _custom(*inputs, op_type, _train=False, **kwargs):
+    """The `Custom` operator (reference src/operator/custom/custom.cc):
+    dispatches to the registered CustomOpProp/CustomOp pair via
+    pure_callback + custom_vjp."""
+    import jax
+    import jax.numpy as jnp
+
+    prop = get_prop(op_type)(**_as_str_kwargs(kwargs))
+    if prop.list_auxiliary_states():
+        raise MXNetError("custom ops with auxiliary states are not "
+                         "supported on the TPU backend (v1)")
+    n_in = len(prop.list_arguments())
+    if len(inputs) != n_in:
+        raise MXNetError(f"custom op {op_type!r} expects {n_in} inputs "
+                         f"({prop.list_arguments()}), got {len(inputs)}")
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [x.dtype for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    n_out = len(out_shapes)
+    out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                      for s, d in zip(out_shapes, out_types))
+    in_specs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                     for s, d in zip(in_shapes, in_types))
+
+    def fwd_host(*arrs):
+        op = prop.create_operator(None, [a.shape for a in arrs],
+                                  [a.dtype for a in arrs])
+        in_data = [_HostArray(a) for a in arrs]
+        out_data = [_HostArray(_np.zeros(s, d))
+                    for s, d in zip(out_shapes, out_types)]
+        op.forward(bool(_train), ["write"] * n_out, in_data, out_data, [])
+        outs = tuple(o.asnumpy().astype(d) for o, d in
+                     zip(out_data, out_types))
+        return outs if n_out > 1 else outs[0]
+
+    def bwd_host(*arrs):
+        xs = arrs[:n_in]
+        ys = arrs[n_in:n_in + n_out]
+        gys = arrs[n_in + n_out:]
+        op = prop.create_operator(None, [a.shape for a in xs],
+                                  [a.dtype for a in xs])
+        in_data = [_HostArray(a) for a in xs]
+        out_data = [_HostArray(a) for a in ys]
+        out_grad = [_HostArray(a) for a in gys]
+        in_grad = [_HostArray(_np.zeros(a.shape, a.dtype)) for a in xs]
+        op.backward(["write"] * n_in, out_grad, in_data, out_data,
+                    in_grad, [])
+        gxs = tuple(g.asnumpy().astype(x.dtype)
+                    for g, x in zip(in_grad, xs))
+        return gxs if n_in > 1 else gxs[0]
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(
+            fwd_host, out_specs if n_out > 1 else out_specs[0], *xs)
+
+    def f_fwd(*xs):
+        ys = f(*xs)
+        return ys, (xs, ys if n_out > 1 else (ys,))
+
+    def f_bwd(res, gys):
+        xs, ys = res
+        gys = gys if isinstance(gys, tuple) else (gys,)
+        gxs = jax.pure_callback(
+            bwd_host, in_specs if n_in > 1 else in_specs[0],
+            *xs, *ys, *gys)
+        return gxs if n_in > 1 else (gxs,)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(*inputs)

@@ -1,0 +1,148 @@
+"""Symbolic control-flow operators: _foreach, _while_loop, _cond.
+
+Capability parity with the reference's src/operator/control_flow.cc
+(`_foreach` :1089, `_while_loop` :1150, `_cond` :1211), which execute nnvm
+subgraphs under imperative loops. The TPU-native design lowers each to the
+matching XLA structured-control-flow primitive — `lax.scan`,
+`lax.while_loop`, `lax.cond` — so a 1000-step RNN loop compiles to ONE
+compact HLO While instead of 1000 unrolled steps, and reverse-mode autodiff
+through the loop comes from jax.vjp for free (the reference hand-writes
+LoopState backward bookkeeping).
+
+Subgraphs are interpreted programs built by symbol/contrib.py (via
+executor._graph_program) and stashed in a process-local side table; op
+params carry only the table key plus (subgraph_arg_pos, role_index) maps,
+keeping params hashable for the executable caches.
+
+Node-input layout conventions (established by symbol/contrib.py):
+  _foreach:     [data..., states..., body frees...]
+  _while_loop:  [states..., body frees..., cond frees...]
+  _cond:        [input vars... (union over pred/then/else)]
+Each subgraph's argument vector is filled through its `(argpos, idx)` maps;
+a subgraph that ignores a loop state simply has no map entry for it.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .registry import register
+
+_SUBGRAPHS: dict[int, object] = {}
+_next_id = itertools.count()
+
+
+def stash_subgraph(pure_fn, n_args):
+    """Register a traced subgraph program; returns its table key."""
+    key = next(_next_id)
+    _SUBGRAPHS[key] = (pure_fn, n_args)
+    return key
+
+
+def _argv(n_args, *maps_and_sources):
+    """Build a subgraph argument vector from (map, source) pairs, where map
+    is a tuple of (argpos, source_idx)."""
+    argv = [None] * n_args
+    for m, src in maps_and_sources:
+        for argpos, idx in m:
+            argv[argpos] = src[idx]
+    return argv
+
+
+@register("_foreach",
+          num_outputs=lambda p: p["_n_out"] + p["_n_state"])
+def _foreach(*inputs, _sub, _n_data, _n_state, _n_out, _data_map,
+             _state_map, _free_map, _train=False):
+    """Scan the subgraph over axis 0 of the data inputs; returns
+    (*stacked_step_outputs, *final_states)."""
+    from jax import lax
+
+    pure_fn, n_args = _SUBGRAPHS[_sub]
+    data = tuple(inputs[:_n_data])
+    states = tuple(inputs[_n_data:_n_data + _n_state])
+    free = tuple(inputs[_n_data + _n_state:])
+
+    def step(carry, xs):
+        argv = _argv(n_args, (_data_map, xs), (_state_map, carry),
+                     (_free_map, free))
+        outs, _ = pure_fn(argv, [], _train)
+        return tuple(outs[_n_out:]), tuple(outs[:_n_out])
+
+    final, ys = lax.scan(step, states, data)
+    return (*ys, *final)
+
+
+@register("_while_loop",
+          num_outputs=lambda p: p["_n_out"] + p["_n_state"])
+def _while_loop(*inputs, _cond_sub, _body_sub, _n_state, _n_body_free,
+                _n_out, _max_iterations, _body_state_map, _body_free_map,
+                _cond_state_map, _cond_free_map, _train=False):
+    """lax.while_loop with fixed-size output buffers.
+
+    Per-step outputs are written into (max_iterations, ...) buffers (rows
+    past the realized iteration count stay zero — the reference pads
+    identically). Returns (*output_buffers, *final_states).
+    """
+    import jax.numpy as jnp
+    from jax import eval_shape, lax
+
+    body_fn, n_body_args = _SUBGRAPHS[_body_sub]
+    cond_fn, n_cond_args = _SUBGRAPHS[_cond_sub]
+    states = tuple(inputs[:_n_state])
+    body_free = tuple(inputs[_n_state:_n_state + _n_body_free])
+    cond_free = tuple(inputs[_n_state + _n_body_free:])
+
+    def run_cond(carry):
+        argv = _argv(n_cond_args, (_cond_state_map, carry),
+                     (_cond_free_map, cond_free))
+        outs, _ = cond_fn(argv, [], _train)
+        return outs[0].reshape(()).astype(bool)
+
+    def run_body(carry):
+        argv = _argv(n_body_args, (_body_state_map, carry),
+                     (_body_free_map, body_free))
+        outs, _ = body_fn(argv, [], _train)
+        return tuple(outs[:_n_out]), tuple(outs[_n_out:])
+
+    out_shapes = eval_shape(lambda c: run_body(c)[0], states)
+    bufs = tuple(jnp.zeros((_max_iterations,) + tuple(s.shape), s.dtype)
+                 for s in out_shapes)
+
+    def cond_w(val):
+        i, carry, _ = val
+        return (i < _max_iterations) & run_cond(carry)
+
+    def body_w(val):
+        i, carry, bufs = val
+        outs, new_carry = run_body(carry)
+        bufs = tuple(b.at[i].set(o) for b, o in zip(bufs, outs))
+        return i + 1, new_carry, bufs
+
+    _, final, bufs = lax.while_loop(
+        cond_w, body_w, (jnp.asarray(0, jnp.int32), states, bufs))
+    return (*bufs, *final)
+
+
+@register("_cond", num_outputs=lambda p: p["_n_out"])
+def _cond(*inputs, _pred_sub, _then_sub, _else_sub, _pred_map, _then_map,
+          _else_map, _n_out, _train=False):
+    """lax.cond over then/else subgraphs (both produce `_n_out` outputs of
+    identical shapes/dtypes)."""
+    from jax import lax
+
+    pred_fn, n_pred = _SUBGRAPHS[_pred_sub]
+    then_fn, n_then = _SUBGRAPHS[_then_sub]
+    else_fn, n_else = _SUBGRAPHS[_else_sub]
+
+    pred_outs, _ = pred_fn(_argv(n_pred, (_pred_map, inputs)), [], _train)
+    pred = pred_outs[0].reshape(()).astype(bool)
+
+    def then_branch(ins):
+        outs, _ = then_fn(_argv(n_then, (_then_map, ins)), [], _train)
+        return tuple(outs[:_n_out])
+
+    def else_branch(ins):
+        outs, _ = else_fn(_argv(n_else, (_else_map, ins)), [], _train)
+        return tuple(outs[:_n_out])
+
+    outs = lax.cond(pred, then_branch, else_branch, tuple(inputs))
+    return outs if len(outs) > 1 else outs[0]

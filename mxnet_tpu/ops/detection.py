@@ -237,8 +237,9 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     variances = tuple(float(v) for v in _listify(variances))
     anc = anchor.reshape(-1, 4)
     n = anc.shape[0]
-    k = int(nms_topk) if nms_topk and nms_topk > 0 else min(n, 400)
-    k = min(k, n)
+    # nms_topk<=0 means "no cap" (reference semantics); passing a topk is
+    # the perf lever — it bounds the O(k^2) pairwise-IoU NMS buffer.
+    k = min(int(nms_topk), n) if nms_topk and nms_topk > 0 else n
 
     def one(cprob, lpred):
         # class & score per anchor (background excluded)

@@ -1,14 +1,265 @@
-"""mx.sym.contrib — short names for `_contrib_*` registered ops.
+"""mx.sym.contrib — short names for `_contrib_*` registered ops, plus the
+symbolic control-flow builders (foreach / while_loop / cond).
 
-Parity: python/mxnet/symbol/contrib.py (generated from `_contrib_`-prefixed
-op names).
+Parity: python/mxnet/symbol/contrib.py (generated `_contrib_` creators; the
+control-flow builders mirror its foreach :136, while_loop :276, cond :425 —
+subgraph construction via placeholder variables, free-variable capture from
+the enclosing scope). Execution lowers to lax.scan/while_loop/cond through
+ops/control_flow.py.
 """
 from __future__ import annotations
 
 import sys as _sys
 
+from ..base import MXNetError
+
 _MODULE = _sys.modules[__name__]
 _PREFIX = "_contrib_"
+
+
+def _listify(x):
+    if x is None:
+        return [], False
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _cut_subgraph(group_sym, boundary, name):
+    """Cut the subgraph at pre-existing computed nodes.
+
+    Any edge from a node created inside the control-flow body (serial >=
+    boundary) to a pre-boundary *computed* node is replaced by a fresh
+    placeholder Variable; the outer value is evaluated once in the
+    enclosing graph and fed in as a loop input (the reference's
+    _cut_subgraph does the same for captured symbols). Pre-boundary
+    Variables are left in place — they become ordinary free arguments and
+    keep weight sharing by name. Mutates `group_sym` in place and returns
+    {placeholder_name: outer_ref_symbol}.
+    """
+    from .symbol import Symbol, Variable
+
+    cut_map = {}   # (id(node), slot) -> (var_node, outer_ref)
+
+    def cut_edge(inode, islot):
+        key = (id(inode), islot)
+        if key not in cut_map:
+            v = Variable(f"{name}_cut{len(cut_map)}")
+            cut_map[key] = (v._outputs[0][0], Symbol([(inode, islot)]))
+        return cut_map[key][0]
+
+    # outputs that point straight at outer computation get cut too
+    new_outputs = []
+    for node, slot in group_sym._outputs:
+        if node.serial < boundary and not node.is_var:
+            new_outputs.append((cut_edge(node, slot), 0))
+        else:
+            new_outputs.append((node, slot))
+    group_sym._outputs = new_outputs
+
+    seen = set()
+    stack = [n for n, _ in group_sym._outputs]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen or node.serial < boundary:
+            continue
+        seen.add(id(node))
+        for k, (inode, islot) in enumerate(list(node.inputs)):
+            if inode.serial < boundary and not inode.is_var:
+                node.inputs[k] = (cut_edge(inode, islot), 0)
+            elif inode.serial >= boundary:
+                stack.append(inode)
+    return {vn.name: ref for vn, ref in cut_map.values()}
+
+
+def _subgraph_program(group_sym):
+    """Trace a subgraph Symbol into an interpreted program; returns
+    (table_key, arg_names, var_nodes_by_name)."""
+    from ..executor import _graph_program
+    from ..ops.control_flow import stash_subgraph
+
+    pure_fn, arg_names, aux_names, _ = _graph_program(group_sym)
+    if aux_names:
+        raise MXNetError(
+            "control-flow subgraphs cannot mutate auxiliary state "
+            f"(found {aux_names}); move stateful ops out of the loop body")
+    var_nodes = {}
+    for node in group_sym._topo_nodes():
+        if node.is_var:
+            var_nodes[node.name] = node
+    key = stash_subgraph(pure_fn, len(arg_names))
+    return key, arg_names, var_nodes
+
+
+def _role_maps(arg_names, placeholder_names):
+    """Split subgraph args into placeholder roles and free variables.
+
+    Returns (maps, free_names): maps[role_name] = tuple of
+    (argpos, role_idx); free_names = subgraph args that are not
+    placeholders, in arg order.
+    """
+    name_to_role = {}
+    for role, names in placeholder_names.items():
+        for i, n in enumerate(names):
+            name_to_role[n] = (role, i)
+    maps = {role: [] for role in placeholder_names}
+    free_names = []
+    for pos, n in enumerate(arg_names):
+        if n in name_to_role:
+            role, i = name_to_role[n]
+            maps[role].append((pos, i))
+        else:
+            free_names.append((pos, n))
+    return {r: tuple(m) for r, m in maps.items()}, free_names
+
+
+def _free_ref(n, var_nodes, cut_refs):
+    from .symbol import Symbol
+
+    return cut_refs.get(n) or Symbol([(var_nodes[n], 0)])
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan `body` over axis 0 of `data` (sym.contrib.foreach parity).
+
+    body(data_slice, states) -> (step_outputs, next_states); returns
+    (stacked_outputs, final_states) with the same nesting as the inputs.
+    Lowers to one lax.scan (HLO While), not an unrolled graph.
+    """
+    from .symbol import (Symbol, Variable, _create, node_serial_watermark)
+
+    boundary = node_serial_watermark()
+    data_list, data_is_list = _listify(data)
+    state_list, state_is_list = _listify(init_states)
+    data_ph = [Variable(f"{name}_data{i}") for i in range(len(data_list))]
+    state_ph = [Variable(f"{name}_state{i}") for i in range(len(state_list))]
+    outs, out_states = body(
+        data_ph if data_is_list else data_ph[0],
+        state_ph if state_is_list else (state_ph[0] if state_ph else []))
+    out_list, out_is_list = _listify(outs)
+    out_state_list, _ = _listify(out_states)
+    if len(out_state_list) != len(state_list):
+        raise MXNetError("foreach body must return as many states as "
+                         "init_states")
+    from .symbol import Group
+
+    sub = Group(out_list + out_state_list)
+    cut_refs = _cut_subgraph(sub, boundary, name)
+    key, arg_names, var_nodes = _subgraph_program(sub)
+    maps, free = _role_maps(arg_names, {
+        "data": [p._outputs[0][0].name for p in data_ph],
+        "state": [p._outputs[0][0].name for p in state_ph],
+    })
+    params = {
+        "_sub": key, "_n_data": len(data_list), "_n_state": len(state_list),
+        "_n_out": len(out_list), "_data_map": maps["data"],
+        "_state_map": maps["state"],
+        "_free_map": tuple((pos, k) for k, (pos, _) in enumerate(free)),
+    }
+    inputs = (data_list + state_list +
+              [_free_ref(n, var_nodes, cut_refs) for _, n in free])
+    node_sym = _create("_foreach", inputs, params, name=name)
+    n_out = len(out_list)
+    outs_syms = [node_sym[i] for i in range(n_out)]
+    state_syms = [node_sym[n_out + i] for i in range(len(state_list))]
+    return (outs_syms if out_is_list else outs_syms[0],
+            state_syms if state_is_list else
+            (state_syms[0] if state_syms else []))
+
+
+def while_loop(cond, func, loop_vars, max_iterations, name="while_loop"):
+    """sym.contrib.while_loop parity: cond(*loop_vars) -> scalar;
+    func(*loop_vars) -> (step_outputs, new_loop_vars). Step outputs are
+    stacked into (max_iterations, ...) buffers (tail rows zero)."""
+    from .symbol import (Group, Symbol, Variable, _create,
+                         node_serial_watermark)
+
+    boundary = node_serial_watermark()
+    state_list, state_is_list = _listify(loop_vars)
+    ph = [Variable(f"{name}_var{i}") for i in range(len(state_list))]
+    ph_args = ph if state_is_list else ph[0]
+    cond_out = cond(*ph) if state_is_list else cond(ph_args)
+    outs, new_states = func(*ph) if state_is_list else func(ph_args)
+    out_list, out_is_list = _listify(outs)
+    new_state_list, _ = _listify(new_states)
+    if len(new_state_list) != len(state_list):
+        raise MXNetError("while_loop func must return as many loop_vars")
+
+    ph_names = [p._outputs[0][0].name for p in ph]
+    body_sub = Group(out_list + new_state_list)
+    body_cuts = _cut_subgraph(body_sub, boundary, name + "_body")
+    body_key, body_args, body_vars = _subgraph_program(body_sub)
+    body_maps, body_free = _role_maps(body_args, {"state": ph_names})
+    cond_sub = Group([cond_out])
+    cond_cuts = _cut_subgraph(cond_sub, boundary, name + "_cond")
+    cond_key, cond_args, cond_vars = _subgraph_program(cond_sub)
+    cond_maps, cond_free = _role_maps(cond_args, {"state": ph_names})
+
+    params = {
+        "_cond_sub": cond_key, "_body_sub": body_key,
+        "_n_state": len(state_list), "_n_body_free": len(body_free),
+        "_n_out": len(out_list), "_max_iterations": int(max_iterations),
+        "_body_state_map": body_maps["state"],
+        "_body_free_map": tuple(
+            (pos, k) for k, (pos, _) in enumerate(body_free)),
+        "_cond_state_map": cond_maps["state"],
+        "_cond_free_map": tuple(
+            (pos, k) for k, (pos, _) in enumerate(cond_free)),
+    }
+    inputs = (state_list +
+              [_free_ref(n, body_vars, body_cuts) for _, n in body_free] +
+              [_free_ref(n, cond_vars, cond_cuts) for _, n in cond_free])
+    node_sym = _create("_while_loop", inputs, params, name=name)
+    n_out = len(out_list)
+    outs_syms = [node_sym[i] for i in range(n_out)]
+    state_syms = [node_sym[n_out + i] for i in range(len(state_list))]
+    return (outs_syms if out_is_list else outs_syms[0],
+            state_syms if state_is_list else state_syms[0])
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """sym.contrib.cond parity: `pred` is a scalar Symbol; then_func() and
+    else_func() build branches with identical output structure."""
+    from .symbol import Group, Symbol, _create, node_serial_watermark
+
+    boundary = node_serial_watermark()
+    then_out = then_func()
+    else_out = else_func()
+    then_list, then_is_list = _listify(then_out)
+    else_list, _ = _listify(else_out)
+    if len(then_list) != len(else_list):
+        raise MXNetError("cond branches must have the same number of outputs")
+
+    pred_sub = Group([pred])
+    pred_cuts = _cut_subgraph(pred_sub, boundary, name + "_pred")
+    pred_key, pred_args, pred_vars = _subgraph_program(pred_sub)
+    then_sub = Group(then_list)
+    then_cuts = _cut_subgraph(then_sub, boundary, name + "_then")
+    then_key, then_args, then_vars = _subgraph_program(then_sub)
+    else_sub = Group(else_list)
+    else_cuts = _cut_subgraph(else_sub, boundary, name + "_else")
+    else_key, else_args, else_vars = _subgraph_program(else_sub)
+
+    inputs = []
+    pred_map, then_map, else_map = [], [], []
+    for argpos, n in enumerate(pred_args):
+        pred_map.append((argpos, len(inputs)))
+        inputs.append(_free_ref(n, pred_vars, pred_cuts))
+    for argpos, n in enumerate(then_args):
+        then_map.append((argpos, len(inputs)))
+        inputs.append(_free_ref(n, then_vars, then_cuts))
+    for argpos, n in enumerate(else_args):
+        else_map.append((argpos, len(inputs)))
+        inputs.append(_free_ref(n, else_vars, else_cuts))
+
+    params = {
+        "_pred_sub": pred_key, "_then_sub": then_key, "_else_sub": else_key,
+        "_pred_map": tuple(pred_map), "_then_map": tuple(then_map),
+        "_else_map": tuple(else_map), "_n_out": len(then_list),
+    }
+    node_sym = _create("_cond", inputs, params, name=name)
+    outs = [node_sym[i] for i in range(len(then_list))]
+    return outs if then_is_list else outs[0]
 
 
 def __getattr__(name):

@@ -34,10 +34,22 @@ def _auto_name(kind):
     return f"{kind}{i}"
 
 
+import itertools as _itertools
+
+_node_serial = _itertools.count()
+
+
+def node_serial_watermark():
+    """Current creation-order watermark; nodes created after this call have
+    serial >= the returned value (used by symbol.contrib subgraph cutting)."""
+    return next(_node_serial)
+
+
 class _Node:
     """One graph node: a variable or an op application."""
 
-    __slots__ = ("op", "name", "params", "inputs", "attrs", "aux_mark")
+    __slots__ = ("op", "name", "params", "inputs", "attrs", "aux_mark",
+                 "serial")
 
     def __init__(self, op, name, params=None, inputs=None, attrs=None):
         self.op = op              # None for variables, else canonical op name
@@ -46,6 +58,7 @@ class _Node:
         self.inputs = inputs or []  # list[(Node, out_idx)]
         self.attrs = attrs or {}
         self.aux_mark = False     # variable used in a mutate slot => aux state
+        self.serial = next(_node_serial)  # creation order (subgraph cutting)
 
     @property
     def is_var(self):

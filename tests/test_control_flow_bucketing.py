@@ -1,0 +1,252 @@
+"""Control-flow ops + BucketingModule / SequentialModule / PythonModule.
+
+Mirrors the reference's tests/python/unittest/test_contrib_control_flow.py
+(foreach/while_loop/cond forward+backward) and the word-LM bucketing config
+(example/rnn/word_lm — BucketingModule over variable sequence lengths).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+class TestEagerControlFlow:
+    def test_foreach(self):
+        def body(x, s):
+            return x * 2, s + x.sum()
+
+        data = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+        out, final = mx.nd.contrib.foreach(body, data, mx.nd.zeros((1,)))
+        np.testing.assert_allclose(out.asnumpy(), data.asnumpy() * 2)
+        assert float(final.asnumpy()[0]) == 15.0
+
+    def test_while_loop_pads(self):
+        outs, (i_f, s_f) = mx.nd.contrib.while_loop(
+            lambda i, s: i < 3, lambda i, s: (s, (i + 1, s + 2)),
+            (mx.nd.zeros((1,)), mx.nd.ones((1,))), max_iterations=5)
+        assert outs.shape == (5, 1)
+        np.testing.assert_allclose(outs.asnumpy().ravel(), [1, 3, 5, 0, 0])
+        assert float(i_f.asnumpy()[0]) == 3.0
+        assert float(s_f.asnumpy()[0]) == 7.0
+
+    def test_cond(self):
+        t = mx.nd.contrib.cond(mx.nd.array([1.0]),
+                               lambda: mx.nd.ones((2,)),
+                               lambda: mx.nd.zeros((2,)))
+        np.testing.assert_array_equal(t.asnumpy(), [1, 1])
+        f = mx.nd.contrib.cond(mx.nd.array([0.0]),
+                               lambda: mx.nd.ones((2,)),
+                               lambda: mx.nd.zeros((2,)))
+        np.testing.assert_array_equal(f.asnumpy(), [0, 0])
+
+
+class TestSymbolicControlFlow:
+    def test_foreach_forward_and_grad(self):
+        data_s = sym.Variable("seq")
+        w = sym.Variable("w")
+
+        def body(x, s):
+            h = sym.FullyConnected(x, w, num_hidden=4, no_bias=True)
+            return h, s + h
+
+        outs_s, fin_s = sym.contrib.foreach(body, data_s,
+                                            sym.Variable("init"))
+        loss = sym.sum(fin_s)
+        seq = mx.nd.array(np.random.RandomState(0).rand(5, 2, 3)
+                          .astype(np.float32))
+        wv = mx.nd.array(np.random.RandomState(1).rand(4, 3)
+                         .astype(np.float32))
+        gw = mx.nd.zeros(wv.shape)
+        ex = loss.bind(mx.cpu(), {"seq": seq, "init": mx.nd.zeros((2, 4)),
+                                  "w": wv}, args_grad={"w": gw})
+        ex.forward(is_train=True)
+        ex.backward()
+        expected = np.tile(seq.asnumpy().sum((0, 1)), (4, 1))
+        np.testing.assert_allclose(gw.asnumpy(), expected, rtol=1e-4)
+
+    def test_foreach_matches_eager(self):
+        def body_sym(x, s):
+            return x * 2 + 1, s * 0.5 + x.sum()
+
+        def body_nd(x, s):
+            return x * 2 + 1, s * 0.5 + x.sum()
+
+        data = np.random.RandomState(2).rand(4, 3).astype(np.float32)
+        s0 = np.array([1.0], np.float32)
+        outs_s, fin_s = sym.contrib.foreach(
+            body_sym, sym.Variable("d"), sym.Variable("s0"))
+        g = sym.Group([outs_s, fin_s])
+        ex = g.bind(mx.cpu(), {"d": mx.nd.array(data),
+                               "s0": mx.nd.array(s0)})
+        sym_out, sym_fin = ex.forward()
+        nd_out, nd_fin = mx.nd.contrib.foreach(
+            body_nd, mx.nd.array(data), mx.nd.array(s0))
+        np.testing.assert_allclose(sym_out.asnumpy(), nd_out.asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(sym_fin.asnumpy(), nd_fin.asnumpy(),
+                                   rtol=1e-6)
+
+    def test_while_loop(self):
+        outs, (fi, fs) = sym.contrib.while_loop(
+            lambda i, s: i < 3.0, lambda i, s: (s * 2, (i + 1.0, s + 1.0)),
+            (sym.Variable("i0"), sym.Variable("s0")), max_iterations=5)
+        g = sym.Group([outs, fi, fs])
+        ex = g.bind(mx.cpu(), {"i0": mx.nd.zeros((1,)),
+                               "s0": mx.nd.ones((1,))})
+        o = ex.forward()
+        np.testing.assert_allclose(o[0].asnumpy().ravel(), [2, 4, 6, 0, 0])
+        assert float(o[1].asnumpy()[0]) == 3.0
+        assert float(o[2].asnumpy()[0]) == 4.0
+
+    def test_cond_both_branches(self):
+        p = sym.Variable("p")
+        a = sym.Variable("a")
+        b = sym.Variable("b")
+        out = sym.contrib.cond(sym.sum(p), lambda: a * 2, lambda: b * 3)
+        for pval, expect in ((1.0, 2.0), (0.0, 3.0)):
+            ex = out.bind(mx.cpu(), {"p": mx.nd.array([pval]),
+                                     "a": mx.nd.ones((2,)),
+                                     "b": mx.nd.ones((2,))})
+            np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                       [expect, expect])
+
+
+# --------------------------------------------------------------------------
+# Bucketed word-LM (example/rnn/word_lm capability): predict next token of
+# a deterministic cyclic language over variable-length sequences.
+# --------------------------------------------------------------------------
+VOCAB = 8
+BUCKETS = [4, 6]
+
+
+def _lm_sym_gen(seq_len):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, input_dim=VOCAB, output_dim=16,
+                          name="embed")
+    emb_t = sym.transpose(embed, axes=(1, 0, 2))  # (T, B, E)
+    rnn = sym.RNN(emb_t, state_size=32, num_layers=1, mode="gru",
+                  name="gru")
+    out = sym.transpose(rnn, axes=(1, 0, 2)).reshape((-1, 32))
+    logits = sym.FullyConnected(out, num_hidden=VOCAB, name="pred")
+    pred = sym.SoftmaxOutput(logits, sym.reshape(label, shape=(-1,)),
+                             name="softmax")
+    return pred, ("data",), ("softmax_label",)
+
+
+def _cyclic_batches(n_batches, batch_size, rng):
+    """Sequences x[t+1] = (x[t] + 2) % VOCAB; bucket picked per batch."""
+    batches = []
+    for _ in range(n_batches):
+        T = BUCKETS[rng.randint(len(BUCKETS))]
+        start = rng.randint(0, VOCAB, size=(batch_size, 1))
+        seq = (start + 2 * np.arange(T + 1)) % VOCAB
+        batches.append((T, seq[:, :-1].astype(np.float32),
+                        seq[:, 1:].astype(np.float32)))
+    return batches
+
+
+def test_bucketing_module_word_lm():
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    rng = np.random.RandomState(0)
+    mod = mx.mod.BucketingModule(_lm_sym_gen, default_bucket_key=max(BUCKETS))
+    B = 8
+    mod.bind(data_shapes=[DataDesc("data", (B, max(BUCKETS)))],
+             label_shapes=[DataDesc("softmax_label", (B, max(BUCKETS)))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=None)
+
+    ppl = []
+    for epoch in range(4):
+        metric.reset()
+        for T, x, y in _cyclic_batches(12, B, rng):
+            batch = DataBatch(
+                data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+                bucket_key=T,
+                provide_data=[DataDesc("data", (B, T))],
+                provide_label=[DataDesc("softmax_label", (B, T))])
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        ppl.append(metric.get()[1])
+    assert len(mod._buckets) == len(BUCKETS)
+    assert ppl[-1] < ppl[0] * 0.5, ppl
+    assert ppl[-1] < 2.0, ppl  # deterministic language -> near-1 perplexity
+
+
+def test_sequential_module_with_python_loss():
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.module import PythonLossModule, SequentialModule
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    body = mx.mod.Module(net, data_names=("data",), label_names=None)
+    smod = SequentialModule()
+    smod.add(body).add(PythonLossModule(data_names=("fc_output",)),
+                       take_labels=True)
+    B = 6
+    rng = np.random.RandomState(0)
+    smod.bind(data_shapes=[DataDesc("data", (B, 8))],
+              label_shapes=[DataDesc("softmax_label", (B,))])
+    smod.init_params(mx.initializer.Xavier())
+    smod.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.5})
+    # learnable mapping: class = argmax of first 4 features
+    accs = []
+    for epoch in range(12):
+        x = rng.rand(B, 8).astype(np.float32)
+        y = x[:, :4].argmax(1).astype(np.float32)
+        batch = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+                          provide_data=[DataDesc("data", (B, 8))],
+                          provide_label=[DataDesc("softmax_label", (B,))])
+        smod.forward(batch, is_train=True)
+        out = smod.get_outputs()[0].asnumpy()
+        accs.append((out.argmax(1) == y).mean())
+        smod.backward()
+        smod.update()
+    assert np.mean(accs[-3:]) >= np.mean(accs[:3])
+
+
+class TestSubgraphCutting:
+    def test_captured_outer_computation_not_recomputed(self):
+        """A value computed outside the loop (even through an aux-stateful
+        op like BatchNorm) is cut at the boundary and fed in as a loop
+        input, not dragged into the subgraph."""
+        data = sym.Variable("x")
+        h = sym.BatchNorm(sym.FullyConnected(data, num_hidden=3, name="fc"),
+                          name="bn")
+
+        def body(xs, s):
+            return xs + h, s
+
+        outs, _ = sym.contrib.foreach(body, sym.Variable("seq"),
+                                      sym.Variable("s0"))
+        # binds and runs: BN executes once in the outer graph
+        ex = outs.bind(mx.cpu(), {
+            "x": mx.nd.array(np.random.RandomState(0).rand(2, 4)
+                             .astype(np.float32)),
+            "seq": mx.nd.zeros((5, 2, 3)),
+            "s0": mx.nd.zeros((1,)),
+            "fc_weight": mx.nd.ones((3, 4)),
+            "fc_bias": mx.nd.zeros((3,)),
+            "bn_gamma": mx.nd.ones((3,)),
+            "bn_beta": mx.nd.zeros((3,)),
+        })
+        out = ex.forward()[0].asnumpy()
+        assert out.shape == (5, 2, 3)
+        # every step added the same outer h
+        np.testing.assert_allclose(out[0], out[4], rtol=1e-6)
+
+    def test_cond_pred_evaluated_outside(self):
+        """cond's predicate graph is cut to an outer input."""
+        a = sym.Variable("a")
+        pred = sym.sum(a * 2)  # computed symbol, not a bare variable
+        out = sym.contrib.cond(pred, lambda: a + 1, lambda: a - 1)
+        for aval, expect in ((0.5, 1.5), (0.0, -1.0)):
+            ex = out.bind(mx.cpu(), {"a": mx.nd.array([aval])})
+            np.testing.assert_allclose(ex.forward()[0].asnumpy(), [expect],
+                                       rtol=1e-6)

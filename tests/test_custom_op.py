@@ -1,0 +1,138 @@
+"""mx.operator CustomOp/CustomOpProp tests.
+
+Mirrors the reference's tests/python/unittest/test_operator.py::test_custom_op
+(sigmoid/square tutorials, multi-input ops, gradient correctness) across the
+eager, symbolic, and hybridized-gluon frontends.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+@mx.operator.register("t_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        self.assign(in_grad[0], req[0],
+                    out_grad[0].asnumpy() * y * (1.0 - y))
+
+
+@mx.operator.register("t_weighted_add")
+class WeightedAddProp(mx.operator.CustomOpProp):
+    """Two inputs, one param, exercises kwargs-as-strings."""
+
+    def __init__(self, alpha="1.0"):
+        super().__init__(need_top_grad=True)
+        self.alpha = float(alpha)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _WeightedAdd(self.alpha)
+
+
+class _WeightedAdd(mx.operator.CustomOp):
+    def __init__(self, alpha):
+        self.alpha = alpha
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    in_data[0].asnumpy() + self.alpha * in_data[1].asnumpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g)
+        self.assign(in_grad[1], req[1], self.alpha * g)
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_eager_forward_backward():
+    xv = np.array([-1.0, 0.0, 2.0], np.float32)
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="t_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), _sig(xv), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), _sig(xv) * (1 - _sig(xv)),
+                               rtol=1e-5)
+
+
+def test_symbolic_bind_and_grad():
+    xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    gx = mx.nd.zeros((4, 3))
+    s = sym.Custom(sym.Variable("d"), op_type="t_sigmoid", name="sig")
+    ex = s.bind(mx.cpu(), {"d": mx.nd.array(xv)}, args_grad={"d": gx})
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), _sig(xv), rtol=1e-6)
+    ex.backward(out_grads=mx.nd.ones((4, 3)))
+    np.testing.assert_allclose(gx.asnumpy(), _sig(xv) * (1 - _sig(xv)),
+                               rtol=1e-5)
+
+
+def test_multi_input_with_kwargs():
+    a = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    b = mx.nd.array(np.array([10.0, 20.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(a, b, op_type="t_weighted_add", alpha=0.5)
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), [6.0, 12.0])
+    np.testing.assert_allclose(a.grad.asnumpy(), [1.0, 1.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [0.5, 0.5])
+
+
+def test_inside_gluon_hybridize():
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(3)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.dense(x), op_type="t_sigmoid")
+
+    net = Net()
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 5).astype(np.float32))
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 3)
+    assert (out.asnumpy() > 0).all() and (out.asnumpy() < 1).all()
+    g = net.dense.weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.zeros((2,)), op_type="no_such_op")
