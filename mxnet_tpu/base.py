@@ -83,3 +83,15 @@ class _Registry:
 
     def keys(self):
         return sorted(self._map)
+
+
+def listify(x):
+    """Normalize control-flow data/state arguments: None -> ([], False),
+    list/tuple -> (list, True), scalar -> ([x], False). Shared by the
+    eager (ndarray/contrib.py) and symbolic (symbol/contrib.py) control
+    flow so the nesting contract cannot drift."""
+    if x is None:
+        return [], False
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False

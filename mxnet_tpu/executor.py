@@ -64,7 +64,7 @@ def _graph_program(symbol):
                 env[(id(n), i)] = raw[i]
                 if tap is not None:
                     tap(n.name, i, raw[i])
-            for slot, val in zip(op.mutate, raw[n_primary:]):
+            for slot, val in zip(op.mutate_slots(params), raw[n_primary:]):
                 tgt_node, tgt_slot = n.inputs[slot]
                 env[(id(tgt_node), tgt_slot)] = val
                 if tgt_node.is_var and tgt_node.aux_mark:

@@ -1,0 +1,5 @@
+"""gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
+from . import estimator
+from . import nn
+from . import rnn
+from .estimator import Estimator

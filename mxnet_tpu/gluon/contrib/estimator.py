@@ -1,0 +1,260 @@
+"""gluon.contrib.estimator — the fit API.
+
+Capability parity with python/mxnet/gluon/contrib/estimator/
+(Estimator, event handlers: estimator.py + event_handler.py). The
+Estimator owns the train loop: forward/loss/backward/step per batch,
+metric bookkeeping, and an event-handler pipeline
+(train/epoch/batch begin/end) for logging, checkpointing, and early
+stopping.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import autograd
+from ...base import MXNetError
+from ...metric import Accuracy, EvalMetric, Loss as LossMetric
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler", "MetricHandler"]
+
+
+# ---------------------------------------------------------------------------
+# event-handler mixins (event_handler.py)
+# ---------------------------------------------------------------------------
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch / max_batch (event_handler.py StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets/updates train metrics (event_handler.py MetricHandler)."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, pred=None, label=None, loss=None,
+                  **kwargs):
+        for m in self.metrics:
+            if isinstance(m, LossMetric):
+                m.update(None, loss)
+            else:
+                m.update(label, pred)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochEnd):
+    """Per-epoch metric logging (event_handler.py LoggingHandler)."""
+
+    def __init__(self, logger=None):
+        self.logger = logger or logging.getLogger("mxnet_tpu.estimator")
+        self._start = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.1fs", time.time() - self._start)
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        msgs = [f"[epoch {epoch}]"]
+        for m in estimator.train_metrics:
+            name, val = m.get()
+            msgs.append(f"train_{name}={val:.4f}")
+        for m in estimator.val_metrics:
+            name, val = m.get()
+            msgs.append(f"val_{name}={val:.4f}")
+        self.logger.info(" ".join(msgs))
+
+
+class CheckpointHandler(EpochEnd):
+    """Save parameters every epoch (event_handler.py CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model"):
+        import os
+
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        import os
+
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-epoch{epoch}.params")
+        estimator.net.save_parameters(path)
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when a monitored metric stalls (event_handler.py
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.waited = 0
+        self.stop_training = False
+
+    def epoch_end(self, estimator, **kwargs):
+        name, val = self.monitor.get()
+        better = (self.best is None or
+                  (self.mode == "min" and val < self.best - self.min_delta) or
+                  (self.mode == "max" and val > self.best + self.min_delta))
+        if better:
+            self.best = val
+            self.waited = 0
+        else:
+            self.waited += 1
+            if self.waited >= self.patience:
+                self.stop_training = True
+
+
+# ---------------------------------------------------------------------------
+# Estimator (estimator.py:Estimator)
+# ---------------------------------------------------------------------------
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = self._check_metrics(train_metrics)
+        self.val_metrics = self._check_metrics(val_metrics)
+        if not self.train_metrics:
+            self.train_metrics = [Accuracy()]
+        if not self.val_metrics:
+            self.val_metrics = [type(m)() for m in self.train_metrics]
+        self.train_loss_metric = LossMetric()
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 0.001})
+        self.context = context
+
+    @staticmethod
+    def _check_metrics(metrics):
+        if metrics is None:
+            return []
+        metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        for m in metrics:
+            if not isinstance(m, EvalMetric):
+                raise MXNetError(f"{m} is not an EvalMetric")
+        return list(metrics)
+
+    def _batch_fn(self, batch):
+        if isinstance(batch, (list, tuple)):
+            data, label = batch[0], batch[1]
+        else:
+            data, label = batch.data[0], batch.label[0]
+        return data, label
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._batch_fn(batch)
+            pred = self.net(data)
+            for m in self.val_metrics:
+                if isinstance(m, LossMetric):
+                    m.update(None, self.loss(pred, label))
+                else:
+                    m.update([label], [pred])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError("pass epochs and/or batches")
+        stop = StoppingHandler(max_epoch=epochs, max_batch=batches)
+        handlers = [stop, MetricHandler(self.train_metrics +
+                                        [self.train_loss_metric])]
+        handlers.extend(event_handlers or [])
+        self._run(handlers, "train_begin")
+        epoch = 0
+        while not self._stopped(handlers):
+            self._run(handlers, "epoch_begin")
+            for batch in train_data:
+                self._run(handlers, "batch_begin")
+                data, label = self._batch_fn(batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                self._run(handlers, "batch_end", pred=[pred], label=[label],
+                          loss=[loss])
+                if self._stopped(handlers):
+                    break
+            if val_data is not None:
+                self.evaluate(val_data)
+            self._run(handlers, "epoch_end", epoch=epoch)
+            epoch += 1
+        self._run(handlers, "train_end")
+
+    def _run(self, handlers, event, **kwargs):
+        for h in handlers:
+            fn = getattr(h, event, None)
+            if fn is not None:
+                fn(self, **kwargs)
+
+    def _stopped(self, handlers):
+        return any(getattr(h, "stop_training", False) for h in handlers)

@@ -1,0 +1,95 @@
+"""gluon.contrib.nn — auxiliary blocks.
+
+Capability parity with python/mxnet/gluon/contrib/nn/basic_layers.py:
+Concurrent/HybridConcurrent (parallel branches, concatenated),
+Identity, SparseEmbedding, SyncBatchNorm.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .. import nn as _nn
+from ..block import Block, HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(_nn.Sequential):
+    """Feed input to every child, concat outputs along `axis`."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(_nn.HybridSequential):
+    """Hybridizable Concurrent. HybridSequential short-circuits its children
+    chain in _call_with_params / the Symbol path, so both are overridden
+    here to concatenate instead."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def _concat(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+    def hybrid_forward(self, F, x):
+        return self._concat(F, x)
+
+    def _call_with_params(self, *args):
+        from ... import ndarray as F
+
+        return self._concat(F, args[0])
+
+    def forward(self, x, *args):
+        from ... import symbol as _sym
+        from ...symbol import Symbol
+
+        if isinstance(x, Symbol):
+            return self._concat(_sym, x)
+        return HybridBlock.forward(self, x, *args)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """API parity for contrib.nn.SparseEmbedding: on TPU the dense-gradient
+    Embedding is the efficient path (XLA scatter-add), so this delegates
+    and documents the difference."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        warnings.warn("SparseEmbedding uses dense gradients on TPU "
+                      "(row_sparse grads are a GPU/PS optimization)")
+        with self.name_scope():
+            self._embed = _nn.Embedding(input_dim, output_dim, dtype=dtype,
+                                        weight_initializer=weight_initializer)
+
+    def forward(self, x):
+        return self._embed(x)
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device BatchNorm (contrib SyncBatchNorm / sync_batch_norm.cc).
+    Under GSPMD the batch axis is sharded over the mesh and XLA computes
+    batch statistics with cross-replica collectives automatically, so the
+    standard BatchNorm IS synchronized; this subclass exists for API
+    parity (num_devices is accepted and ignored)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)

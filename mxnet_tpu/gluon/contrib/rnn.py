@@ -1,0 +1,67 @@
+"""gluon.contrib.rnn — VariationalDropoutCell.
+
+Capability parity with python/mxnet/gluon/contrib/rnn/rnn_cell.py
+(VariationalDropoutCell): dropout masks sampled ONCE per sequence and
+reused across time steps (Gal & Ghahramani), for inputs, states, and
+outputs of the wrapped cell.
+"""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import ModifierCell
+
+__all__ = ["VariationalDropoutCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        super().__init__(base_cell)
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_input_masks(self, F, inputs, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = F.Dropout(
+                F.ones_like(states[0]), p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = F.Dropout(
+                F.ones_like(inputs), p=self.drop_inputs)
+
+    def _initialize_output_mask(self, F, output):
+        if self.drop_outputs and self.drop_outputs_mask is None:
+            self.drop_outputs_mask = F.Dropout(
+                F.ones_like(output), p=self.drop_outputs)
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        self._initialize_input_masks(F, inputs, states)
+        if self.drop_states:
+            states = list(states)
+            # only the hidden state h is masked (reference behavior);
+            # the LSTM cell state c passes through
+            states[0] = states[0] * self.drop_states_mask
+        if self.drop_inputs:
+            inputs = inputs * self.drop_inputs_mask
+        next_output, next_states = cell(inputs, states)
+        self._initialize_output_mask(F, next_output)
+        if self.drop_outputs:
+            next_output = next_output * self.drop_outputs_mask
+        return next_output, next_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(p_in={self.drop_inputs}, "
+                f"p_state={self.drop_states}, p_out={self.drop_outputs}, "
+                f"base={self.base_cell.__class__.__name__})")

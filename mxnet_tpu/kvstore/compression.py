@@ -1,0 +1,96 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Capability parity with src/kvstore/gradient_compression.h:38 (2-bit
+stochastic quantization: each gradient value becomes one of
+{-threshold, 0, +threshold}, 16 values packed per 32-bit word, with the
+quantization error carried in a per-key residual so it is re-applied on
+the next step). The TPU-native implementation is a pair of jittable jax
+functions — the pack/unpack is integer bit-twiddling XLA vectorizes — so
+compression can live inside a jitted step or before a DCN allreduce,
+where its 16x size reduction actually pays.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["GradientCompression", "quantize_2bit", "dequantize_2bit"]
+
+_VALS_PER_WORD = 16  # 2 bits each in an int32
+
+
+def quantize_2bit(grad, residual, threshold):
+    """Returns (packed int32 codes, new_residual).
+
+    codes: 0 = zero, 1 = -threshold, 2 = +threshold (2 bits per value,
+    value j stored at bits [2j, 2j+2) of word j//16).
+    """
+    import jax.numpy as jnp
+
+    g = grad + residual
+    pos = g >= threshold
+    neg = g <= -threshold
+    code = jnp.where(pos, 2, jnp.where(neg, 1, 0)).astype(jnp.int32)
+    sent = jnp.where(pos, threshold, jnp.where(neg, -threshold, 0.0))
+    new_residual = g - sent
+
+    flat = code.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % _VALS_PER_WORD
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.int32)])
+    words = flat.reshape(-1, _VALS_PER_WORD)
+    shifts = jnp.arange(_VALS_PER_WORD, dtype=jnp.int32) * 2
+    packed = jnp.bitwise_or.reduce(words << shifts, axis=1)
+    return packed, new_residual
+
+
+def dequantize_2bit(packed, shape, threshold, dtype=_np.float32):
+    """Inverse of quantize_2bit: packed int32 words -> dense gradient."""
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(_VALS_PER_WORD, dtype=jnp.int32) * 2
+    codes = (packed[:, None] >> shifts) & 0x3
+    flat = codes.reshape(-1)
+    n = 1
+    for s in shape:
+        n *= s
+    flat = flat[:n]
+    vals = jnp.where(flat == 2, threshold,
+                     jnp.where(flat == 1, -threshold, 0.0)).astype(dtype)
+    return vals.reshape(shape)
+
+
+class GradientCompression:
+    """Per-key compression state driver (the Python face of the reference's
+    GradientCompression; kvstore wires it into push)."""
+
+    def __init__(self, compression_params):
+        params = dict(compression_params or {})
+        ctype = params.pop("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported gradient compression type "
+                             f"{ctype!r} (supported: '2bit')")
+        self.threshold = float(params.pop("threshold", 0.5))
+        if self.threshold <= 0:
+            raise MXNetError("compression threshold must be positive")
+        if params:
+            raise MXNetError(f"unknown compression params: {sorted(params)}")
+        self._residuals = {}
+
+    def compress(self, key, grad_nd):
+        """Lossy round-trip with error feedback: what the receiving side
+        would reconstruct after the 16x-smaller transfer."""
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        g = grad_nd._data
+        res = self._residuals.get(key)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros_like(g)
+        packed, new_res = quantize_2bit(g, res, self.threshold)
+        self._residuals[key] = new_res
+        out = dequantize_2bit(packed, g.shape, self.threshold, g.dtype)
+        return NDArray(out, grad_nd._ctx)

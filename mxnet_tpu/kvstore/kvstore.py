@@ -47,7 +47,7 @@ class KVStore:
         self._data = {}
         self._updater = None
         self._optimizer = None
-        self._compression = {}
+        self._compression = None
 
     @property
     def type(self):
@@ -77,18 +77,31 @@ class KVStore:
             self.pull(key, out)
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import BaseSparseNDArray
+
         keys, values = _pairs(key, value)
         for k, v in zip(keys, values):
             merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            if self._compression is not None and \
+                    not isinstance(merged, BaseSparseNDArray):
+                # compress this worker's contribution before it leaves the
+                # host (worker->server leg in the reference)
+                merged = self._compression.compress(k, merged)
             merged = self._global_merge(merged)
+            from ..ndarray.sparse import RowSparseNDArray
+
             if k not in self._data:
-                self._data[k] = merged.copy()
+                self._data[k] = (merged.tostype("default")
+                                 if isinstance(merged, RowSparseNDArray)
+                                 else merged.copy())
                 continue
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._data[k])
             else:
                 # no updater: the store holds the latest reduced value
                 # (kvstore_local.h:208 PushImpl — reduce then assign)
+                if isinstance(merged, RowSparseNDArray):
+                    merged = merged.tostype("default")
                 self._data[k]._set_data(merged._data)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -106,11 +119,45 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise MXNetError("sparse storage is out of scope on TPU "
-                         "(SURVEY.md §7 hard part 4: dense Embedding path)")
+        """Pull only the requested rows as a RowSparseNDArray
+        (kvstore_local.h:268 PullRowSparseImpl). The store holds dense
+        values; the row gather is an XLA program."""
+        from ..ndarray.ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _pairs(key, out)
+        ids_list = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        results = []
+        for k, o, ids in zip(keys, outs, ids_list):
+            if k not in self._data:
+                raise MXNetError(f"key {k} was not initialized")
+            import jax.numpy as jnp
+
+            val = self._data[k]
+            idx = ids._data.astype(jnp.int32) if isinstance(ids, NDArray) \
+                else jnp.asarray(ids, jnp.int32)
+            rsp = RowSparseNDArray(
+                NDArray(val._data[idx], val._ctx),
+                NDArray(idx, val._ctx),
+                val.shape, val._ctx)
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t.data = rsp.data
+                    t.indices = rsp.indices
+            results.append(rsp)
+        return results[0] if len(results) == 1 else results
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        """Enable lossy gradient compression on push (2-bit quantization
+        with error feedback; kvstore/compression.py). Raises on unsupported
+        configs instead of silently accepting them."""
+        from .compression import GradientCompression
+
+        self._compression = GradientCompression(compression_params)
 
     def set_optimizer(self, optimizer):
         from ..optimizer import get_updater
@@ -145,8 +192,14 @@ class KVStore:
         return merged
 
     def _reduce(self, values):
+        from ..ndarray.sparse import RowSparseNDArray, _rsp_add
+
         merged = values[0]
         if len(values) > 1:
+            if isinstance(merged, RowSparseNDArray):
+                for v in values[1:]:
+                    merged = _rsp_add(merged, v)
+                return merged
             acc = merged.copy()
             for v in values[1:]:
                 acc._set_data((acc + v.as_in_context(acc.context))._data)

@@ -150,7 +150,10 @@ class Accuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _as_numpy(label)
             pred = _as_numpy(pred)
-            if pred.ndim > label.ndim:
+            # pred carries class scores iff its shape differs from the
+            # label's AND it has an axis to reduce; 1-D class-id preds
+            # against (B, 1) labels compare directly via ravel
+            if pred.shape != label.shape and pred.ndim > self.axis:
                 pred = pred.argmax(axis=self.axis)
             ok = (pred.astype(_np.int64).ravel() ==
                   label.astype(_np.int64).ravel()).sum()

@@ -30,6 +30,13 @@ class BucketingModule(BaseModule):
         self._default_bucket_key = default_bucket_key
         self._context = context
         self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._compression_params = compression_params
+        if work_load_list is not None or group2ctxs is not None:
+            raise MXNetError(
+                "work_load_list/group2ctxs are not supported: device "
+                "placement on TPU is mesh sharding (mx.parallel), not "
+                "per-executor workload splitting")
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -45,7 +52,8 @@ class BucketingModule(BaseModule):
         return Module(symbol, data_names=data_names,
                       label_names=label_names, logger=self.logger,
                       context=self._context,
-                      fixed_param_names=self._fixed_param_names)
+                      fixed_param_names=self._fixed_param_names,
+                      state_names=self._state_names)
 
     @property
     def default_bucket_key(self):
@@ -160,6 +168,9 @@ class BucketingModule(BaseModule):
         self._curr_module.init_optimizer(kvstore, optimizer,
                                          optimizer_params,
                                          force_init=force_init)
+        if self._compression_params and self._curr_module._kvstore:
+            self._curr_module._kvstore.set_gradient_compression(
+                self._compression_params)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
                 mod.borrow_optimizer(self._curr_module)

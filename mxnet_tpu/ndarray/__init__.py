@@ -13,6 +13,7 @@ import sys as _sys
 
 from .ndarray import *  # noqa: F401,F403
 from .ndarray import (NDArray, imperative_invoke, zeros_like, ones_like)
+from . import sparse  # noqa: F401  (mx.nd.sparse)
 from ..ops import registry as _registry
 from ..ops.registry import get_op, list_ops
 from .. import random  # noqa: F401  (exposed as nd.random)

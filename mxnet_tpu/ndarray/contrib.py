@@ -14,12 +14,7 @@ _MODULE = _sys.modules[__name__]
 _PREFIX = "_contrib_"
 
 
-def _listify(x):
-    if x is None:
-        return [], False
-    if isinstance(x, (list, tuple)):
-        return list(x), True
-    return [x], False
+from ..base import listify as _listify  # noqa: E402  (shared contract)
 
 
 def foreach(body, data, init_states, name=None):

@@ -545,6 +545,9 @@ class _CastedOp:
         self.name = op.name
         self.mutate = op.mutate
 
+    def mutate_slots(self, params):
+        return self._op.mutate_slots(params)
+
     def closed(self, params):
         base = self._op.closed(params)
         spec = self._spec
@@ -602,9 +605,11 @@ def imperative_invoke(opname, *inputs, out=None, **params):
     n_primary = op.n_out(params)
     outputs = [NDArray(r, ctx) for r in raw[:n_primary]]
     # write mutated aux slots (e.g. BatchNorm running stats, optimizer weights)
-    if op.mutate:
+    mutate_slots = op.mutate_slots(params) if hasattr(op, "mutate_slots") \
+        else op.mutate
+    if mutate_slots:
         amp_on = _amp_mod() is not None and _amp_mod().amp_active()
-        for slot_name, val in zip(op.mutate, raw[n_primary:]):
+        for slot_name, val in zip(mutate_slots, raw[n_primary:]):
             idx = slot_name if isinstance(slot_name, int) else None
             if idx is None:
                 raise MXNetError("mutate slots must be input indices")
@@ -813,7 +818,7 @@ def waitall():
 # Format: numpy .npz with a name manifest (single-host files, like the ref).
 
 def save(fname, data):
-    if isinstance(data, NDArray):
+    if isinstance(data, NDArray) or hasattr(data, "stype"):
         arrs, names = [data], ["__only__"]
     elif isinstance(data, (list, tuple)):
         arrs, names = list(data), [f"__list_{i}__" for i in range(len(data))]
@@ -822,19 +827,56 @@ def save(fname, data):
         names, arrs = list(names), list(arrs)
     else:
         raise TypeError("save expects NDArray, list or dict")
-    _np.savez(fname if fname.endswith(".npz") else fname + ".npz",
-              **{n: a.asnumpy() for n, a in zip(names, arrs)})
+    entries = {}
+    for n, a in zip(names, arrs):
+        stype = getattr(a, "stype", None)
+        if stype == "row_sparse":
+            entries[n + "::rsp_data"] = a.data.asnumpy()
+            entries[n + "::rsp_indices"] = a.indices.asnumpy()
+            entries[n + "::rsp_shape"] = _np.asarray(a.shape, _np.int64)
+        elif stype == "csr":
+            entries[n + "::csr_data"] = a.data.asnumpy()
+            entries[n + "::csr_indices"] = a.indices.asnumpy()
+            entries[n + "::csr_indptr"] = a.indptr.asnumpy()
+            entries[n + "::csr_shape"] = _np.asarray(a.shape, _np.int64)
+        else:
+            entries[n] = a.asnumpy()
+    _np.savez(fname if fname.endswith(".npz") else fname + ".npz", **entries)
     import os
 
     if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
         os.replace(fname + ".npz", fname)
 
 
+def _load_entries(f):
+    from . import sparse as _sparse
+
+    out = {}
+    names = list(f.keys())
+    for n in names:
+        if "::" not in n:
+            out[n] = array(f[n])
+            continue
+        base, kind = n.split("::", 1)
+        if base in out:
+            continue
+        if kind.startswith("rsp_"):
+            out[base] = _sparse.RowSparseNDArray(
+                f[base + "::rsp_data"], f[base + "::rsp_indices"],
+                tuple(f[base + "::rsp_shape"]))
+        elif kind.startswith("csr_"):
+            out[base] = _sparse.CSRNDArray(
+                f[base + "::csr_data"], f[base + "::csr_indices"],
+                f[base + "::csr_indptr"], tuple(f[base + "::csr_shape"]))
+    return out
+
+
 def load(fname):
     f = _np.load(fname, allow_pickle=False)
-    names = list(f.keys())
+    entries = _load_entries(f)
+    names = list(entries.keys())
     if names == ["__only__"]:
-        return [array(f["__only__"])]
-    if all(n.startswith("__list_") for n in names):
-        return [array(f[f"__list_{i}__"]) for i in range(len(names))]
-    return {n: array(f[n]) for n in names}
+        return [entries["__only__"]]
+    if names and all(n.startswith("__list_") for n in names):
+        return [entries[f"__list_{i}__"] for i in range(len(names))]
+    return entries

@@ -1,0 +1,343 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Capability parity with python/mxnet/ndarray/sparse.py (RowSparseNDArray,
+CSRNDArray, row_sparse_array :~1000, csr_matrix :~900) and the sparse
+storage types of include/mxnet/ndarray.h:61. TPU-native design (SURVEY.md
+§7 hard part 4): the compressed representations are ordinary dense jax
+arrays (values + integer index arrays), so every *consuming* op — retain,
+CSR×dense dot, row-sparse optimizer updates — is a statically-shaped
+gather/scatter program that XLA maps onto the TPU's vector units.
+Compression itself (dense→sparse, data-dependent nnz) runs eagerly on
+host, exactly where the reference runs `cast_storage` on CPU.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import ndarray as _nd
+from .ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "dot", "zeros"]
+
+
+class BaseSparseNDArray:
+    """Common surface of the compressed array types."""
+
+    stype = None
+
+    def __init__(self, shape, ctx=None, dtype=_np.float32):
+        self._shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._dtype = _np.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def context(self):
+        from ..context import current_context
+
+        return self._ctx or current_context()
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def todense(self):
+        return self.tostype("default")
+
+    def tostype(self, stype):
+        raise NotImplementedError
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(self.todense()._data)
+            return other
+        raise MXNetError(f"cannot copy {type(self).__name__} to "
+                         f"{type(other).__name__}")
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"@{self.context}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Compressed row slices: `data[i]` is the full row `indices[i]` of the
+    dense view; all other rows are zero (ndarray.h kRowSparseStorage).
+    The canonical type for embedding gradients."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx=None):
+        data = data if isinstance(data, NDArray) else _nd.array(data)
+        indices = (indices if isinstance(indices, NDArray)
+                   else _nd.array(indices, dtype=_np.int32))
+        super().__init__(shape, ctx, data.dtype)
+        if data.shape[0] != indices.shape[0]:
+            raise MXNetError("data and indices row counts differ")
+        if tuple(data.shape[1:]) != tuple(shape[1:]):
+            raise MXNetError("data row shape must match dense row shape")
+        self.data = data
+        self.indices = indices
+
+    @property
+    def nnz_rows(self):
+        return self.indices.shape[0]
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            import jax.numpy as jnp
+
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[self.indices._data.astype(_np.int32)].set(
+                self.data._data)
+            return NDArray(dense, self._ctx)
+        raise MXNetError(f"cannot convert row_sparse to {stype!r}")
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices,
+                                self._shape, self._ctx)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return _rsp_add(self, other)
+        return self.todense() + other
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix: values `data`, column `indices`,
+    row pointer `indptr` (ndarray.h kCSRStorage)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        data = data if isinstance(data, NDArray) else _nd.array(data)
+        indices = (indices if isinstance(indices, NDArray)
+                   else _nd.array(indices, dtype=_np.int32))
+        indptr = (indptr if isinstance(indptr, NDArray)
+                  else _nd.array(indptr, dtype=_np.int32))
+        super().__init__(shape, ctx, data.dtype)
+        if len(shape) != 2:
+            raise MXNetError("CSR arrays are 2-D")
+        if indptr.shape[0] != shape[0] + 1:
+            raise MXNetError("indptr must have shape (rows+1,)")
+        self.data = data
+        self.indices = indices
+        self.indptr = indptr
+
+    @property
+    def nnz(self):
+        return self.data.shape[0]
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            import jax.numpy as jnp
+
+            rows = _row_ids_from_indptr(self.indptr._data, self.nnz)
+            dense = jnp.zeros(self._shape, self.data._data.dtype)
+            dense = dense.at[rows, self.indices._data.astype(_np.int32)].set(
+                self.data._data)
+            return NDArray(dense, self._ctx)
+        if stype == "row_sparse":
+            return cast_storage(self.todense(), "row_sparse")
+        raise MXNetError(f"cannot convert csr to {stype!r}")
+
+    def astype(self, dtype):
+        return CSRNDArray(self.data.astype(dtype), self.indices,
+                          self.indptr, self._shape, self._ctx)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            if key.step is not None and key.step != 1:
+                raise MXNetError("CSR slicing supports unit steps only")
+            start = key.start or 0
+            stop = self._shape[0] if key.stop is None else key.stop
+            ip = self.indptr.asnumpy()
+            lo, hi = int(ip[start]), int(ip[stop])
+            new_ip = ip[start:stop + 1] - ip[start]
+            return CSRNDArray(self.data[lo:hi], self.indices[lo:hi],
+                              _nd.array(new_ip, dtype=_np.int32),
+                              (stop - start, self._shape[1]), self._ctx)
+        raise MXNetError("CSR indexing supports row slices only")
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+
+def _row_ids_from_indptr(indptr, nnz):
+    """Expand a CSR row pointer into a per-value row-id vector. Jittable:
+    nnz and the number of rows are static."""
+    import jax.numpy as jnp
+
+    # rows[j] = (number of indptr entries <= j) - 1
+    positions = jnp.arange(nnz)
+    return (jnp.searchsorted(indptr[1:-1].astype(jnp.int32),
+                             positions, side="right")).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """row_sparse_array((data, indices), shape=...) or from a dense
+    source (sparse.py row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape is required with (data, indices)")
+        rsp = RowSparseNDArray(_nd.array(data, dtype=dtype),
+                               indices, shape, ctx)
+        return rsp
+    dense = arg1 if isinstance(arg1, NDArray) else _nd.array(arg1,
+                                                             dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """csr_matrix((data, indices, indptr), shape=...) or from dense
+    (sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape is required with (data, indices, indptr)")
+        return CSRNDArray(_nd.array(data, dtype=dtype), indices, indptr,
+                          shape, ctx)
+    dense = arg1 if isinstance(arg1, NDArray) else _nd.array(arg1,
+                                                             dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=_np.float32):
+    if stype == "row_sparse":
+        row_shape = tuple(shape[1:])
+        return RowSparseNDArray(_np.zeros((0,) + row_shape, dtype),
+                                _np.zeros((0,), _np.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype), _np.zeros((0,), _np.int32),
+                          _np.zeros((shape[0] + 1,), _np.int32), shape, ctx)
+    return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """Storage conversion (src/operator/tensor/cast_storage.cc). The
+    compressing directions inspect values, so they run eagerly on host —
+    same placement as the reference's CPU cast_storage."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(a[nz_rows], nz_rows.astype(_np.int32),
+                                a.shape, arr.context)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires a 2-D array")
+        rows, cols = _np.nonzero(a)
+        indptr = _np.zeros(a.shape[0] + 1, _np.int64)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return CSRNDArray(a[rows, cols], cols.astype(_np.int32), indptr,
+                          a.shape, arr.context)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def retain(rsp, row_ids):
+    """sparse_retain (src/operator/tensor/sparse_retain.cc): keep only the
+    requested rows. Jittable given static row_ids length."""
+    import jax.numpy as jnp
+
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    ids = (row_ids._data if isinstance(row_ids, NDArray)
+           else _nd.array(row_ids, dtype=_np.int32)._data)
+    ids = ids.astype(jnp.int32)
+    stored = rsp.indices._data.astype(jnp.int32)
+    if stored.shape[0] == 0:  # nothing stored: every requested row is zero
+        rows = jnp.zeros((ids.shape[0],) + tuple(rsp.shape[1:]),
+                         rsp.data._data.dtype)
+        return RowSparseNDArray(NDArray(rows, rsp._ctx),
+                                NDArray(ids, rsp._ctx), rsp.shape, rsp._ctx)
+    # position of each requested id in the stored indices (or miss)
+    pos = jnp.searchsorted(stored, ids)
+    pos_c = jnp.clip(pos, 0, stored.shape[0] - 1)
+    hit = stored[pos_c] == ids
+    rows = jnp.where(hit.reshape((-1,) + (1,) * (rsp.data.ndim - 1)),
+                     rsp.data._data[pos_c], 0.0)
+    return RowSparseNDArray(NDArray(rows, rsp._ctx),
+                            NDArray(ids, rsp._ctx),
+                            rsp.shape, rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot: CSR x dense and CSR^T x dense (src/operator/tensor/
+    dot.cc sparse paths). Lowers to a gather + segment-sum / scatter-add —
+    the natural TPU mapping."""
+    import jax.numpy as jnp
+
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        rows = _row_ids_from_indptr(lhs.indptr._data, lhs.nnz)
+        cols = lhs.indices._data.astype(jnp.int32)
+        vals = lhs.data._data
+        if not transpose_a:
+            # out[r] += vals[j] * rhs[cols[j]]  grouped by row
+            contrib = vals[:, None] * rhs._data[cols]
+            out = jnp.zeros((lhs.shape[0], rhs.shape[1]), vals.dtype)
+            out = out.at[rows].add(contrib)
+            return NDArray(out, rhs._ctx)
+        contrib = vals[:, None] * rhs._data[rows]
+        out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype)
+        out = out.at[cols].add(contrib)
+        return NDArray(out, rhs._ctx)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _nd.dot(lhs, rhs, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+    raise MXNetError(f"unsupported sparse dot: {type(lhs).__name__} x "
+                     f"{type(rhs).__name__}")
+
+
+def _rsp_add(a, b):
+    """row_sparse + row_sparse -> row_sparse over the union of rows
+    (host-side union; the add itself is on device)."""
+    import jax.numpy as jnp
+
+    ia = a.indices.asnumpy()
+    ib = b.indices.asnumpy()
+    union = _np.union1d(ia, ib)
+    pa = _np.searchsorted(union, ia)
+    pb = _np.searchsorted(union, ib)
+    rows = jnp.zeros((union.shape[0],) + tuple(a.shape[1:]),
+                     a.data._data.dtype)
+    rows = rows.at[pa].add(a.data._data).at[pb].add(b.data._data)
+    return RowSparseNDArray(NDArray(rows, a._ctx),
+                            union.astype(_np.int32), a.shape, a._ctx)

@@ -631,7 +631,13 @@ def _batch_take(a, indices):
 
 @register("pick")
 def _pick(a, indices, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.expand_dims(indices.astype(jnp.int32), axis=axis)
+    idx = indices.astype(jnp.int32)
+    if idx.ndim == a.ndim:
+        # indices may already carry a size-1 dim at `axis` (e.g. labels of
+        # shape (B, 1) picked from (B, C) — reference pick accepts both)
+        pass
+    else:
+        idx = jnp.expand_dims(idx, axis=axis)
     out = jnp.take_along_axis(a, idx, axis=axis)
     return out if keepdims else jnp.squeeze(out, axis=axis)
 

@@ -196,3 +196,123 @@ def _multi_sum_sq(*arrays, num_arrays=1):
           param_normalizer=lambda p: {k: v for k, v in p.items() if k != "num_arrays"})
 def _reset_arrays(*arrays):
     return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("multi_lars", no_grad=True)
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0):
+    """LARS learning-rate adaptation over a group of layers
+    (src/operator/contrib/multi_lars.cc): lr_i *= eta*||w||/(||g||+wd*||w||+eps),
+    applied only where both norms are positive."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return jnp.where((w_norm > 0) & (g_norm > 0), lrs * ratio, lrs)
+
+
+def _lamb_step(weight, grad, mean, var, lr, beta1, beta2, epsilon, t, wd,
+               rescale_grad, clip_gradient, bias_correction, lower_bound,
+               upper_bound):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        m_hat = m / (1 - beta1 ** t)
+        v_hat = v / (1 - beta2 ** t)
+    else:
+        m_hat, v_hat = m, v
+    g_upd = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w32
+    r1 = jnp.linalg.norm(w32)
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    r2 = jnp.linalg.norm(g_upd)
+    trust = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    new_w = w32 - lr * trust * g_upd
+    return new_w, m, v
+
+
+@register("multi_lamb_update", no_grad=True,
+          num_outputs=lambda p: p["num_tensors"],
+          mutate=lambda p: tuple(
+              s for i in range(p["num_tensors"])
+              for s in (4 * i, 4 * i + 2, 4 * i + 3)),
+          param_normalizer=lambda p: p)
+def _multi_lamb_update(*tensors, num_tensors=1, learning_rates=(),
+                       wds=(), beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       rescale_grad=1.0, clip_gradient=-1.0,
+                       bias_correction=True, step_count=(),
+                       lower_bound=-1.0, upper_bound=-1.0):
+    """Group LAMB (src/operator/contrib/multi_lamb.cc): tensors are
+    interleaved [w0, g0, m0, v0, w1, ...]; weights AND Adam moments are
+    updated in place (mutate slots), and the new weights are also returned.
+    On TPU the grouping is API parity — XLA already fuses the per-tensor
+    updates; the CUDA kernel-launch amortization it bought is moot."""
+    n = num_tensors
+    outs, mutated = [], []
+    for i in range(n):
+        w, g, m, v = tensors[4 * i:4 * i + 4]
+        t = step_count[i] if i < len(step_count) else 1
+        new_w, new_m, new_v = _lamb_step(
+            w, g, m, v, learning_rates[i], beta1, beta2, epsilon, t,
+            wds[i], rescale_grad,
+            clip_gradient if clip_gradient > 0 else None,
+            bias_correction,
+            lower_bound if lower_bound > 0 else None,
+            upper_bound if upper_bound > 0 else None)
+        new_w = new_w.astype(w.dtype)
+        outs.append(new_w)
+        mutated.extend([new_w, new_m, new_v])
+    return tuple(outs) + tuple(mutated)
+
+
+@register("preloaded_multi_sgd_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(2 * i for i in
+                                 range(p.get("num_weights", 1))),
+          param_normalizer=lambda p: p)
+def _preloaded_multi_sgd_update(*tensors, num_weights=1, rescale_grad=1.0,
+                                clip_gradient=-1.0):
+    """Group SGD with preloaded lrs/wds (src/operator/contrib/
+    preloaded_multi_sgd.cc): inputs [w0, g0, w1, g1, ..., lrs, wds];
+    weights updated in place and returned."""
+    lrs, wds = tensors[-2], tensors[-1]
+    outs = []
+    for i in range(num_weights):
+        w, g = tensors[2 * i], tensors[2 * i + 1]
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        outs.append((w.astype(jnp.float32) -
+                     lrs[i] * (g + wds[i] * w.astype(jnp.float32)))
+                    .astype(w.dtype))
+    return tuple(outs) + tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(
+              s for i in range(p.get("num_weights", 1))
+              for s in (3 * i, 3 * i + 2)),
+          param_normalizer=lambda p: p)
+def _preloaded_multi_sgd_mom_update(*tensors, num_weights=1, momentum=0.0,
+                                    rescale_grad=1.0, clip_gradient=-1.0):
+    """Inputs [w0, g0, mom0, w1, g1, mom1, ..., lrs, wds]; weights and
+    momenta updated in place; new weights returned."""
+    lrs, wds = tensors[-2], tensors[-1]
+    new_ws, mutated = [], []
+    for i in range(num_weights):
+        w, g, mom = tensors[3 * i:3 * i + 3]
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        new_mom = momentum * mom - lrs[i] * (g + wds[i] *
+                                             w.astype(jnp.float32))
+        new_w = (w.astype(jnp.float32) + new_mom).astype(w.dtype)
+        new_ws.append(new_w)
+        mutated.extend([new_w, new_mom.astype(mom.dtype)])
+    return tuple(new_ws) + tuple(mutated)

@@ -53,7 +53,9 @@ class OpDef:
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
-        self.mutate = tuple(mutate)
+        # mutate: tuple of input indices, or callable(params) -> tuple for
+        # variadic ops whose mutated slots depend on arity (multi_lamb etc.)
+        self.mutate = mutate if callable(mutate) else tuple(mutate)
         self.aliases = tuple(aliases)
         self.no_grad = no_grad
         self.param_normalizer = param_normalizer
@@ -61,6 +63,10 @@ class OpDef:
 
     def n_out(self, params):
         return self.num_outputs(params) if callable(self.num_outputs) else self.num_outputs
+
+    def mutate_slots(self, params):
+        return tuple(self.mutate(params)) if callable(self.mutate) \
+            else self.mutate
 
     def normalize(self, params):
         params = {k: v for k, v in params.items() if v is not None}

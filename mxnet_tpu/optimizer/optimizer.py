@@ -590,8 +590,49 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
             self.states_synced[index] = True
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            self._update_row_sparse(index, grad, weight)
+            return
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    def _update_row_sparse(self, index, grad, weight):
+        """Lazy row-sparse update: gather the touched rows of weight and
+        state, run the ordinary dense optimizer kernel on that row block,
+        scatter back. One mechanism covers every optimizer — the reference
+        hand-writes per-optimizer sparse kernels (sgd/adam/ftrl *_update
+        sparse paths); here the gather/scatter is an XLA program."""
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        rows = grad.indices._data.astype(jnp.int32)
+        state = self.states[index]
+
+        def gather(s):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return type(s)(gather(x) for x in s)
+            return NDArray(s._data[rows], s._ctx)
+
+        def scatter(s, sr):
+            if s is None:
+                return
+            if isinstance(s, (tuple, list)):
+                for x, xr in zip(s, sr):
+                    scatter(x, xr)
+                return
+            s._set_data(s._data.at[rows].set(sr._data))
+
+        w_rows = NDArray(weight._data[rows], weight._ctx)
+        state_rows = gather(state)
+        self.optimizer.update_multi_precision(index, w_rows, grad.data,
+                                              state_rows)
+        weight._set_data(weight._data.at[rows].set(w_rows._data))
+        scatter(state, state_rows)
 
     def set_states(self, states):
         def _to_nd(x):

@@ -597,7 +597,7 @@ def make_symbol_creator(opname):
         params = dict(kwargs)
         # positional non-symbol args map onto remaining op params (rare)
         # auto-create missing parameter variables
-        mutate_idx = set(op.mutate)
+        mutate_idx = set(op.mutate) if not callable(op.mutate) else set()
         final_inputs = []
         for idx, an in enumerate(arr_names):
             s = slots[an]

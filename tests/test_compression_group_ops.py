@@ -1,0 +1,136 @@
+"""Gradient compression + multi-weight group optimizer ops.
+
+Mirrors the reference's tests/python/unittest/test_kvstore.py compression
+cases (quantize/dequantize roundtrip, error feedback accumulates dropped
+mass) and test_operator.py multi_lars/multi_lamb/preloaded_multi_sgd.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore.compression import (GradientCompression,
+                                           dequantize_2bit, quantize_2bit)
+
+
+class TestQuantize2Bit:
+    def test_roundtrip_values(self):
+        import jax.numpy as jnp
+
+        g = jnp.asarray(np.array([0.9, -0.7, 0.1, -0.2, 0.5, 0.0],
+                                 np.float32))
+        res = jnp.zeros_like(g)
+        packed, new_res = quantize_2bit(g, res, 0.5)
+        assert packed.shape == (1,)  # 6 values -> 1 word
+        out = np.asarray(dequantize_2bit(packed, (6,), 0.5))
+        np.testing.assert_allclose(out, [0.5, -0.5, 0, 0, 0.5, 0])
+        np.testing.assert_allclose(np.asarray(new_res),
+                                   [0.4, -0.2, 0.1, -0.2, 0.0, 0.0],
+                                   atol=1e-6)
+
+    def test_error_feedback_accumulates(self):
+        """Small gradients below threshold eventually get sent thanks to
+        the residual (the defining property of error feedback)."""
+        import jax.numpy as jnp
+
+        g = jnp.full((4,), 0.2, jnp.float32)
+        res = jnp.zeros_like(g)
+        sent_total = np.zeros(4, np.float32)
+        for _ in range(5):
+            packed, res = quantize_2bit(g, res, 0.5)
+            sent_total += np.asarray(dequantize_2bit(packed, (4,), 0.5))
+        # 5 steps x 0.2 = 1.0 of mass; at least one 0.5 pulse must have fired
+        assert (sent_total >= 0.5).all()
+
+    def test_large_array_packing(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(1000).astype(np.float32))
+        packed, _ = quantize_2bit(g, jnp.zeros_like(g), 1.0)
+        assert packed.shape == ((1000 + 15) // 16,)
+        out = np.asarray(dequantize_2bit(packed, (1000,), 1.0))
+        gn = np.asarray(g)
+        np.testing.assert_allclose(out[gn >= 1.0], 1.0)
+        np.testing.assert_allclose(out[gn <= -1.0], -1.0)
+        np.testing.assert_allclose(out[np.abs(gn) < 1.0], 0.0)
+
+    def test_config_validation(self):
+        with pytest.raises(mx.MXNetError):
+            GradientCompression({"type": "1bit"})
+        with pytest.raises(mx.MXNetError):
+            GradientCompression({"type": "2bit", "threshold": -1})
+        with pytest.raises(mx.MXNetError):
+            GradientCompression({"type": "2bit", "bogus": 1})
+
+
+class TestKVStoreCompression:
+    def test_push_is_lossy_but_unbiased_over_time(self):
+        kv = mx.kv.create("local")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", mx.nd.zeros((4,)))
+        # no updater: store holds latest compressed-reconstructed push
+        kv.push("w", mx.nd.array(np.array([0.9, 0.3, -0.6, 0.0],
+                                          np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, -0.5, 0.0])
+        # second push: residual [0.4, 0.3, -0.1, 0] + new grad crosses
+        kv.push("w", mx.nd.array(np.array([0.2, 0.3, 0.0, 0.1],
+                                          np.float32)))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5, 0.0, 0.0])
+
+
+class TestGroupOps:
+    def test_multi_lars(self):
+        lrs = mx.nd.array([0.1, 0.1, 0.1])
+        wss = mx.nd.array([4.0, 0.0, 1.0])
+        gss = mx.nd.array([1.0, 1.0, 4.0])
+        wds = mx.nd.array([0.0, 0.0, 0.0])
+        out = mx.nd.multi_lars(lrs, wss, gss, wds, eta=1.0, eps=0.0)
+        np.testing.assert_allclose(out.asnumpy(), [0.2, 0.1, 0.05],
+                                   rtol=1e-6)
+
+    def test_preloaded_multi_sgd(self):
+        w0 = mx.nd.array(np.array([1.0, 2.0], np.float32))
+        g0 = mx.nd.array(np.array([0.5, 0.5], np.float32))
+        w1 = mx.nd.array(np.array([3.0], np.float32))
+        g1 = mx.nd.array(np.array([1.0], np.float32))
+        lrs = mx.nd.array([0.1, 0.2])
+        wds = mx.nd.array([0.0, 0.0])
+        nw0, nw1 = mx.nd.preloaded_multi_sgd_update(
+            w0, g0, w1, g1, lrs, wds, num_weights=2)
+        np.testing.assert_allclose(nw0.asnumpy(), [0.95, 1.95])
+        np.testing.assert_allclose(nw1.asnumpy(), [2.8])
+
+    def test_multi_lamb_matches_single(self):
+        """Group LAMB must equal per-tensor lamb phase1+phase2."""
+        rng = np.random.RandomState(0)
+        w = rng.rand(4, 3).astype(np.float32)
+        g = rng.rand(4, 3).astype(np.float32)
+        m = np.zeros_like(w)
+        v = np.zeros_like(w)
+        lr, wd = 0.01, 0.1
+
+        outs = mx.nd.multi_lamb_update(
+            mx.nd.array(w), mx.nd.array(g), mx.nd.array(m), mx.nd.array(v),
+            num_tensors=1, learning_rates=(lr,), wds=(wd,),
+            step_count=(1,), bias_correction=True)
+        new_w = (outs[0] if isinstance(outs, (list, tuple)) else
+                 outs).asnumpy()
+
+        g_upd = mx.nd.lamb_update_phase1(
+            mx.nd.array(w), mx.nd.array(g), mx.nd.array(m), mx.nd.array(v),
+            t=1, wd=wd, bias_correction=True, epsilon=1e-6)
+        r1 = np.linalg.norm(w)
+        r2 = np.linalg.norm(g_upd.asnumpy())
+        expected = w - lr * (r1 / r2) * g_upd.asnumpy()
+        np.testing.assert_allclose(new_w, expected, rtol=1e-5)
+
+    def test_multi_sum_sq_and_reset(self):
+        a = mx.nd.array(np.array([1.0, 2.0], np.float32))
+        b = mx.nd.array(np.array([[3.0]], np.float32))
+        sums = mx.nd.multi_sum_sq(a, b, num_arrays=2)
+        np.testing.assert_allclose(
+            [float(sums[0].asnumpy()), float(sums[1].asnumpy())],
+            [5.0, 9.0])

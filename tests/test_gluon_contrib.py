@@ -1,0 +1,138 @@
+"""gluon.contrib tests: estimator fit API, VariationalDropoutCell,
+Concurrent/Identity/SyncBatchNorm blocks.
+
+Mirrors the reference's tests/python/unittest/test_gluon_contrib.py and
+test_gluon_estimator.py core cases.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+class TestContribNN:
+    def test_concurrent_shapes(self):
+        for cls in (gluon.contrib.nn.Concurrent,
+                    gluon.contrib.nn.HybridConcurrent):
+            net = cls(axis=1)
+            net.add(gluon.nn.Dense(3), gluon.nn.Dense(2))
+            net.initialize(mx.initializer.Xavier())
+            out = net(mx.nd.ones((4, 5)))
+            assert out.shape == (4, 5)
+
+    def test_hybrid_concurrent_hybridized(self):
+        net = gluon.contrib.nn.HybridConcurrent(axis=-1)
+        net.add(gluon.nn.Dense(3), gluon.nn.Dense(3))
+        net.initialize(mx.initializer.Xavier())
+        eager = net(mx.nd.ones((2, 4))).asnumpy()
+        net.hybridize()
+        hybrid = net(mx.nd.ones((2, 4))).asnumpy()
+        np.testing.assert_allclose(eager, hybrid, rtol=1e-6)
+
+    def test_identity(self):
+        ident = gluon.contrib.nn.Identity()
+        x = mx.nd.array(np.random.RandomState(0).rand(3, 3)
+                        .astype(np.float32))
+        np.testing.assert_array_equal(ident(x).asnumpy(), x.asnumpy())
+
+    def test_sync_batchnorm_trains(self):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.contrib.nn.SyncBatchNorm(num_devices=8))
+        net.initialize()
+        x = mx.nd.array(np.random.RandomState(0).rand(8, 4)
+                        .astype(np.float32))
+        with mx.autograd.record():
+            out = net(x)
+        assert out.shape == x.shape
+
+
+class TestVariationalDropout:
+    def test_mask_constant_across_time(self):
+        """The defining property: the same dropout mask applies at every
+        time step, so zeroed units are zero in ALL steps."""
+        mx.random.seed(7)
+        cell = gluon.contrib.rnn.VariationalDropoutCell(
+            gluon.rnn.RNNCell(16, input_size=8), drop_outputs=0.5)
+        cell.initialize(mx.initializer.One())
+        x = mx.nd.array(np.ones((6, 2, 8), np.float32))
+        with mx.autograd.record():  # dropout active in train mode
+            outputs, _ = cell.unroll(6, x, layout="TNC",
+                                     merge_outputs=True)
+        o = outputs.asnumpy()  # (T, B, H)
+        zero_mask = (o == 0)
+        # a unit zeroed at t=0 must be zeroed at every t
+        np.testing.assert_array_equal(
+            np.broadcast_to(zero_mask[0], o.shape), zero_mask)
+        assert zero_mask.any(), "dropout did nothing"
+
+    def test_no_drop_in_inference(self):
+        cell = gluon.contrib.rnn.VariationalDropoutCell(
+            gluon.rnn.RNNCell(8, input_size=4), drop_inputs=0.9,
+            drop_outputs=0.9)
+        cell.initialize(mx.initializer.Xavier())
+        outputs, _ = cell.unroll(3, mx.nd.ones((3, 2, 4)), layout="TNC",
+                                 merge_outputs=True)
+        assert np.isfinite(outputs.asnumpy()).all()
+
+
+class TestEstimator:
+    def _toy(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 10).astype(np.float32)
+        y = (X[:, :5].sum(1) > X[:, 5:].sum(1)).astype(np.float32)
+        ds = gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+        return gluon.data.DataLoader(ds, batch_size=16, shuffle=True)
+
+    def _model(self):
+        model = gluon.nn.Sequential()
+        model.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+        model.initialize(mx.initializer.Xavier())
+        return model
+
+    def test_fit_learns(self):
+        model = self._model()
+        est = gluon.contrib.Estimator(
+            model, gluon.loss.SoftmaxCrossEntropyLoss(),
+            trainer=gluon.Trainer(model.collect_params(), "adam",
+                                  {"learning_rate": 0.05}))
+        est.fit(self._toy(), epochs=6)
+        assert est.train_metrics[0].get()[1] > 0.8
+
+    def test_max_batches_stops(self):
+        model = self._model()
+        est = gluon.contrib.Estimator(
+            model, gluon.loss.SoftmaxCrossEntropyLoss())
+        counter = {"n": 0}
+
+        class CountHandler(gluon.contrib.estimator.BatchEnd):
+            def batch_end(self, estimator, **kwargs):
+                counter["n"] += 1
+
+        est.fit(self._toy(), batches=3, event_handlers=[CountHandler()])
+        assert counter["n"] == 3
+
+    def test_checkpoint_and_early_stopping(self, tmp_path):
+        model = self._model()
+        loss_metric = mx.metric.Loss()
+        est = gluon.contrib.Estimator(
+            model, gluon.loss.SoftmaxCrossEntropyLoss(),
+            train_metrics=[mx.metric.Accuracy()])
+        ckpt = gluon.contrib.estimator.CheckpointHandler(str(tmp_path))
+        early = gluon.contrib.estimator.EarlyStoppingHandler(
+            est.train_metrics[0], mode="max", patience=1)
+        est.fit(self._toy(), epochs=20, event_handlers=[ckpt, early])
+        import os
+
+        saved = [f for f in os.listdir(tmp_path) if f.endswith(".params")]
+        assert saved, "no checkpoints written"
+        # early stopping must have cut the run well short of 20 epochs
+        assert len(saved) < 20
+
+    def test_evaluate(self):
+        model = self._model()
+        est = gluon.contrib.Estimator(
+            model, gluon.loss.SoftmaxCrossEntropyLoss(),
+            val_metrics=[mx.metric.Accuracy()])
+        res = est.evaluate(self._toy())
+        assert "accuracy" in res and 0.0 <= res["accuracy"] <= 1.0

@@ -1,0 +1,163 @@
+"""Sparse NDArray tests (row_sparse + CSR).
+
+Mirrors the reference's tests/python/unittest/test_sparse_ndarray.py /
+test_sparse_operator.py core cases: creation, storage casts, retain,
+sparse dot, row-sparse optimizer updates, kvstore row_sparse_pull,
+save/load roundtrip.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense_rows(rows=6, cols=4, nz_rows=(1, 4), seed=0):
+    a = np.zeros((rows, cols), np.float32)
+    rng = np.random.RandomState(seed)
+    for r in nz_rows:
+        a[r] = rng.rand(cols)
+    return a
+
+
+class TestRowSparse:
+    def test_create_and_dense_roundtrip(self):
+        a = _rand_dense_rows()
+        rsp = sparse.row_sparse_array(a)
+        assert rsp.stype == "row_sparse"
+        assert rsp.nnz_rows == 2
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+        np.testing.assert_allclose(rsp.asnumpy(), a)
+
+    def test_create_from_components(self):
+        data = np.ones((2, 3), np.float32)
+        rsp = sparse.row_sparse_array((data, [0, 2]), shape=(4, 3))
+        d = rsp.asnumpy()
+        np.testing.assert_array_equal(d[0], 1)
+        np.testing.assert_array_equal(d[1], 0)
+        np.testing.assert_array_equal(d[2], 1)
+
+    def test_retain(self):
+        a = _rand_dense_rows(nz_rows=(1, 3, 4))
+        rsp = sparse.row_sparse_array(a)
+        kept = sparse.retain(rsp, mx.nd.array([1, 2, 4]))
+        d = kept.asnumpy()
+        np.testing.assert_allclose(d[1], a[1])
+        np.testing.assert_allclose(d[4], a[4])
+        np.testing.assert_array_equal(d[3], 0)  # dropped
+        np.testing.assert_array_equal(d[2], 0)  # was empty
+
+    def test_add_union(self):
+        a = sparse.row_sparse_array((np.ones((1, 2), np.float32), [0]),
+                                    shape=(3, 2))
+        b = sparse.row_sparse_array((2 * np.ones((2, 2), np.float32),
+                                     [0, 2]), shape=(3, 2))
+        c = a + b
+        np.testing.assert_allclose(
+            c.asnumpy(), [[3, 3], [0, 0], [2, 2]])
+
+    def test_save_load(self, tmp_path):
+        a = _rand_dense_rows()
+        rsp = sparse.row_sparse_array(a)
+        path = str(tmp_path / "x.params")
+        mx.nd.save(path, {"w": rsp, "d": mx.nd.array(a)})
+        back = mx.nd.load(path)
+        assert back["w"].stype == "row_sparse"
+        np.testing.assert_allclose(back["w"].asnumpy(), a)
+        np.testing.assert_allclose(back["d"].asnumpy(), a)
+
+
+class TestCSR:
+    def test_create_and_roundtrip(self):
+        a = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+        csr = sparse.csr_matrix(a)
+        assert csr.stype == "csr"
+        assert csr.nnz == 3
+        np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+        np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+        np.testing.assert_allclose(csr.asnumpy(), a)
+
+    def test_from_components_and_slice(self):
+        csr = sparse.csr_matrix(
+            (np.array([1., 2., 3.], np.float32), [0, 2, 1], [0, 1, 2, 3]),
+            shape=(3, 3))
+        sub = csr[1:3]
+        np.testing.assert_allclose(
+            sub.asnumpy(), [[0, 0, 2], [0, 3, 0]])
+
+    def test_dot_dense(self):
+        rng = np.random.RandomState(0)
+        a = np.where(rng.rand(5, 7) > 0.6, rng.rand(5, 7), 0).astype(
+            np.float32)
+        b = rng.rand(7, 3).astype(np.float32)
+        csr = sparse.csr_matrix(a)
+        out = sparse.dot(csr, mx.nd.array(b))
+        np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+
+    def test_dot_transpose(self):
+        rng = np.random.RandomState(1)
+        a = np.where(rng.rand(4, 6) > 0.5, rng.rand(4, 6), 0).astype(
+            np.float32)
+        b = rng.rand(4, 2).astype(np.float32)
+        csr = sparse.csr_matrix(a)
+        out = sparse.dot(csr, mx.nd.array(b), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5)
+
+
+class TestSparseOptimizer:
+    @pytest.mark.parametrize("opt_name,opt_kw", [
+        ("sgd", {"learning_rate": 0.5}),
+        ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+        ("adam", {"learning_rate": 0.1}),
+    ])
+    def test_lazy_rows_match_dense(self, opt_name, opt_kw):
+        """A row-sparse grad must produce the same result as the dense grad
+        on the touched rows, and leave untouched rows strictly unmodified."""
+        rng = np.random.RandomState(0)
+        w0 = rng.rand(6, 3).astype(np.float32)
+        g_rows = np.array([1, 4])
+        g_data = rng.rand(2, 3).astype(np.float32)
+
+        # sparse path
+        w_sp = mx.nd.array(w0)
+        upd = mx.optimizer.get_updater(
+            mx.optimizer.create(opt_name, rescale_grad=1.0, **opt_kw))
+        rsp = sparse.row_sparse_array((g_data, g_rows), shape=(6, 3))
+        upd(0, rsp, w_sp)
+
+        # dense path on the same rows
+        gd = np.zeros((6, 3), np.float32)
+        gd[g_rows] = g_data
+        w_dn = mx.nd.array(w0)
+        upd2 = mx.optimizer.get_updater(
+            mx.optimizer.create(opt_name, rescale_grad=1.0, **opt_kw))
+        upd2(0, mx.nd.array(gd), w_dn)
+
+        sp, dn = w_sp.asnumpy(), w_dn.asnumpy()
+        np.testing.assert_allclose(sp[g_rows], dn[g_rows], rtol=2e-5)
+        np.testing.assert_array_equal(
+            sp[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])  # untouched rows identical
+
+
+class TestKVStoreSparse:
+    def test_row_sparse_pull(self):
+        kv = mx.kv.create("local")
+        w = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+        kv.init("emb", mx.nd.array(w))
+        rsp = kv.row_sparse_pull("emb", row_ids=mx.nd.array([0, 3]))
+        assert rsp.stype == "row_sparse"
+        np.testing.assert_allclose(rsp.data.asnumpy(), w[[0, 3]])
+
+    def test_push_row_sparse_updates(self):
+        kv = mx.kv.create("local")
+        kv.init("w", mx.nd.zeros((4, 2)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                          rescale_grad=1.0))
+        g = sparse.row_sparse_array((np.ones((1, 2), np.float32), [2]),
+                                    shape=(4, 2))
+        kv.push("w", g)
+        out = mx.nd.zeros((4, 2))
+        kv.pull("w", out=out)
+        d = out.asnumpy()
+        np.testing.assert_allclose(d[2], [-1.0, -1.0])
+        np.testing.assert_array_equal(d[[0, 1, 3]], 0)
