@@ -184,7 +184,11 @@ class Estimator:
         if not self.train_metrics:
             self.train_metrics = [Accuracy()]
         if not self.val_metrics:
-            self.val_metrics = [type(m)() for m in self.train_metrics]
+            import copy
+
+            # deep copy keeps the metrics' constructor config (top_k, axis,
+            # names) — type(m)() would silently evaluate a different metric
+            self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
         self.train_loss_metric = LossMetric()
         self.trainer = trainer or Trainer(
             net.collect_params(), "adam", {"learning_rate": 0.001})

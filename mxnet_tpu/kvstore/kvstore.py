@@ -128,8 +128,16 @@ class KVStore:
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
         keys, outs = _pairs(key, out)
-        ids_list = row_ids if isinstance(row_ids, (list, tuple)) else \
-            [row_ids] * len(keys)
+        # A single key always gets row_ids verbatim; only a multi-key pull
+        # interprets a list as per-key id sets (a plain Python list of ints
+        # for one key would otherwise be zipped element-per-key).
+        if isinstance(key, (str, int)):
+            ids_list = [row_ids]
+        elif isinstance(row_ids, (list, tuple)) and \
+                len(row_ids) == len(keys):
+            ids_list = list(row_ids)
+        else:
+            ids_list = [row_ids] * len(keys)
         results = []
         for k, o, ids in zip(keys, outs, ids_list):
             if k not in self._data:

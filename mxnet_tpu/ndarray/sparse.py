@@ -91,6 +91,15 @@ class RowSparseNDArray(BaseSparseNDArray):
             raise MXNetError("data and indices row counts differ")
         if tuple(data.shape[1:]) != tuple(shape[1:]):
             raise MXNetError("data row shape must match dense row shape")
+        if indices.shape[0] > 1:
+            # keep indices ascending — every searchsorted consumer (retain,
+            # kvstore row gathers) depends on it; argsort of an already
+            # sorted vector is the identity, so this is cheap and jittable
+            import jax.numpy as jnp
+
+            order = jnp.argsort(indices._data)
+            indices = NDArray(indices._data[order], indices._ctx)
+            data = NDArray(data._data[order], data._ctx)
         self.data = data
         self.indices = indices
 
@@ -309,14 +318,15 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         rows = _row_ids_from_indptr(lhs.indptr._data, lhs.nnz)
         cols = lhs.indices._data.astype(jnp.int32)
         vals = lhs.data._data
+        r = rhs._data.T if transpose_b else rhs._data
         if not transpose_a:
-            # out[r] += vals[j] * rhs[cols[j]]  grouped by row
-            contrib = vals[:, None] * rhs._data[cols]
-            out = jnp.zeros((lhs.shape[0], rhs.shape[1]), vals.dtype)
+            # out[row] += vals[j] * r[cols[j]]  grouped by row
+            contrib = vals[:, None] * r[cols]
+            out = jnp.zeros((lhs.shape[0], r.shape[1]), vals.dtype)
             out = out.at[rows].add(contrib)
             return NDArray(out, rhs._ctx)
-        contrib = vals[:, None] * rhs._data[rows]
-        out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype)
+        contrib = vals[:, None] * r[rows]
+        out = jnp.zeros((lhs.shape[1], r.shape[1]), vals.dtype)
         out = out.at[cols].add(contrib)
         return NDArray(out, rhs._ctx)
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):

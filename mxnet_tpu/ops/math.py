@@ -632,11 +632,9 @@ def _batch_take(a, indices):
 @register("pick")
 def _pick(a, indices, axis=-1, keepdims=False, mode="clip"):
     idx = indices.astype(jnp.int32)
-    if idx.ndim == a.ndim:
-        # indices may already carry a size-1 dim at `axis` (e.g. labels of
-        # shape (B, 1) picked from (B, C) — reference pick accepts both)
-        pass
-    else:
+    # indices may already carry a size-1 dim at `axis` (labels of shape
+    # (B, 1) picked from (B, C)) — reference pick accepts both layouts
+    if idx.ndim != a.ndim:
         idx = jnp.expand_dims(idx, axis=axis)
     out = jnp.take_along_axis(a, idx, axis=axis)
     return out if keepdims else jnp.squeeze(out, axis=axis)

@@ -91,6 +91,7 @@ class TestEstimator:
         return model
 
     def test_fit_learns(self):
+        np.random.seed(0)  # DataLoader shuffle uses the global numpy RNG
         model = self._model()
         est = gluon.contrib.Estimator(
             model, gluon.loss.SoftmaxCrossEntropyLoss(),

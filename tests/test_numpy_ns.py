@@ -1,0 +1,85 @@
+"""mx.np namespace tests — the VERDICT-named surface (einsum, cumsum,
+percentile, boolean indexing) plus set_np toggle semantics.
+
+Mirrors the reference's tests/python/unittest/test_numpy_op.py subset.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+class TestNumpyOps:
+    def test_einsum(self):
+        a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(1).rand(4, 5).astype(np.float32)
+        out = mx.np.einsum("ij,jk->ik", mx.np.array(a), mx.np.array(b))
+        np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-5)
+        tr = mx.np.einsum("ii->i", mx.np.array(a[:3, :3]))
+        np.testing.assert_allclose(np.asarray(tr), np.diag(a[:3, :3]),
+                                   rtol=1e-6)
+
+    def test_cumsum_percentile_quantile(self):
+        a = np.random.RandomState(2).rand(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(mx.np.cumsum(mx.np.array(a), axis=1)),
+            np.cumsum(a, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(mx.np.percentile(mx.np.array(a), 30)),
+            np.percentile(a, 30), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(mx.np.quantile(mx.np.array(a), 0.7, axis=0)),
+            np.quantile(a, 0.7, axis=0), rtol=1e-5)
+
+    def test_boolean_indexing(self):
+        a = mx.np.array([1.0, -2.0, 3.0, -4.0])
+        out = a[a > 0]
+        np.testing.assert_allclose(np.asarray(out), [1.0, 3.0])
+
+    def test_bincount_diff_unique(self):
+        x = mx.np.array([0, 1, 1, 3, 3, 3], dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(mx.np.bincount(x)),
+                                      [1, 2, 0, 3])
+        a = mx.np.array([1.0, 3.0, 6.0, 10.0])
+        np.testing.assert_allclose(np.asarray(mx.np.diff(a)), [2, 3, 4])
+        u = mx.np.unique(mx.np.array([3.0, 1.0, 3.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(u), [1, 2, 3])
+
+    def test_insert_delete(self):
+        a = mx.np.array([1.0, 2.0, 4.0])
+        np.testing.assert_allclose(
+            np.asarray(mx.np.insert(a, 2, 3.0)), [1, 2, 3, 4])
+        np.testing.assert_allclose(
+            np.asarray(mx.np.delete(a, 1)), [1, 4])
+
+    def test_true_scalars(self):
+        """np semantics: 0-d results behave like scalars."""
+        s = mx.np.sum(mx.np.array([1.0, 2.0]))
+        assert float(s) == 3.0
+        assert np.asarray(s).shape == ()
+
+    def test_linalg_subset(self):
+        a = np.eye(3, dtype=np.float32) * 2
+        np.testing.assert_allclose(
+            np.asarray(mx.np.linalg.det(mx.np.array(a))), 8.0, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(mx.np.linalg.norm(mx.np.array([3.0, 4.0]))), 5.0,
+            rtol=1e-6)
+
+    def test_random_namespace(self):
+        mx.np.random.seed(3)
+        u = mx.np.random.uniform(0, 1, size=(100,))
+        arr = np.asarray(u)
+        assert arr.shape == (100,) and (arr >= 0).all() and (arr < 1).all()
+
+
+class TestSetNp:
+    def test_toggle(self):
+        assert not mx.util.is_np_array()
+        mx.util.set_np()
+        try:
+            assert mx.util.is_np_array()
+        finally:
+            mx.util.reset_np() if hasattr(mx.util, "reset_np") else \
+                mx.util.set_np(shape=False, array=False)
+        assert not mx.util.is_np_array()

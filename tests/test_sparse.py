@@ -161,3 +161,32 @@ class TestKVStoreSparse:
         d = out.asnumpy()
         np.testing.assert_allclose(d[2], [-1.0, -1.0])
         np.testing.assert_array_equal(d[[0, 1, 3]], 0)
+
+
+class TestSparseEdgeCases:
+    def test_unsorted_indices_sorted_on_construction(self):
+        data = np.array([[3., 3.], [1., 1.]], np.float32)
+        rsp = sparse.row_sparse_array((data, [3, 1]), shape=(5, 2))
+        np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+        kept = sparse.retain(rsp, mx.nd.array([1, 3]))
+        np.testing.assert_allclose(kept.asnumpy()[1], [1., 1.])
+        np.testing.assert_allclose(kept.asnumpy()[3], [3., 3.])
+
+    def test_retain_empty_rsp(self):
+        r = sparse.retain(sparse.zeros("row_sparse", (4, 2)),
+                          mx.nd.array([0, 2]))
+        assert r.asnumpy().sum() == 0
+
+    def test_dot_transpose_b(self):
+        a = np.array([[1., 0.], [0., 2.]], np.float32)
+        b = np.array([[1., 2.], [3., 4.]], np.float32)
+        csr = sparse.csr_matrix(a)
+        out = sparse.dot(csr, mx.nd.array(b), transpose_b=True)
+        np.testing.assert_allclose(out.asnumpy(), a @ b.T)
+
+    def test_row_sparse_pull_plain_list(self):
+        kv = mx.kv.create("local")
+        w = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+        kv.init("emb", mx.nd.array(w))
+        rsp = kv.row_sparse_pull("emb", row_ids=[0, 3])
+        np.testing.assert_allclose(rsp.data.asnumpy(), w[[0, 3]])
