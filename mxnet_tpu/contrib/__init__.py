@@ -13,6 +13,7 @@ def __getattr__(name):
 
     lazy = {
         "tensorboard": ".tensorboard",
+        "quantization": ".quantization",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

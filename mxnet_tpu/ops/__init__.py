@@ -12,5 +12,7 @@ from . import rnn as _rnn              # noqa: F401  fused RNN
 from . import optimizer_ops as _opt    # noqa: F401  optimizer updates
 from . import random_ops as _rand      # noqa: F401  samplers
 from . import detection as _det        # noqa: F401  SSD/R-CNN contrib ops
+from . import control_flow as _cf      # noqa: F401  foreach/while/cond
+from . import quantization as _quant   # noqa: F401  int8 quantize family
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]

@@ -787,3 +787,43 @@ def _hard_sigmoid(a, alpha=0.2, beta=0.5):
 @register("digamma")
 def _digamma(a):
     return jax.lax.digamma(a)
+
+
+@register("reverse", aliases=("_reverse",))
+def _reverse(a, axis=0):
+    """Reverse along axes (src/operator/tensor/matrix_op.cc reverse)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(a, axis=axes)
+
+
+@register("_ravel_multi_index", no_grad=True, aliases=("ravel_multi_index",))
+def _ravel_multi_index(data, shape=None):
+    """(N, K) coordinate rows -> flat indices (src/operator/tensor/
+    ravel.cc)."""
+    strides = _np.cumprod([1] + list(shape[::-1]))[::-1][1:]
+    s = jnp.asarray(strides.copy(), data.dtype)
+    return jnp.sum(data * s[:, None], axis=0)
+
+
+@register("_unravel_index", no_grad=True, aliases=("unravel_index",))
+def _unravel_index(data, shape=None):
+    """Flat indices -> (K, N) coordinates (ravel.cc UnravelIndex)."""
+    idx = data.astype(jnp.int32)
+    coords = []
+    for dim in reversed(shape):
+        coords.append(idx % dim)
+        idx = idx // dim
+    return jnp.stack(coords[::-1], axis=0).astype(data.dtype)
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def _index_copy(old, index, new):
+    """Copy rows of `new` into `old` at `index`
+    (src/operator/contrib/index_copy.cc)."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_add", aliases=("index_add",))
+def _index_add(old, index, new):
+    """Accumulate rows of `new` into `old` at `index` (contrib index_add)."""
+    return old.at[index.astype(jnp.int32)].add(new)

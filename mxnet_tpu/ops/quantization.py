@@ -1,0 +1,103 @@
+"""INT8 quantization operators.
+
+Capability parity with src/operator/quantization/ (quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc). Symmetric int8 (scale =
+127 / max|range|) and affine uint8 (scale = 255 / (max-min)) mappings,
+matching the reference's MaxAbs/MinMax conventions, so calibrated ranges
+transfer. On TPU these are used by the fake-quant graph pass in
+contrib/quantization.py — the int8 *accuracy* flow; int8 *throughput*
+(XLA int8 matmuls) can slot in underneath without changing the surface.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _int8_range(min_r, max_r):
+    return jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+
+
+@register("_contrib_quantize", num_outputs=3, no_grad=True,
+          aliases=("quantize",))
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """Quantize fp32 -> int8/uint8 given calibrated ranges
+    (quantize.cc). Returns (quantized, out_min, out_max)."""
+    min_r = min_range.reshape(())
+    max_r = max_range.reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(max_r - min_r, 1e-20)
+        q = jnp.clip(jnp.round((data - min_r) * scale), 0, 255)
+        return q.astype(jnp.uint8), min_r, max_r
+    real = _int8_range(min_r, max_r)
+    scale = 127.0 / jnp.maximum(real, 1e-20)
+    q = jnp.clip(jnp.round(data * scale), -127, 127)
+    return q.astype(jnp.int8), -real, real
+
+
+@register("_contrib_quantize_v2", num_outputs=3, no_grad=True,
+          aliases=("quantize_v2",))
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8"):
+    """Quantize with optional calibrated ranges; computes min/max from the
+    data when not calibrated (quantize_v2.cc). out_type='auto' picks uint8
+    for non-negative calibrated ranges, int8 otherwise (the reference's
+    rule for post-relu layers)."""
+    if out_type not in ("int8", "uint8", "auto"):
+        raise ValueError(f"unsupported out_type {out_type!r}")
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(data)
+        max_r = jnp.max(data)
+        if out_type == "auto":
+            out_type = "int8"  # data-dependent sign can't pick a dtype
+    else:
+        min_r = jnp.asarray(min_calib_range, jnp.float32)
+        max_r = jnp.asarray(max_calib_range, jnp.float32)
+        if out_type == "auto":
+            out_type = ("uint8" if float(min_calib_range) >= 0.0
+                        else "int8")
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(max_r - min_r, 1e-20)
+        q = jnp.clip(jnp.round((data - min_r) * scale), 0, 255)
+        return q.astype(jnp.uint8), min_r, max_r
+    real = _int8_range(min_r, max_r)
+    scale = 127.0 / jnp.maximum(real, 1e-20)
+    q = jnp.clip(jnp.round(data * scale), -127, 127)
+    return q.astype(jnp.int8), -real, real
+
+
+@register("_contrib_dequantize", no_grad=True, aliases=("dequantize",))
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/uint8 -> fp32 (dequantize.cc)."""
+    min_r = min_range.reshape(())
+    max_r = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (max_r - min_r) / 255.0
+        return data.astype(jnp.float32) * scale + min_r
+    real = _int8_range(min_r, max_r)
+    return data.astype(jnp.float32) * (real / 127.0)
+
+
+@register("_contrib_requantize", num_outputs=3, no_grad=True,
+          aliases=("requantize",))
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 accumulator -> int8 with recalibrated range (requantize.cc).
+    The int32 grid spans the full int32 range (quantization_utils.h:87
+    MinAbs(int32 max/min) = 2147483647) so calibrated ranges transfer from
+    the reference."""
+    min_r = min_range.reshape(())
+    max_r = max_range.reshape(())
+    real_in = _int8_range(min_r, max_r)
+    fp = data.astype(jnp.float32) * (real_in / 2147483647.0)
+    if min_calib_range is not None and max_calib_range is not None:
+        out_min = jnp.asarray(min_calib_range, jnp.float32)
+        out_max = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        out_max = jnp.max(jnp.abs(fp))
+        out_min = -out_max
+    real_out = _int8_range(out_min, out_max)
+    q = jnp.clip(jnp.round(fp * 127.0 / jnp.maximum(real_out, 1e-20)),
+                 -127, 127)
+    return q.astype(jnp.int8), -real_out, real_out

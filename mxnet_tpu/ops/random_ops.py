@@ -90,3 +90,76 @@ _elem_sampler("_sample_normal", lambda k, mu, sig, s, d:
               jax.random.normal(k, s, d) * _bshape(sig, s) + _bshape(mu, s))
 _elem_sampler("_sample_gamma", lambda k, a, b, s, d:
               jax.random.gamma(k, _bshape(a, s), s, d) * _bshape(b, s))
+
+
+# ---------------------------------------------------------------------------
+# pdf ops (src/operator/random/pdf_op.cc): evaluate the density of samples
+# under parameterized distributions. Differentiable w.r.t. samples AND
+# parameters via jax.vjp — the reference hand-writes each backward kernel.
+# Sample shape: (batch..., n); parameter shape: (batch...,) broadcast over
+# the trailing sample axis.
+# ---------------------------------------------------------------------------
+
+def _pdf_op(name, log_fn):
+    def _fn(sample, *params, is_log=False):
+        lp = log_fn(sample, *[p[..., None] for p in params])
+        return lp if is_log else jnp.exp(lp)
+
+    _fn.__name__ = name
+    # set before register(): OpDef captures __doc__ at registration time
+    _fn.__doc__ = (f"{name}: density (or log-density with is_log=True) of "
+                   "`sample` under the given distribution parameters "
+                   "(parity: src/operator/random/pdf_op.cc).")
+    return register(name)(_fn)
+
+
+_pdf_op("_random_pdf_uniform",
+        lambda x, lo, hi: jnp.where(
+            (x >= lo) & (x <= hi), -jnp.log(hi - lo), -jnp.inf))
+_pdf_op("_random_pdf_normal",
+        lambda x, mu, sigma: (-0.5 * jnp.square((x - mu) / sigma)
+                              - jnp.log(sigma)
+                              - 0.5 * jnp.log(2 * jnp.pi)))
+_pdf_op("_random_pdf_exponential",
+        lambda x, lam: jnp.where(x >= 0, jnp.log(lam) - lam * x, -jnp.inf))
+_pdf_op("_random_pdf_gamma",
+        lambda x, alpha, beta: jnp.where(
+            x > 0,
+            alpha * jnp.log(beta) + (alpha - 1) * jnp.log(x) - beta * x
+            - jax.scipy.special.gammaln(alpha), -jnp.inf))
+_pdf_op("_random_pdf_poisson",
+        lambda x, lam: (x * jnp.log(lam) - lam
+                        - jax.scipy.special.gammaln(x + 1)))
+_pdf_op("_random_pdf_negative_binomial",
+        lambda x, k, p: (jax.scipy.special.gammaln(x + k)
+                         - jax.scipy.special.gammaln(x + 1)
+                         - jax.scipy.special.gammaln(k)
+                         + k * jnp.log(p) + x * jnp.log1p(-p)))
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          param_normalizer=lambda p: p)
+def _pdf_gnb(sample, mu, alpha, is_log=False):
+    """Generalized negative binomial density (pdf_op.cc PDF_GenNegBinomial):
+    mean mu, dispersion alpha."""
+    mu = mu[..., None]
+    alpha = alpha[..., None]
+    x = sample
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lp = (jax.scipy.special.gammaln(x + r)
+          - jax.scipy.special.gammaln(x + 1)
+          - jax.scipy.special.gammaln(r)
+          + r * jnp.log(p) + x * jnp.log1p(-p))
+    return lp if is_log else jnp.exp(lp)
+
+
+@register("_random_pdf_dirichlet", param_normalizer=lambda p: p)
+def _pdf_dirichlet(sample, alpha, is_log=False):
+    """Dirichlet density: sample (..., n, k), alpha (..., k) broadcast over
+    the n sample axis."""
+    a = alpha[..., None, :]
+    lp = (jnp.sum((a - 1) * jnp.log(sample), axis=-1)
+          + jax.scipy.special.gammaln(jnp.sum(a, axis=-1))
+          - jnp.sum(jax.scipy.special.gammaln(a), axis=-1))
+    return lp if is_log else jnp.exp(lp)

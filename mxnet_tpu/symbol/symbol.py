@@ -304,6 +304,15 @@ class Symbol:
                     for i, s in enumerate(out_shapes):
                         known[(id(n), i)] = s
                     changed = True
+                # element-shaped ops propagate a known OUTPUT shape back to
+                # their primary input (lets parameter hooks see through
+                # quantize/dequantize pairs to the weight variable)
+                if op.name in _SHAPE_PASSTHROUGH and \
+                        known.get((id(n), 0)) is not None and n.inputs:
+                    node_i, slot_i = n.inputs[0]
+                    if known.get((id(node_i), slot_i)) is None:
+                        known[(id(node_i), slot_i)] = known[(id(n), 0)]
+                        changed = True
         arg_shapes = []
         for name in self.list_arguments():
             node = next(x for x in nodes if x.is_var and x.name == name)
@@ -500,6 +509,13 @@ _PARAM_SHAPE_HOOKS = {
     "InstanceNorm": _groupnorm_hook,
     "Embedding": _embedding_hook,
     "RNN": _rnn_hook,
+}
+
+# ops whose primary output shape equals their primary input shape; a known
+# output back-propagates to the input during fixpoint inference
+_SHAPE_PASSTHROUGH = {
+    "_contrib_quantize_v2", "_contrib_dequantize", "amp_cast", "Cast",
+    "identity", "BlockGrad",
 }
 
 

@@ -1,0 +1,222 @@
+"""INT8 quantization flow + random pdf ops + misc op gap tests.
+
+Mirrors the reference's tests/python/quantization/test_quantization.py
+(quantize/dequantize/requantize roundtrips, quantize_model accuracy) and
+test_random.py pdf cases (validated against scipy).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+class TestQuantizeOps:
+    def test_int8_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = (rng.rand(4, 6).astype(np.float32) - 0.3) * 2
+        q, lo, hi = mx.nd.quantize_v2(mx.nd.array(x))
+        assert q.asnumpy().dtype == np.int8
+        back = mx.nd.dequantize(q, lo, hi).asnumpy()
+        # int8 grid resolution over the data's own range
+        step = np.abs(x).max() / 127
+        np.testing.assert_allclose(back, x, atol=step * 0.51 + 1e-6)
+
+    def test_uint8_roundtrip(self):
+        x = np.random.RandomState(1).rand(3, 5).astype(np.float32) * 4 + 1
+        q, lo, hi = mx.nd.quantize_v2(mx.nd.array(x), out_type="uint8")
+        assert q.asnumpy().dtype == np.uint8
+        back = mx.nd.dequantize(q, lo, hi).asnumpy()
+        step = (x.max() - x.min()) / 255
+        np.testing.assert_allclose(back, x, atol=step * 0.51 + 1e-6)
+
+    def test_calibrated_range_clips(self):
+        x = np.array([0.5, 2.0, -3.0], np.float32)
+        q, lo, hi = mx.nd.quantize_v2(mx.nd.array(x), min_calib_range=-1.0,
+                                      max_calib_range=1.0)
+        back = mx.nd.dequantize(q, lo, hi).asnumpy()
+        np.testing.assert_allclose(back, [0.5, 1.0, -1.0], atol=0.01)
+
+    def test_quantize_op_with_ranges(self):
+        x = np.array([[-1.0, 0.5, 1.0]], np.float32)
+        q, lo, hi = mx.nd.quantize(mx.nd.array(x), mx.nd.array([-1.0]),
+                                   mx.nd.array([1.0]))
+        np.testing.assert_array_equal(q.asnumpy(), [[-127, 64, 127]])
+
+
+class TestQuantizeModel:
+    def test_fake_quant_accuracy(self):
+        from mxnet_tpu.contrib.quantization import quantize_model
+        from mxnet_tpu.io import NDArrayIter
+
+        rng = np.random.RandomState(0)
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=16,
+                                 name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+        out = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                                name="softmax")
+        args = {
+            "fc1_weight": mx.nd.array(rng.randn(16, 8).astype(np.float32)
+                                      * 0.4),
+            "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.array(rng.randn(4, 16).astype(np.float32)
+                                      * 0.4),
+            "fc2_bias": mx.nd.zeros((4,)),
+        }
+        X = rng.rand(64, 8).astype(np.float32)
+        calib = NDArrayIter(X, np.zeros(64, np.float32), batch_size=16)
+        qsym, qargs, _ = quantize_model(out, args, {}, calib_mode="naive",
+                                        calib_data=calib)
+        # quantize nodes got calibrated ranges
+        qjson = qsym.list_arguments()
+        assert set(qjson) == set(out.list_arguments())
+        x = mx.nd.array(X[:16])
+        lbl = mx.nd.zeros((16,))
+        fp = out.bind(mx.cpu(), {**args, "data": x, "softmax_label": lbl}
+                      ).forward()[0].asnumpy()
+        qd = qsym.bind(mx.cpu(), {**qargs, "data": x, "softmax_label": lbl}
+                       ).forward()[0].asnumpy()
+        assert np.abs(fp - qd).max() < 0.05
+        assert (fp.argmax(1) == qd.argmax(1)).mean() >= 0.9
+
+    def test_excluded_layers_untouched(self):
+        from mxnet_tpu.contrib.quantization import quantize_graph
+
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                                 name="fca")
+        net = sym.FullyConnected(net, num_hidden=2, name="fcb")
+        q = quantize_graph(net, excluded_sym_names=("fca",))
+        names = [n.name for n in q._topo_nodes()]
+        assert any("fcb_in0_quantize" in n for n in names)
+        assert not any("fca_in0_quantize" in n for n in names)
+
+    def test_bad_config_raises(self):
+        from mxnet_tpu.contrib.quantization import quantize_model
+
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=2)
+        with pytest.raises(mx.MXNetError):
+            quantize_model(net, {}, {}, calib_mode="entropy")
+        with pytest.raises(mx.MXNetError):
+            quantize_model(net, {}, {}, quantized_dtype="int4")
+
+
+PDF_CASES = [
+    ("_random_pdf_normal",
+     lambda x, p: scipy_stats.norm.pdf(x, loc=p[0], scale=p[1]),
+     [np.array([0.5]), np.array([1.2])]),
+    ("_random_pdf_uniform",
+     lambda x, p: scipy_stats.uniform.pdf(x, loc=p[0], scale=p[1] - p[0]),
+     [np.array([0.0]), np.array([2.0])]),
+    ("_random_pdf_exponential",
+     lambda x, p: scipy_stats.expon.pdf(x, scale=1 / p[0]),
+     [np.array([1.5])]),
+    ("_random_pdf_gamma",
+     lambda x, p: scipy_stats.gamma.pdf(x, a=p[0], scale=1 / p[1]),
+     [np.array([2.0]), np.array([1.5])]),
+    ("_random_pdf_poisson",
+     lambda x, p: scipy_stats.poisson.pmf(x, mu=p[0]),
+     [np.array([3.0])]),
+    ("_random_pdf_negative_binomial",
+     lambda x, p: scipy_stats.nbinom.pmf(x, n=p[0], p=p[1]),
+     [np.array([4.0]), np.array([0.4])]),
+]
+
+
+class TestPdfOps:
+    @pytest.mark.parametrize("opname,scipy_fn,params", PDF_CASES,
+                             ids=[c[0] for c in PDF_CASES])
+    def test_matches_scipy(self, opname, scipy_fn, params):
+        if "poisson" in opname or "binomial" in opname:
+            x = np.array([[0.0, 1.0, 3.0, 6.0]], np.float32)
+        else:
+            x = np.array([[0.3, 0.9, 1.7]], np.float32)
+        args = [mx.nd.array(x)] + [mx.nd.array(p.astype(np.float32))
+                                   for p in params]
+        out = getattr(mx.nd, opname)(*args).asnumpy()
+        expected = scipy_fn(x, [float(p[0]) for p in params])
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-6)
+        logout = getattr(mx.nd, opname)(*args, is_log=True).asnumpy()
+        np.testing.assert_allclose(np.exp(logout), expected, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_pdf_gradient(self):
+        """Densities are differentiable w.r.t. parameters (the reference
+        hand-writes these backwards)."""
+        mu = mx.nd.array([0.5])
+        mu.attach_grad()
+        xv = np.array([0.2, 1.4])
+        x = mx.nd.array(xv[None])
+        with mx.autograd.record():
+            p = mx.nd._random_pdf_normal(x, mu, mx.nd.array([1.0]))
+            loss = p.sum()
+        loss.backward()
+        g = mu.grad.asnumpy()
+        # d/dmu sum(pdf) = sum(pdf * (x - mu))
+        pv = scipy_stats.norm.pdf(xv, 0.5, 1.0)
+        expected = (pv * (xv - 0.5)).sum()
+        np.testing.assert_allclose(g, [expected], rtol=1e-4)
+
+    def test_dirichlet(self):
+        x = np.array([[[0.2, 0.3, 0.5]]], np.float32)
+        alpha = np.array([[1.0, 2.0, 3.0]], np.float32)
+        out = mx.nd._random_pdf_dirichlet(mx.nd.array(x),
+                                          mx.nd.array(alpha)).asnumpy()
+        expected = scipy_stats.dirichlet.pdf(x[0, 0], alpha[0])
+        np.testing.assert_allclose(out[0, 0], expected, rtol=1e-4)
+
+
+class TestOpGaps:
+    def test_reverse(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(
+            mx.nd.reverse(mx.nd.array(a), axis=1).asnumpy(), a[:, ::-1])
+
+    def test_ravel_unravel(self):
+        np.testing.assert_allclose(
+            mx.nd._ravel_multi_index(
+                mx.nd.array([[0, 1], [2, 0]], dtype=np.float32),
+                shape=(2, 3)).asnumpy(),
+            np.ravel_multi_index(([0, 1], [2, 0]), (2, 3)))
+        np.testing.assert_allclose(
+            mx.nd._unravel_index(mx.nd.array([2, 3], dtype=np.float32),
+                                 shape=(2, 3)).asnumpy(),
+            np.array(np.unravel_index([2, 3], (2, 3))))
+
+    def test_index_copy_add(self):
+        out = mx.nd.index_copy(mx.nd.zeros((4, 2)), mx.nd.array([1, 3]),
+                               mx.nd.ones((2, 2)))
+        np.testing.assert_array_equal(out.asnumpy().sum(1), [0, 2, 0, 2])
+        out2 = mx.nd.index_add(out, mx.nd.array([1, 1]),
+                               mx.nd.ones((2, 2)))
+        np.testing.assert_array_equal(out2.asnumpy()[1], [3, 3])
+
+
+def test_quantized_model_through_module():
+    """simple_bind shape inference sees through quantize/dequantize pairs
+    to the weight variables (Module path, not just explicit bind)."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.io import NDArrayIter
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    y = (X.sum(1) > 4).astype(np.float32)
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=8, name="fq1")
+    out = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=2,
+                                               name="fq2"),
+                            sym.Variable("softmax_label"), name="softmax")
+    mod = mx.mod.Module(out)
+    it = NDArrayIter(X, y, batch_size=16)
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    qsym, qargs, qaux = quantize_model(
+        out, arg_params, aux_params, calib_mode="naive",
+        calib_data=NDArrayIter(X, y, batch_size=16))
+    qmod = mx.mod.Module(qsym)
+    it.reset()
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.set_params(qargs, qaux)
+    acc = qmod.score(it, mx.metric.Accuracy())[0][1]
+    assert 0.0 <= acc <= 1.0  # binding + scoring works end to end
