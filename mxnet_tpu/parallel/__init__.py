@@ -18,6 +18,7 @@ def __getattr__(name):
 
     if name in ("ring", "ring_attention"):
         mod = importlib.import_module(".ring_attention", __name__)
-        globals()[name] = mod
-        return mod
+        globals()["ring"] = mod
+        globals()["ring_attention"] = mod.ring_attention
+        return globals()[name]
     raise AttributeError(name)

@@ -1,0 +1,135 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+The long-context capability the north star calls for (absent in the
+reference, whose longest-sequence tool is BucketingModule — SURVEY.md
+§2.3): the sequence axis is sharded over the mesh, each device holds one
+block of Q/K/V, and K/V blocks rotate around the ring via
+`lax.ppermute` while each device accumulates its queries' attention with
+a numerically-stable online (flash-style) softmax. Peak memory per device
+is O(T_local^2) instead of O(T^2), compute overlaps with the ICI
+transfers, and the whole thing is one jitted SPMD program —
+reverse-mode AD through the loop comes from jax for free.
+
+Usage (global arrays, T sharded over 'sp')::
+
+    mesh = parallel.create_mesh({"sp": 8})
+    out = parallel.ring.ring_attention(q, k, v, mesh=mesh, causal=True)
+
+`ring_attention_inner` is the raw per-shard function for embedding inside
+a larger shard_map'd training step.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+__all__ = ["ring_attention", "ring_attention_inner"]
+
+_NEG = -1e30
+
+
+def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Per-shard ring attention body (call inside shard_map).
+
+    q, k, v: (B, H, T_local, D) — this device's sequence block. Returns
+    (B, H, T_local, D) attention output for the local queries over the
+    GLOBAL sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, h, t, d = q.shape
+    s_scale = scale if scale is not None else 1.0 / _np.sqrt(d)
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32)
+    # derive the accumulators from q so they inherit its full
+    # varying-manual-axes type (dp, sp, ...) — fresh constants would make
+    # the fori_loop carry type diverge from the rotating K/V blocks
+    m0 = q32[..., :1] * 0 + _NEG
+    l0 = q32[..., :1] * 0
+    o0 = q32 * 0
+    qpos = my_idx * t + jnp.arange(t)
+
+    def body(i, carry):
+        m, l, o, kc, vc = carry
+        # the K/V block currently held arrived from device (my_idx - i)
+        src = (my_idx - i) % axis_size
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            kc.astype(jnp.float32)) * s_scale
+        if causal:
+            kpos = src * t + jnp.arange(t)
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask, logits, _NEG)
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                      vc.astype(jnp.float32))
+        # rotate K/V one hop around the ring (overlaps with next block's
+        # compute under XLA's async collectives)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return m_new, l_new, o_new, kc, vc
+
+    m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, k, v))
+    return (o / jnp.maximum(l, 1e-20)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_fn(mesh, axis_name, causal, scale):
+    """One jitted SPMD program per (mesh, axis, causal, scale) — re-built
+    closures would defeat jax.jit's identity-keyed cache and recompile on
+    every call."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    inner = functools.partial(ring_attention_inner, axis_name=axis_name,
+                              causal=causal, scale=scale)
+    return jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec))
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                   scale=None):
+    """Sequence-parallel attention over global arrays.
+
+    q, k, v: (B, H, T, D) NDArrays or jax arrays with T divisible by the
+    mesh's `axis_name` size. The sequence axis is sharded over the ring;
+    output has the same global shape/sharding.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import create_mesh
+
+    if mesh is None:
+        mesh = create_mesh({axis_name: len(jax.devices())})
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {axis_name!r} "
+                         "axis; build it with parallel.create_mesh("
+                         f"{{'{axis_name}': n}})")
+    raw = [a._data if hasattr(a, "_data") else jnp.asarray(a)
+           for a in (q, k, v)]
+    t = raw[0].shape[2]
+    n = mesh.shape[axis_name]
+    if t % n != 0:
+        raise ValueError(f"sequence length {t} not divisible by "
+                         f"{axis_name} size {n}")
+    spec = P(None, None, axis_name, None)
+    fn = _ring_fn(mesh, axis_name, causal, scale)
+    arrs = [jax.device_put(a, NamedSharding(mesh, spec)) for a in raw]
+    out = fn(*arrs)
+    if hasattr(q, "_data"):
+        from ..ndarray.ndarray import NDArray
+
+        return NDArray(out, getattr(q, "_ctx", None))
+    return out
