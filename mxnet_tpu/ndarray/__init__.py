@@ -77,11 +77,11 @@ _populate()
 
 
 def __getattr__(name):
-    if name == "contrib":
+    if name in ("contrib", "image"):
         import importlib
 
-        mod = importlib.import_module(".contrib", __name__)
-        setattr(_MODULE, "contrib", mod)
+        mod = importlib.import_module("." + name, __name__)
+        setattr(_MODULE, name, mod)
         return mod
     # late-registered ops resolve lazily
     try:

@@ -1,0 +1,33 @@
+"""mx.nd.image — on-device image op namespace.
+
+Parity: python/mxnet/ndarray/image.py (generated from `_image_`-prefixed
+op names; short name `to_tensor` resolves `_image_to_tensor`).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+_MODULE = _sys.modules[__name__]
+_PREFIX = "_image_"
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    from . import __getattr__ as _nd_getattr
+
+    for candidate in (_PREFIX + name, name):
+        try:
+            fn = _nd_getattr(candidate)
+        except AttributeError:
+            continue
+        setattr(_MODULE, name, fn)
+        return fn
+    raise AttributeError(name)
+
+
+def __dir__():
+    from ..ops.registry import list_ops
+
+    return sorted(n[len(_PREFIX):] for n in list_ops()
+                  if n.startswith(_PREFIX))

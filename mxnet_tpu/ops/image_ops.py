@@ -1,0 +1,171 @@
+"""On-device image operators (mx.nd.image.*).
+
+Capability parity with src/operator/image/ (image_random.cc resize.cc
+crop.cc): batched HWC/NHWC tensor augmentation that runs as XLA programs
+on the accelerator, unlike the host-side PIL path in mxnet_tpu/image/.
+This is the batched on-device augmentation family the inventory calls
+out: apply to whole device-resident batches (e.g. after the C++ loader)
+with everything fusing into the training step.
+
+All ops accept (H, W, C) or (N, H, W, C); random ops draw from the
+framework key stream (rng_key slot) so `mx.random.seed` governs them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _batched(x):
+    return x.ndim == 4
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    axes = (0, 3, 1, 2) if _batched(data) else (2, 0, 1)
+    return jnp.transpose(x, axes)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def _normalize(data, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW/NCHW float input."""
+    mean = jnp.asarray(mean, jnp.float32).reshape(-1, 1, 1)
+    std = jnp.asarray(std, jnp.float32).reshape(-1, 1, 1)
+    return (data - mean) / std
+
+
+@register("_image_flip_left_right", aliases=("image_flip_left_right",))
+def _flip_lr(data):
+    return jnp.flip(data, axis=-2)  # W axis in HWC/NHWC
+
+
+@register("_image_flip_top_bottom", aliases=("image_flip_top_bottom",))
+def _flip_tb(data):
+    return jnp.flip(data, axis=-3)  # H axis
+
+
+def _rand_apply(data, rng_key, fn, p=0.5):
+    import jax.random as jr
+
+    if _batched(data):
+        flips = jr.bernoulli(rng_key, p, (data.shape[0],))
+        return jnp.where(flips[:, None, None, None], fn(data), data)
+    return jax.lax.cond(jr.bernoulli(rng_key, p), fn, lambda d: d, data)
+
+
+@register("_image_random_flip_left_right", mutate=(1,), no_grad=True,
+          aliases=("image_random_flip_left_right",))
+def _random_flip_lr(data, rng_key, p=0.5):
+    key, nxt = jax.random.split(rng_key)
+    return _rand_apply(data, key, _flip_lr, p), nxt
+
+
+@register("_image_random_flip_top_bottom", mutate=(1,), no_grad=True,
+          aliases=("image_random_flip_top_bottom",))
+def _random_flip_tb(data, rng_key, p=0.5):
+    key, nxt = jax.random.split(rng_key)
+    return _rand_apply(data, key, _flip_tb, p), nxt
+
+
+@register("_image_crop", aliases=("image_crop",))
+def _crop(data, x=0, y=0, width=1, height=1):
+    """Fixed-position crop (crop.cc): x/y are the top-left corner."""
+    if _batched(data):
+        return data[:, y:y + height, x:x + width, :]
+    return data[y:y + height, x:x + width, :]
+
+
+@register("_image_resize", aliases=("image_resize",))
+def _resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    """Bilinear/nearest resize (resize.cc); size = (w, h) or int."""
+    if isinstance(size, int):
+        w = h = size
+    else:
+        w, h = (size if len(size) == 2 else (size[0], size[0]))
+    method = "nearest" if interp == 0 else "linear"
+    if _batched(data):
+        shape = (data.shape[0], h, w, data.shape[3])
+    else:
+        shape = (h, w, data.shape[2])
+    return jax.image.resize(data.astype(jnp.float32), shape, method=method
+                            ).astype(data.dtype)
+
+
+def _blend(a, b, ratio):
+    return a * ratio + b * (1.0 - ratio)
+
+
+def _adjust_brightness(data, factor):
+    return data * factor
+
+
+def _adjust_contrast(data, factor):
+    mean = jnp.mean(data, axis=(-3, -2, -1), keepdims=True)
+    return _blend(data, mean, factor)
+
+
+def _adjust_saturation(data, factor):
+    # luminance via ITU-R BT.601 (same coefficients as image_random.cc)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    gray = jnp.sum(data * coef, axis=-1, keepdims=True)
+    return _blend(data, gray, factor)
+
+
+def _uniform_factor(rng_key, lo, hi, data):
+    import jax.random as jr
+
+    if _batched(data):
+        f = jr.uniform(rng_key, (data.shape[0], 1, 1, 1), minval=lo,
+                       maxval=hi)
+    else:
+        f = jr.uniform(rng_key, (), minval=lo, maxval=hi)
+    return f
+
+
+def _random_adjust(name, adjust):
+    @register(f"_image_random_{name}", mutate=(1,), no_grad=True,
+              aliases=(f"image_random_{name}",))
+    def _fn(data, rng_key, min_factor=0.0, max_factor=0.0):
+        key, nxt = jax.random.split(rng_key)
+        f = _uniform_factor(key, 1.0 + min_factor, 1.0 + max_factor, data)
+        return adjust(data.astype(jnp.float32), f), nxt
+
+    _fn.__name__ = f"_image_random_{name}"
+    return _fn
+
+
+_random_adjust("brightness", _adjust_brightness)
+_random_adjust("contrast", _adjust_contrast)
+_random_adjust("saturation", _adjust_saturation)
+
+
+@register("_image_adjust_lighting", aliases=("image_adjust_lighting",))
+def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting with fixed alpha (image_random.cc)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.814],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    delta = (eigvec * alpha * eigval).sum(axis=1)
+    return data + delta
+
+
+@register("_image_random_lighting", mutate=(1,), no_grad=True,
+          aliases=("image_random_lighting",))
+def _random_lighting(data, rng_key, alpha_std=0.05):
+    key, nxt = jax.random.split(rng_key)
+    n = data.shape[0] if _batched(data) else 1
+    alpha = jax.random.normal(key, (n, 3)) * alpha_std
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.814],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    delta = jnp.einsum("nc,rc->nr", alpha * eigval, eigvec)
+    if _batched(data):
+        return data + delta[:, None, None, :], nxt
+    return data + delta[0], nxt
