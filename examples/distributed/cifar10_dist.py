@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training (baseline config #5; reference
+example/distributed_training/cifar10_dist.py).
+
+Launch:
+    python tools/launch.py -n 2 python examples/distributed/cifar10_dist.py
+
+Each worker trains on its shard through kvstore='dist_sync'
+(jax.distributed allreduce); parameters stay bitwise-identical on every
+rank.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+# On CPU hosts each process gets its own device; TPU pods set the platform
+# via their own environment.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args()
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    print(f"[rank {rank}/{nw}] up", flush=True)
+
+    transform = gluon.data.vision.transforms.Compose([
+        gluon.data.vision.transforms.ToTensor()])
+    ds = gluon.data.vision.CIFAR10(train=True).transform_first(transform)
+    # shard the dataset across workers
+    idx = list(range(rank, len(ds), nw))
+    shard = gluon.data.SimpleDataset([ds[i] for i in idx]) \
+        if hasattr(gluon.data, "SimpleDataset") else \
+        gluon.data.ArrayDataset(*map(list, zip(*[ds[i] for i in idx])))
+    loader = gluon.data.DataLoader(shard, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    mx.random.seed(7)  # identical init on every rank
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.002}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in loader:
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+        print(f"[rank {rank}] epoch {epoch}: {metric.get()}", flush=True)
+
+    checksum = sum(float(p.data().asnumpy().sum())
+                   for p in net.collect_params().values())
+    print(f"[rank {rank}] param checksum {checksum:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

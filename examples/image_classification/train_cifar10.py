@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""CIFAR-10-style CNN training with Gluon + hybridize (baseline config #2
+family; reference example/gluon/image_classification.py).
+
+gluon.data.vision.CIFAR10 falls back to a synthetic color-rule dataset
+offline; pass --use-resnet for the model_zoo resnet18_v1.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def build_net(use_resnet):
+    if use_resnet:
+        return vision.resnet18_v1(classes=10, thumbnail=True)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(64, 3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.002)
+    ap.add_argument("--use-resnet", action="store_true")
+    args = ap.parse_args()
+
+    transform = gluon.data.vision.transforms.Compose([
+        gluon.data.vision.transforms.ToTensor()])
+    train_ds = gluon.data.vision.CIFAR10(train=True).transform_first(
+        transform)
+    loader = gluon.data.DataLoader(train_ds, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    net = build_net(args.use_resnet)
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in loader:
+            with mx.autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+        print(f"epoch {epoch}: train {metric.get()}")
+    net.export("cifar10_model")
+    print("exported to cifar10_model-*.params/.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""INT8 quantization flow (reference example/quantization): train fp32,
+calibrate with quantize_model, compare accuracies.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.io import NDArrayIter
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 16).astype(np.float32)
+    y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype(np.float32)
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    out = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+
+    mod = mx.mod.Module(out)
+    mod.fit(NDArrayIter(X, y, 64, shuffle=True), num_epoch=args.epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    fp_acc = mod.score(NDArrayIter(X, y, 64), mx.metric.Accuracy())[0][1]
+
+    qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+        out, arg_params, aux_params, calib_mode="naive",
+        calib_data=NDArrayIter(X, y, 64), num_calib_examples=256)
+    qmod = mx.mod.Module(qsym)
+    it = NDArrayIter(X, y, 64)
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.set_params(qargs, qaux)
+    q_acc = qmod.score(it, mx.metric.Accuracy())[0][1]
+    print(f"fp32 accuracy: {fp_acc:.4f}")
+    print(f"int8 accuracy: {q_acc:.4f} (delta {q_acc - fp_acc:+.4f})")
+
+
+if __name__ == "__main__":
+    main()

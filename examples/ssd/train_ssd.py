@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""SSD-style detector training (baseline config #4 family; reference
+example/ssd). Multi-scale anchors + MultiBoxTarget/Detection with an
+ImageDetIter over synthetic box data offline (pass --imglist/--root for
+real data in the det .lst format).
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+N_CLASSES = 3
+
+
+def synthetic_dataset(n=48, size=64):
+    from PIL import Image
+
+    root = tempfile.mkdtemp()
+    entries = []
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        cls = i % N_CLASSES
+        img = np.full((size, size, 3), 30, np.uint8)
+        x0, y0 = rng.randint(4, size // 2, 2)
+        w, h = rng.randint(size // 4, size // 2, 2)
+        img[y0:y0 + h, x0:x0 + w] = 80 + 60 * cls
+        Image.fromarray(img).save(os.path.join(root, f"d{i}.jpg"))
+        entries.append((np.array([[cls, x0 / size, y0 / size,
+                                   min(1, (x0 + w) / size),
+                                   min(1, (y0 + h) / size)]], np.float32),
+                        f"d{i}.jpg"))
+    return root, entries
+
+
+class SSD(gluon.HybridBlock):
+    """Two feature scales, each with anchors + class/box heads."""
+
+    def __init__(self, num_classes):
+        super().__init__()
+        self.nc = num_classes
+        self.base = gluon.nn.HybridSequential()
+        self.base.add(gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                      activation="relu"),
+                      gluon.nn.Conv2D(32, 3, strides=2, padding=1,
+                                      activation="relu"))
+        self.down = gluon.nn.Conv2D(64, 3, strides=2, padding=1,
+                                    activation="relu")
+        self.cls1 = gluon.nn.Conv2D(4 * (num_classes + 1), 3, padding=1)
+        self.loc1 = gluon.nn.Conv2D(4 * 4, 3, padding=1)
+        self.cls2 = gluon.nn.Conv2D(4 * (num_classes + 1), 3, padding=1)
+        self.loc2 = gluon.nn.Conv2D(4 * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        f1 = self.base(x)
+        f2 = self.down(f1)
+        a1 = F.contrib.MultiBoxPrior(f1, sizes=(0.2, 0.35), ratios=(1, 2, 0.5))
+        a2 = F.contrib.MultiBoxPrior(f2, sizes=(0.5, 0.7), ratios=(1, 2, 0.5))
+        def heads(f, cls, loc):
+            cp = cls(f).transpose((0, 2, 3, 1)).reshape(
+                (0, -1, self.nc + 1))
+            lp = loc(f).transpose((0, 2, 3, 1)).reshape((0, -1))
+            return cp, lp
+        c1, l1 = heads(f1, self.cls1, self.loc1)
+        c2, l2 = heads(f2, self.cls2, self.loc2)
+        anchors = F.Concat(a1, a2, dim=1)
+        cls_pred = F.Concat(c1, c2, dim=1).transpose((0, 2, 1))
+        loc_pred = F.Concat(l1, l2, dim=1)
+        return anchors, cls_pred, loc_pred
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--imglist", default=None)
+    ap.add_argument("--root", default=None)
+    args = ap.parse_args()
+
+    if args.imglist:
+        it = mx.image.ImageDetIter(batch_size=args.batch_size,
+                                   data_shape=(3, 64, 64),
+                                   path_imglist=args.imglist,
+                                   path_root=args.root or "",
+                                   shuffle=True, rand_mirror=True)
+    else:
+        root, entries = synthetic_dataset()
+        it = mx.image.ImageDetIter(batch_size=args.batch_size,
+                                   data_shape=(3, 64, 64), imglist=entries,
+                                   path_root=root, shuffle=True,
+                                   rand_mirror=True)
+
+    net = SSD(N_CLASSES)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.002})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    for epoch in range(args.epochs):
+        it.reset()
+        tot = []
+        for batch in it:
+            x = batch.data[0] / 255.0
+            label = batch.label[0]
+            with mx.autograd.record():
+                anchors, cp, lp = net(x)
+                with mx.autograd.pause():
+                    sm = mx.nd.softmax(cp, axis=1)
+                    lt, lm, ct = mx.nd.contrib.MultiBoxTarget(
+                        anchors, label, sm, negative_mining_ratio=3.0)
+                loss = (cls_loss(cp, ct).mean() +
+                        mx.nd.smooth_l1((lp - lt) * lm, scalar=1.0).mean())
+            loss.backward()
+            trainer.step(x.shape[0])
+            tot.append(float(loss.asnumpy()))
+        print(f"epoch {epoch}: loss {sum(tot)/len(tot):.4f}")
+
+    # detection on one batch
+    it.reset()
+    batch = next(iter(it))
+    anchors, cp, lp = net(batch.data[0] / 255.0)
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.softmax(cp, axis=1), lp, anchors, nms_topk=50)
+    kept = det.asnumpy()[0]
+    kept = kept[kept[:, 0] >= 0]
+    print(f"detections on image 0: {len(kept)} (top: {kept[:3].round(3)})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""MNIST MLP via the Module API (baseline config #1,
+reference example/image-classification/train_mnist.py).
+
+Uses the real MNIST idx files when --data points at them, else a
+synthetic separable dataset so the example runs offline.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+def get_iters(args):
+    if args.data and os.path.exists(os.path.join(args.data,
+                                                 "train-images-idx3-ubyte")):
+        d = args.data
+        train = mx.io.MNISTIter(
+            image=os.path.join(d, "train-images-idx3-ubyte"),
+            label=os.path.join(d, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(d, "t10k-images-idx3-ubyte"),
+            label=os.path.join(d, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=True, shuffle=False)
+        return train, val
+    rng = np.random.RandomState(0)
+    centers = rng.rand(10, 784).astype(np.float32)
+    y = rng.randint(0, 10, 4096)
+    X = centers[y] + rng.randn(4096, 784).astype(np.float32) * 0.15
+    return (mx.io.NDArrayIter(X[:3584], y[:3584].astype(np.float32),
+                              args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(X[3584:], y[3584:].astype(np.float32),
+                              args.batch_size))
+
+
+def mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None, help="dir with MNIST idx files")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    train, val = get_iters(args)
+    mod = mx.mod.Module(mlp())
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    print("final validation:", mod.score(val, mx.metric.Accuracy()))
+
+
+if __name__ == "__main__":
+    main()

@@ -540,9 +540,29 @@ def _interleaved_valatt(qkv, att, heads=1):
 
 
 @register("scaled_dot_product_attention")
-def _sdpa(q, k, v, mask=None, causal=False, scale=None):
-    """TPU-native fused attention (new capability; long-context story lives in
-    parallel/ring_attention.py). q,k,v: (B, H, L, D)."""
+def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
+    """TPU-native fused attention (new capability; long-context story lives
+    in parallel/ring_attention.py). q,k,v: (B, H, L, D).
+
+    impl='flash' opts into the Pallas streaming kernel
+    (ops/pallas_kernels.py): O(T) HBM instead of the O(T^2) score matrix —
+    the inference path for sequences dense attention can't hold. Forward
+    only (no VJP registered); the default XLA composition is
+    differentiable and is what training uses."""
+    if impl == "flash":
+        from .pallas_kernels import flash_attention, pallas_available
+
+        if mask is not None:
+            raise ValueError(
+                "impl='flash' does not support an explicit mask (only "
+                "causal=True); the dense path would defeat the O(T) memory "
+                "guarantee you opted into")
+        if pallas_available():
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        import warnings
+
+        warnings.warn("impl='flash' requires a TPU backend; falling back "
+                      "to the XLA composition")
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / _np.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s

@@ -1,0 +1,146 @@
+"""Pallas TPU kernels for hot ops.
+
+The custom-kernel layer the blueprint reserves for "where fusion matters"
+(SURVEY.md §7): hand-placed VMEM tiling for operations whose fused form
+XLA cannot synthesize. First resident: a streaming flash-attention
+forward — K/V arrive in VMEM one (BLOCK_K, D) tile per grid step, running
+(m, l, acc) online-softmax statistics live in VMEM scratch that persists
+across the innermost grid dimension, and the O(T^2) score matrix never
+exists anywhere. Sequence length is bounded by HBM, not VMEM.
+
+Kernels run on real TPUs (platform + shape gated) with the jnp
+composition as the universal fallback; tests drive the same kernel in
+Pallas interpret mode on CPU so numerics are CI-checked everywhere.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+__all__ = ["flash_attention", "pallas_available"]
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+_NEG = -1e30
+
+
+def pallas_available():
+    import jax
+
+    try:
+        return jax.default_backend() not in ("cpu",) and \
+            any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def _mha_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                scale, causal, n_kb):
+    """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is innermost,
+    so the VMEM scratch (m, l, acc) carries across K blocks of one
+    (batch*head, q-block) pair and the output writes on the last step.
+
+    q_ref (1, BQ, D) / k_ref, v_ref (1, BK, D) / o_ref (1, BQ, D).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(2)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # under causal masking, K blocks strictly in this q block's future are
+    # all-masked: skip their HBM reads and MXU work entirely (~2x on long
+    # sequences)
+    live = (kb * bk <= (qi + 1) * bq - 1) if causal else (kb >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_prev = m_ref[:]
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bq = min(_BLOCK_Q, t)
+    bk = min(_BLOCK_K, t)
+    n_kb = t // bk
+    kernel = functools.partial(_mha_kernel, scale=scale, causal=causal,
+                               n_kb=n_kb)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // bq, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.dtype(dtype_str)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum l
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
+    """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D).
+
+    Requirements: T divisible by the 128 block (or T <= 128), D <= 256.
+    Raises ValueError otherwise — callers fall back to the XLA
+    composition (ops/nn.py scaled_dot_product_attention).
+    """
+    b, h, t, d = q.shape
+    bq = min(_BLOCK_Q, t)
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"flash_attention: unsupported shape — q {q.shape} vs k "
+            f"{k.shape} / v {v.shape} (self-attention only)")
+    if t % bq != 0 or d > 256:
+        raise ValueError(f"flash_attention: unsupported shape T={t} D={d}")
+    s = scale if scale is not None else 1.0 / _np.sqrt(d)
+    fn = _build_flash(b * h, t, d, str(q.dtype), float(s), bool(causal),
+                      bool(interpret))
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    return fn(qf, kf, vf).reshape(b, h, t, d)
