@@ -26,6 +26,7 @@ def _configure_jax():
 
 _configure_jax()
 
+from .attribute import AttrScope
 from .base import MXNetError, __version__
 from .context import (Context, cpu, cpu_pinned, current_context, gpu,
                       num_gpus, num_tpus, tpu)

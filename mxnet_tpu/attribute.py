@@ -1,0 +1,53 @@
+"""AttrScope — scoped attributes for symbol construction.
+
+Capability parity with python/mxnet/attribute.py (AttrScope :28) and its
+uses: `with mx.AttrScope(ctx_group='stage1', lr_mult='0.1'):` stamps every
+node created in the scope. On TPU, `ctx_group` no longer drives manual
+device placement (GSPMD shardings do — SURVEY.md §2.3 model parallelism
+row); the attrs still flow to `Symbol.attr_dict()` where
+`Module.init_optimizer` consumes `__lr_mult__`/`__wd_mult__`, and
+`ctx_group` remains available to sharding-rule authors as a grouping tag.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+class AttrScope:
+    """Attribute manager (attribute.py:28): attrs apply to every symbol
+    node created inside the scope; nested scopes merge (inner wins)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError(
+                    "AttrScope values must be strings (reference contract); "
+                    f"got {type(v).__name__}")
+        self._attrs = {f"__{k}__" if not k.startswith("__") else k: v
+                       for k, v in kwargs.items()}
+
+    def __enter__(self):
+        _stack().append(self._attrs)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def current_attrs():
+    """Merged attrs of all active scopes (outer to inner)."""
+    merged = {}
+    for attrs in _stack():
+        merged.update(attrs)
+    return merged

@@ -256,6 +256,17 @@ class Module(BaseModule):
             optimizer = opt.create(optimizer, sym=self.symbol,
                                    param_idx2name=idx2name,
                                    **optimizer_params)
+            # per-parameter multipliers from symbol attrs (AttrScope /
+            # Variable(lr_mult=...); reference model.py attr_dict flow)
+            attrs = self.symbol.attr_dict()
+            lr_mult = {n: float(a["__lr_mult__"])
+                       for n, a in attrs.items() if "__lr_mult__" in a}
+            wd_mult = {n: float(a["__wd_mult__"])
+                       for n, a in attrs.items() if "__wd_mult__" in a}
+            if lr_mult:
+                optimizer.set_lr_mult(lr_mult)
+            if wd_mult:
+                optimizer.set_wd_mult(wd_mult)
         self._optimizer = optimizer
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore

@@ -550,6 +550,8 @@ def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
     only (no VJP registered); the default XLA composition is
     differentiable and is what training uses."""
     if impl == "flash":
+        import warnings
+
         from .pallas_kernels import flash_attention, pallas_available
 
         if mask is not None:
@@ -558,11 +560,17 @@ def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
                 "causal=True); the dense path would defeat the O(T) memory "
                 "guarantee you opted into")
         if pallas_available():
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        import warnings
-
-        warnings.warn("impl='flash' requires a TPU backend; falling back "
-                      "to the XLA composition")
+            try:
+                # NOTE: inside a trace only the shape gate can fall back;
+                # a program compiled for a CPU device cannot lower the TPU
+                # kernel — eager NDArray callers get automatic placement
+                # via pallas_kernels.flash_attention instead.
+                return flash_attention(q, k, v, causal=causal, scale=scale)
+            except ValueError as e:  # shape gate (trace-time)
+                warnings.warn(f"impl='flash': {e}; falling back to XLA")
+        else:
+            warnings.warn("impl='flash' requires a TPU backend; falling "
+                          "back to the XLA composition")
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / _np.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s

@@ -125,10 +125,30 @@ def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
 def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
     """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D).
 
-    Requirements: T divisible by the 128 block (or T <= 128), D <= 256.
-    Raises ValueError otherwise — callers fall back to the XLA
-    composition (ops/nn.py scaled_dot_product_attention).
+    Requirements: T divisible by the 128 block (or T <= 128), D <= 256,
+    self-attention shapes. Raises ValueError otherwise — callers fall back
+    to the XLA composition (ops/nn.py scaled_dot_product_attention).
+
+    Accepts NDArrays or jax arrays. Eager NDArray calls are placed on the
+    TPU device automatically (or run in interpret mode on CPU-only hosts),
+    since a program compiled for a CPU device cannot lower the kernel.
     """
+    nd_in = hasattr(q, "_data")
+    if nd_in:
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        ctx = getattr(q, "_ctx", None)
+        tpu_devs = [d for d in jax.devices() if d.platform != "cpu"]
+        if tpu_devs:
+            raw = [jax.device_put(a._data, tpu_devs[0]) for a in (q, k, v)]
+        else:
+            raw = [a._data for a in (q, k, v)]
+            interpret = True
+        out = flash_attention(*raw, causal=causal, scale=scale,
+                              interpret=interpret)
+        return NDArray(out, ctx)
     b, h, t, d = q.shape
     bq = min(_BLOCK_Q, t)
     if k.shape != q.shape or v.shape != q.shape:

@@ -55,6 +55,10 @@ class Optimizer:
         self.param_dict = param_dict or {}
         self.lr_mult = {}
         self.wd_mult = {}
+        # bias/gamma/beta get zero weight decay by default, unconditionally
+        # (reference Optimizer.__init__ calls set_wd_mult({}) itself — the
+        # defaults must not depend on whether a user ever sets a mult)
+        self.set_wd_mult({})
         self.aggregate_num = 0
 
     def create_state(self, index, weight):

@@ -36,6 +36,8 @@ def _auto_name(kind):
 
 import itertools as _itertools
 
+from ..attribute import current_attrs as _current_attrs
+
 _node_serial = _itertools.count()
 
 
@@ -56,7 +58,7 @@ class _Node:
         self.name = name
         self.params = params or {}
         self.inputs = inputs or []  # list[(Node, out_idx)]
-        self.attrs = attrs or {}
+        self.attrs = {**_current_attrs(), **(attrs or {})}
         self.aux_mark = False     # variable used in a mutate slot => aux state
         self.serial = next(_node_serial)  # creation order (subgraph cutting)
 

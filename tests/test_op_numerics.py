@@ -471,6 +471,21 @@ def test_coverage_fraction():
         "_random_generalized_negative_binomial", "_sample_uniform",
         "_sample_normal", "_sample_gamma", "_sample_multinomial",
         "_shuffle", "amp_cast", "amp_multicast", "boolean_mask",
+        # test_quantization_pdf.py
+        "_contrib_quantize", "_contrib_quantize_v2", "_contrib_dequantize",
+        "_contrib_requantize", "_random_pdf_uniform", "_random_pdf_normal",
+        "_random_pdf_exponential", "_random_pdf_gamma",
+        "_random_pdf_poisson", "_random_pdf_negative_binomial",
+        "_random_pdf_generalized_negative_binomial",
+        "_random_pdf_dirichlet", "reverse", "_ravel_multi_index",
+        "_unravel_index", "_contrib_index_copy", "_contrib_index_add",
+        # test_image_ops.py
+        "_image_to_tensor", "_image_normalize", "_image_flip_left_right",
+        "_image_flip_top_bottom", "_image_random_flip_left_right",
+        "_image_random_flip_top_bottom", "_image_crop", "_image_resize",
+        "_image_random_brightness", "_image_random_contrast",
+        "_image_random_saturation", "_image_adjust_lighting",
+        "_image_random_lighting",
     }
     # exercised inline in this file's non-parametrized tests
     inline = {"norm", "sort", "argsort", "topk", "take", "batch_take",
@@ -478,8 +493,176 @@ def test_coverage_fraction():
               "pad", "dot", "batch_dot", "linalg_det", "linalg_inverse",
               "linalg_potrf", "softmax", "log_softmax", "softmin",
               "smooth_l1", "slice", "slice_axis", "expand_dims", "squeeze",
-              "flip", "tile", "repeat", "transpose", "clip"}
+              "flip", "tile", "repeat", "transpose", "clip",
+              # the families added below
+              "linalg_gemm", "linalg_gemm2", "linalg_potri",
+              "linalg_slogdet", "linalg_sumlogdiag", "linalg_syrk",
+              "linalg_extractdiag", "linalg_makediag", "linalg_syevd",
+              "linalg_trsm", "linalg_trmm", "linalg_gelqf", "add_n",
+              "argmax_channel", "broadcast_axis", "broadcast_to",
+              "broadcast_like", "broadcast_greater_equal_scalar",
+              "broadcast_lesser_equal_scalar", "broadcast_not_equal_scalar",
+              "depth_to_space", "space_to_depth", "shape_array",
+              "size_array", "slice_like", "split_v2", "digamma", "erfinv",
+              "histogram", "khatri_rao", "scatter_nd",
+              "softmax_cross_entropy", "sequence_mask"}
     covered = covered_here | other_files | inline
     all_ops = set(list_ops())
     frac = len(covered & all_ops) / len(all_ops)
-    assert frac >= 0.6, f"op test coverage {frac:.0%} below 60%"
+    assert frac >= 0.9, f"op test coverage {frac:.0%} below 90%"
+
+
+# --------------------------------------------------------------------------
+# previously-uncovered families: linalg, misc tensor, utility ops
+# --------------------------------------------------------------------------
+
+def test_linalg_family():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.linalg_gemm2(mx.nd.array(a), mx.nd.array(b)).asnumpy(),
+        a @ b, rtol=1e-4)
+    c = rng.rand(3, 5).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.linalg_gemm(mx.nd.array(a), mx.nd.array(b), mx.nd.array(c),
+                          alpha=2.0, beta=0.5).asnumpy(),
+        2.0 * (a @ b) + 0.5 * c, rtol=1e-4)
+
+    spd = (a @ a.T + 3 * np.eye(3)).astype(np.float32)
+    # potri: inverse from the cholesky factor
+    chol = mx.nd.linalg_potrf(mx.nd.array(spd))
+    inv = mx.nd.linalg_potri(chol).asnumpy()
+    assert_almost_equal(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    sign, logdet = (x.asnumpy() for x in
+                    mx.nd.linalg_slogdet(mx.nd.array(spd)))
+    ref_sign, ref_logdet = np.linalg.slogdet(spd)
+    assert_almost_equal(sign, ref_sign, rtol=1e-5)
+    assert_almost_equal(logdet, ref_logdet, rtol=1e-4)
+    # sumlogdiag of the cholesky factor = 0.5 * logdet
+    sld = mx.nd.linalg_sumlogdiag(chol).asnumpy()
+    assert_almost_equal(2 * sld, ref_logdet, rtol=1e-4)
+    # syrk: a @ a.T
+    assert_almost_equal(
+        mx.nd.linalg_syrk(mx.nd.array(a)).asnumpy(), a @ a.T, rtol=1e-4)
+    # extractdiag / makediag roundtrip
+    d = mx.nd.linalg_extractdiag(mx.nd.array(spd)).asnumpy()
+    assert_almost_equal(d, np.diag(spd), rtol=1e-6)
+    assert_almost_equal(
+        mx.nd.linalg_makediag(mx.nd.array(d)).asnumpy(), np.diag(d),
+        rtol=1e-6)
+    # syevd: eigendecomposition of symmetric matrix
+    w_vec, w_val = mx.nd.linalg_syevd(mx.nd.array(spd))
+    recon = w_vec.asnumpy().T @ np.diag(w_val.asnumpy()) @ w_vec.asnumpy()
+    assert_almost_equal(recon, spd, rtol=1e-3, atol=1e-3)
+    # trsm: solve L x = b for lower-triangular L
+    L = np.tril(rng.rand(3, 3).astype(np.float32)) + np.eye(3) * 2
+    rhs = rng.rand(3, 2).astype(np.float32)
+    x = mx.nd.linalg_trsm(mx.nd.array(L), mx.nd.array(rhs)).asnumpy()
+    assert_almost_equal(L @ x, rhs, rtol=1e-4, atol=1e-5)
+    # trmm: L @ rhs
+    assert_almost_equal(
+        mx.nd.linalg_trmm(mx.nd.array(L), mx.nd.array(rhs)).asnumpy(),
+        L @ rhs, rtol=1e-4)
+    # gelqf: LQ factorization, a = L @ Q with Q orthonormal rows
+    lq_l, lq_q = mx.nd.linalg_gelqf(mx.nd.array(a))
+    assert_almost_equal(lq_l.asnumpy() @ lq_q.asnumpy(), a, rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(lq_q.asnumpy() @ lq_q.asnumpy().T, np.eye(3),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_misc_tensor_ops():
+    rng = np.random.RandomState(1)
+    a = rng.rand(2, 3).astype(np.float32)
+    b = rng.rand(2, 3).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.add_n(mx.nd.array(a), mx.nd.array(b),
+                    mx.nd.array(a)).asnumpy(), 2 * a + b, rtol=1e-6)
+    assert_almost_equal(
+        mx.nd.argmax_channel(mx.nd.array(a)).asnumpy(),
+        a.argmax(axis=1).astype(np.float32), rtol=0)
+    assert_almost_equal(
+        mx.nd.broadcast_axis(mx.nd.array(a[:, :1]), axis=1, size=3
+                             ).asnumpy(),
+        np.broadcast_to(a[:, :1], (2, 3)), rtol=0)
+    assert_almost_equal(
+        mx.nd.broadcast_to(mx.nd.array(a[:1]), shape=(4, 3)).asnumpy(),
+        np.broadcast_to(a[:1], (4, 3)), rtol=0)
+    assert_almost_equal(
+        mx.nd.broadcast_like(mx.nd.array(a[:1]), mx.nd.array(
+            np.zeros((4, 3), np.float32))).asnumpy(),
+        np.broadcast_to(a[:1], (4, 3)), rtol=0)
+    # scalar comparison variants
+    assert_almost_equal(
+        mx.nd.broadcast_greater_equal_scalar(mx.nd.array(a),
+                                             scalar=0.5).asnumpy(),
+        (a >= 0.5).astype(np.float32), rtol=0)
+    assert_almost_equal(
+        mx.nd.broadcast_lesser_equal_scalar(mx.nd.array(a),
+                                            scalar=0.5).asnumpy(),
+        (a <= 0.5).astype(np.float32), rtol=0)
+    assert_almost_equal(
+        mx.nd.broadcast_not_equal_scalar(mx.nd.array(a),
+                                         scalar=a[0, 0]).asnumpy(),
+        (a != a[0, 0]).astype(np.float32), rtol=0)
+
+
+def test_space_depth_and_utility_ops():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 4, 2, 2).astype(np.float32)
+    d2s = mx.nd.depth_to_space(mx.nd.array(x), block_size=2).asnumpy()
+    assert d2s.shape == (1, 1, 4, 4)
+    back = mx.nd.space_to_depth(mx.nd.array(d2s), block_size=2).asnumpy()
+    assert_almost_equal(back, x, rtol=1e-6)
+
+    a = rng.rand(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd.shape_array(mx.nd.array(a)).asnumpy(), [3, 4])
+    assert int(mx.nd.size_array(mx.nd.array(a)).asnumpy()) == 12
+    assert_almost_equal(
+        mx.nd.slice_like(mx.nd.array(a), mx.nd.array(a[:2, :2])).asnumpy(),
+        a[:2, :2], rtol=0)
+    parts = mx.nd.split_v2(mx.nd.array(a), sections=2, axis=1)
+    assert_almost_equal(parts[0].asnumpy(), a[:, :2], rtol=0)
+    assert_almost_equal(parts[1].asnumpy(), a[:, 2:], rtol=0)
+
+    import math
+
+    assert_almost_equal(
+        mx.nd.digamma(mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+                      ).asnumpy(),
+        np.array([-0.5772157, 0.42278433, 0.92278427], np.float32),
+        rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.erfinv(mx.nd.array(np.array([0.0, 0.5], np.float32))
+                     ).asnumpy(),
+        np.array([0.0, 0.476936], np.float32), atol=1e-4)
+
+    h_cnt, h_edges = mx.nd.histogram(
+        mx.nd.array(np.array([0.1, 0.4, 0.4, 0.9], np.float32)),
+        bin_cnt=2, range=(0.0, 1.0))
+    np.testing.assert_array_equal(h_cnt.asnumpy(), [3, 1])
+
+    kr = mx.nd.khatri_rao(mx.nd.array(np.array([[1., 2.]], np.float32)),
+                          mx.nd.array(np.array([[3.], [4.]], np.float32)))
+    np.testing.assert_allclose(kr.asnumpy(), [[3., 6.], [4., 8.]])
+
+    sc = mx.nd.scatter_nd(
+        mx.nd.array(np.array([5., 7.], np.float32)),
+        mx.nd.array(np.array([[0, 2]], np.float32)), shape=(4,))
+    np.testing.assert_allclose(sc.asnumpy(), [5., 0., 7., 0.])
+
+    sce = mx.nd.softmax_cross_entropy(
+        mx.nd.array(np.array([[2.0, 0.0], [0.0, 2.0]], np.float32)),
+        mx.nd.array(np.array([0, 1], np.float32))).asnumpy()
+    expected = -np.log(np.exp(2) / (np.exp(2) + 1)) * 2
+    assert_almost_equal(float(sce.sum()), expected, rtol=1e-4)
+
+    # sequence_mask raw op (TNC layout)
+    x = rng.rand(4, 2, 3).astype(np.float32)
+    masked = mx.nd.sequence_mask(
+        mx.nd.array(x), mx.nd.array(np.array([2, 4], np.float32)),
+        use_sequence_length=True, value=-1.0).asnumpy()
+    assert (masked[2:, 0] == -1.0).all()
+    assert_almost_equal(masked[:, 1], x[:, 1], rtol=0)

@@ -123,3 +123,42 @@ def test_group_and_internals():
     outs = ex.forward()
     np.testing.assert_allclose(outs[0].asnumpy(), [2.0, 4.0])
     np.testing.assert_allclose(outs[1].asnumpy(), [2.0, 3.0])
+
+
+def test_attr_scope_and_lr_mult():
+    """AttrScope stamps nodes; __lr_mult__ flows through Module's optimizer
+    (reference attribute.py + model.py attr_dict flow)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import DataDesc
+
+    with mx.AttrScope(ctx_group="stage1", lr_mult="0.0"):
+        frozen = sym.Variable("frozen_w")
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, frozen, num_hidden=4, no_bias=True,
+                           name="fcA")
+    out = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=2, name="fcB"),
+                            sym.Variable("softmax_label"), name="softmax")
+    assert frozen.attr("__ctx_group__") == "stage1"
+    assert out.attr_dict()["frozen_w"]["__lr_mult__"] == "0.0"
+
+    mod = mx.mod.Module(out)
+    mod.bind([DataDesc("data", (8, 6))], [DataDesc("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    before = mod.get_params()[0]["frozen_w"].asnumpy().copy()
+    rng = np.random.RandomState(0)
+    from mxnet_tpu.io import DataBatch
+
+    batch = DataBatch(data=[mx.nd.array(rng.rand(8, 6).astype(np.float32))],
+                      label=[mx.nd.array((rng.rand(8) * 2).astype(
+                          np.float32))])
+    for _ in range(3):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    after = mod.get_params()[0]
+    np.testing.assert_array_equal(after["frozen_w"].asnumpy(), before)
+    assert np.abs(after["fcB_weight"].asnumpy()).sum() > 0
