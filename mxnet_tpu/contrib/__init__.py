@@ -14,6 +14,7 @@ def __getattr__(name):
     lazy = {
         "tensorboard": ".tensorboard",
         "quantization": ".quantization",
+        "svrg_optimization": ".svrg_optimization",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

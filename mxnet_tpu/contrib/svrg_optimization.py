@@ -1,0 +1,151 @@
+"""SVRG optimization (contrib).
+
+Capability parity with python/mxnet/contrib/svrg_optimization/
+(SVRGModule :30, SVRGOptimizer): Stochastic Variance-Reduced Gradient —
+every `update_freq` epochs a snapshot of the weights is taken and the
+full-dataset gradient `mu` at that snapshot is computed; each minibatch
+then steps with the variance-reduced gradient
+``g_i(w) - g_i(w_snapshot) + mu``.
+
+TPU-native form: the snapshot network is a second bound executor over the
+same symbol (both are cached XLA executables), mu lives on device as
+NDArrays, and the gradient algebra is a few fused device ops per
+parameter — no special optimizer subclass is needed, so ANY registered
+optimizer gets variance reduction. Single-context only (multi-device SVRG
+belongs to the sharded trainer path, not per-executor bookkeeping).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG gradient correction (svrg_module.py:30).
+
+    Parameters mirror Module, plus ``update_freq``: the number of epochs
+    between full-gradient snapshots.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, fixed_param_names=None, state_names=None,
+                 update_freq=2, **kwargs):
+        if isinstance(context, (list, tuple)) and len(context) > 1:
+            raise MXNetError(
+                "SVRGModule supports a single context; for multi-device "
+                "training use parallel.ShardedTrainer (GSPMD) instead")
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context,
+                         fixed_param_names=fixed_param_names,
+                         state_names=state_names, **kwargs)
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context,
+                               fixed_param_names=fixed_param_names,
+                               state_names=state_names)
+        self._mu = None  # device NDArrays: full gradient at the snapshot
+
+    # ------------------------------------------------------------ lifecycle
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return  # silent: fit() re-enters bind once per inner epoch
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, None, grad_req)
+
+    def _take_snapshot(self):
+        """Copy the live weights into the snapshot module. Called ONLY by
+        update_full_grads — the snapshot must move in lockstep with mu, or
+        the correction g(w) - g(w_snap) + mu becomes biased."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg, aux, allow_missing=False,
+                                 force_init=True)
+
+    # --------------------------------------------------------- full gradient
+    def update_full_grads(self, train_data):
+        """Snapshot the weights and compute mu = mean gradient over
+        `train_data` at the snapshot (svrg_module.py update_full_grads).
+        mu is accumulated and kept on device."""
+        self._take_snapshot()
+        train_data.reset()
+        acc = {}
+        n_batches = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name, g in zip(self._mod_aux._param_names,
+                               self._grads_of(self._mod_aux)):
+                if g is None:
+                    continue
+                acc[name] = g.copy() if name not in acc else acc[name] + g
+            n_batches += 1
+        if n_batches == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        self._mu = {k: v / n_batches for k, v in acc.items()}
+        train_data.reset()  # leave the iterator fresh for the epoch loop
+
+    @staticmethod
+    def _grads_of(mod):
+        return [mod._execs[0].grad_dict.get(n) for n in mod._param_names]
+
+    # ------------------------------------------------------------- training
+    def forward_backward(self, data_batch):
+        """Variance-reduced step: main grads become
+        g(w) - g(w_snap) + mu (svrg_module.py _update_svrg_gradients)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._mu is None:
+            return
+        self._mod_aux.forward(data_batch, is_train=True)
+        self._mod_aux.backward()
+        for name, g_main, g_snap in zip(
+                self._param_names, self._grads_of(self),
+                self._grads_of(self._mod_aux)):
+            if g_main is None or g_snap is None or name not in self._mu:
+                continue
+            g_main._set_data((g_main - g_snap + self._mu[name])._data)
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=None, initializer=None, arg_params=None,
+            aux_params=None, allow_missing=False, force_rebind=False,
+            force_init=False, kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),), begin_epoch=0,
+            **kwargs):
+        """Module.fit with a full-gradient refresh every update_freq
+        epochs. bind/init/optimizer happen once up front (reference
+        structure), so epoch 0 is already variance-reduced; the inner
+        one-epoch fits re-enter those as no-ops and keep epoch numbering
+        for callbacks/logs."""
+        from ..initializer import Uniform
+
+        if num_epoch is None:
+            raise MXNetError("num_epoch is required")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            super().fit(train_data, eval_data=eval_data,
+                        eval_metric=eval_metric, begin_epoch=epoch,
+                        num_epoch=epoch + 1, kvstore=kvstore,
+                        optimizer=optimizer,
+                        optimizer_params=optimizer_params, **kwargs)
+        return self
