@@ -81,19 +81,26 @@ class SVRGModule(Module):
         self._take_snapshot()
         train_data.reset()
         acc = {}
-        n_batches = 0
+        total_w = 0.0
         for batch in train_data:
             self._mod_aux.forward(batch, is_train=True)
             self._mod_aux.backward()
+            # a padded final batch duplicates front-of-epoch samples
+            # (io.py NDArrayIter pad); down-weight its contribution so mu
+            # stays an (approximately) unbiased full-dataset gradient
+            pad = getattr(batch, "pad", 0) or 0
+            bs = batch.data[0].shape[0]
+            w = (bs - pad) / bs
             for name, g in zip(self._mod_aux._param_names,
                                self._grads_of(self._mod_aux)):
                 if g is None:
                     continue
-                acc[name] = g.copy() if name not in acc else acc[name] + g
-            n_batches += 1
-        if n_batches == 0:
+                gw = g * w if w != 1.0 else g.copy()
+                acc[name] = gw if name not in acc else acc[name] + gw
+            total_w += w
+        if total_w == 0.0:
             raise MXNetError("update_full_grads: empty data iterator")
-        self._mu = {k: v / n_batches for k, v in acc.items()}
+        self._mu = {k: v / total_w for k, v in acc.items()}
         train_data.reset()  # leave the iterator fresh for the epoch loop
 
     @staticmethod

@@ -18,7 +18,8 @@ import functools
 
 import numpy as _np
 
-__all__ = ["flash_attention", "pallas_available"]
+__all__ = ["flash_attention", "flash_attention_with_grad",
+           "pallas_available"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -35,8 +36,8 @@ def pallas_available():
         return False
 
 
-def _mha_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                scale, causal, n_kb):
+def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, n_kb):
     """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is innermost,
     so the VMEM scratch (m, l, acc) carries across K blocks of one
     (batch*head, q-block) pair and the output writes on the last step.
@@ -89,6 +90,9 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+        # row log-sum-exp, already held in scratch — emit it so the
+        # custom_vjp backward doesn't need a recomputation sweep
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-20))
 
 
 @functools.lru_cache(maxsize=32)
@@ -111,8 +115,14 @@ def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.dtype(dtype_str)),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, kb: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.dtype(dtype_str)),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max m
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum l
@@ -122,8 +132,23 @@ def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
     )
 
 
-def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
-    """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D).
+def _unwrap_nd(q, k, v, interpret):
+    """NDArray inputs -> TPU-placed jax arrays (interpret on CPU hosts)."""
+    import jax
+
+    tpu_devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if tpu_devs:
+        raw = [jax.device_put(a._data, tpu_devs[0]) for a in (q, k, v)]
+    else:
+        raw = [a._data for a in (q, k, v)]
+        interpret = True
+    return raw, interpret
+
+
+def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
+                    return_lse=False):
+    """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D)
+    (plus the per-row log-sum-exp when return_lse=True).
 
     Requirements: T divisible by the 128 block (or T <= 128), D <= 256,
     self-attention shapes. Raises ValueError otherwise — callers fall back
@@ -133,21 +158,15 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
     TPU device automatically (or run in interpret mode on CPU-only hosts),
     since a program compiled for a CPU device cannot lower the kernel.
     """
-    nd_in = hasattr(q, "_data")
-    if nd_in:
-        import jax
-
+    if hasattr(q, "_data"):
         from ..ndarray.ndarray import NDArray
 
         ctx = getattr(q, "_ctx", None)
-        tpu_devs = [d for d in jax.devices() if d.platform != "cpu"]
-        if tpu_devs:
-            raw = [jax.device_put(a._data, tpu_devs[0]) for a in (q, k, v)]
-        else:
-            raw = [a._data for a in (q, k, v)]
-            interpret = True
+        raw, interpret = _unwrap_nd(q, k, v, interpret)
         out = flash_attention(*raw, causal=causal, scale=scale,
-                              interpret=interpret)
+                              interpret=interpret, return_lse=return_lse)
+        if return_lse:
+            return NDArray(out[0], ctx), NDArray(out[1], ctx)
         return NDArray(out, ctx)
     b, h, t, d = q.shape
     bq = min(_BLOCK_Q, t)
@@ -163,4 +182,91 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
-    return fn(qf, kf, vf).reshape(b, h, t, d)
+    out, lse = fn(qf, kf, vf)
+    out = out.reshape(b, h, t, d)
+    if return_lse:
+        return out, lse.reshape(b, h, t, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper: custom_vjp with blockwise recomputation backward
+# (flash-attention backward, O(T * BLOCK_K) memory — the score matrix is
+# never materialized in either direction)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k):
+    """Standard flash-attention backward with recomputed probabilities,
+    scanned over K blocks; `lse` comes from the forward kernel's scratch
+    (no recomputation sweep)."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, t, d = q.shape
+    n_kb = t // block_k
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    o32, do32 = out.astype(jnp.float32), dout.astype(jnp.float32)
+    D = jnp.sum(do32 * o32, axis=-1, keepdims=True)  # (b,h,t,1)
+    qpos = jnp.arange(t)
+
+    def body(dq, kb):
+        ks = jax.lax.dynamic_slice_in_dim(k32, kb * block_k, block_k, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(v32, kb * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, ks) * scale
+        if causal:
+            kpos = kb * block_k + jnp.arange(block_k)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG)
+        p = jnp.exp(s - lse)  # (b,h,t,bk)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vs)
+        ds = p * (dp - D)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, ks) * scale
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros_like(q32)
+    dq, (dk_blks, dv_blks) = jax.lax.scan(body, dq0, jnp.arange(n_kb))
+    # scan stacks over the leading axis: (n_kb, b, h, bk, d) -> (b, h, t, d)
+    dk = jnp.moveaxis(dk_blks, 0, 2).reshape(b, h, t, d)
+    dv = jnp.moveaxis(dv_blks, 0, 2).reshape(b, h, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_attention_with_grad(q, k, v, causal=False, scale=None,
+                              interpret=False):
+    """Differentiable flash attention: the Pallas kernel forward paired
+    with a blockwise backward via jax.custom_vjp (probabilities
+    recomputed from the forward's saved log-sum-exp — no extra Q.K^T
+    sweep). Same shape/placement rules as flash_attention, NDArrays
+    included."""
+    import functools as _ft
+
+    import jax
+
+    if hasattr(q, "_data"):
+        from ..ndarray.ndarray import NDArray
+
+        ctx = getattr(q, "_ctx", None)
+        raw, interpret = _unwrap_nd(q, k, v, interpret)
+        return NDArray(flash_attention_with_grad(
+            *raw, causal=causal, scale=scale, interpret=interpret), ctx)
+
+    s = scale if scale is not None else 1.0 / _np.sqrt(q.shape[-1])
+    bk = min(_BLOCK_K, q.shape[2])
+
+    @_ft.partial(jax.custom_vjp)
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=causal, scale=s,
+                               interpret=interpret)
+
+    def f_fwd(q, k, v):
+        out, lse = flash_attention(q, k, v, causal=causal, scale=s,
+                                   interpret=interpret, return_lse=True)
+        return out, (q, k, v, out, lse)
+
+    def f_bwd(res, dout):
+        q, k, v, out, lse = res
+        return _flash_bwd_blockwise(q, k, v, out, lse, dout, s, causal, bk)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(q, k, v)

@@ -90,3 +90,38 @@ def test_sdpa_impl_flash_contract():
                 impl="flash")
         np.testing.assert_allclose(out.asnumpy(), _dense(q, k, v, False),
                                    atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    """custom_vjp blockwise backward vs autodiff through dense attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_with_grad
+
+    q, k, v = _qkv(B=1, H=2, T=256, D=64, seed=5)
+    D = 64
+
+    def loss_flash(q_, k_, v_):
+        out = flash_attention_with_grad(q_, k_, v_, causal=causal,
+                                        interpret=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_dense(q_, k_, v_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) / np.sqrt(D)
+        if causal:
+            T = q_.shape[2]
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+        w = jax.nn.softmax(s, -1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", w, v_) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(jnp.asarray(q),
+                                                 jnp.asarray(k),
+                                                 jnp.asarray(v))
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(jnp.asarray(q),
+                                                 jnp.asarray(k),
+                                                 jnp.asarray(v))
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   err_msg=f"grad {name}")
