@@ -35,7 +35,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.002)
     ap.add_argument("--use-resnet", action="store_true")
+    ap.add_argument("--out-dir", default="output",
+                    help="checkpoint/export directory (gitignored)")
     args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
 
     transform = gluon.data.vision.transforms.Compose([
         gluon.data.vision.transforms.ToTensor()])
@@ -62,8 +65,8 @@ def main():
             trainer.step(x.shape[0])
             metric.update([y], [out])
         print(f"epoch {epoch}: train {metric.get()}")
-    net.export("cifar10_model")
-    print("exported to cifar10_model-*.params/.json")
+    net.export(os.path.join(args.out_dir, "cifar10_model"))
+    print(f"exported to {args.out_dir}/cifar10_model-*.params/.json")
 
 
 if __name__ == "__main__":

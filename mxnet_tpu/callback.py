@@ -46,42 +46,45 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """samples/sec logger (callback.py Speedometer)."""
+    """Periodic samples/sec logger (role of callback.py Speedometer; log
+    format is this repo's own).
+
+    Logs throughput every ``frequent`` batches, measured over the window since
+    the previous log line, together with the current metric values. A batch
+    counter that moves backwards (new epoch) restarts the timing window.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_start = None
+        self._prev_nbatch = 0
+
+    def _restart(self):
+        self._window_start = time.monotonic()
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset_local()
-                    msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count - self.frequent, count,
-                                 speed, *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        nbatch = param.nbatch
+        if nbatch < self._prev_nbatch:
+            self._window_start = None
+        self._prev_nbatch = nbatch
+        if self._window_start is None:
+            self._restart()
+            return
+        if nbatch % self.frequent != 0:
+            return
+        elapsed = time.monotonic() - self._window_start
+        rate = (self.frequent * self.batch_size / elapsed) if elapsed > 0 else float("inf")
+        parts = ["Epoch[%d] Batch [%d-%d]  speed=%.2f samples/sec"
+                 % (param.epoch, nbatch - self.frequent, nbatch, rate)]
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                parts.append("%s=%f" % (name, value))
+            if self.auto_reset:
+                param.eval_metric.reset_local()
+        logging.info("  ".join(parts))
+        self._restart()
 
 
 class ProgressBar:

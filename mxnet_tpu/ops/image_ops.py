@@ -104,8 +104,12 @@ def _adjust_brightness(data, factor):
 
 
 def _adjust_contrast(data, factor):
-    mean = jnp.mean(data, axis=(-3, -2, -1), keepdims=True)
-    return _blend(data, mean, factor)
+    # blend against the BT.601 luminance mean (image_random-inl.h:697-705),
+    # not the plain channel mean — matters for non-gray images
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    gray = jnp.sum(data * coef, axis=-1, keepdims=True)
+    gray_mean = jnp.mean(gray, axis=(-3, -2, -1), keepdims=True)
+    return _blend(data, gray_mean, factor)
 
 
 def _adjust_saturation(data, factor):
@@ -129,9 +133,12 @@ def _uniform_factor(rng_key, lo, hi, data):
 def _random_adjust(name, adjust):
     @register(f"_image_random_{name}", mutate=(1,), no_grad=True,
               aliases=(f"image_random_{name}",))
-    def _fn(data, rng_key, min_factor=0.0, max_factor=0.0):
+    def _fn(data, rng_key, min_factor=1.0, max_factor=1.0):
+        # reference op contract: the factor itself is sampled uniformly in
+        # [min_factor, max_factor] (image_random-inl.h:675-677); the 1+delta
+        # convention lives only in the gluon transform wrappers
         key, nxt = jax.random.split(rng_key)
-        f = _uniform_factor(key, 1.0 + min_factor, 1.0 + max_factor, data)
+        f = _uniform_factor(key, min_factor, max_factor, data)
         return adjust(data.astype(jnp.float32), f), nxt
 
     _fn.__name__ = f"_image_random_{name}"

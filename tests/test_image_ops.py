@@ -83,25 +83,36 @@ class TestRandomOps:
         np.testing.assert_array_equal(out, out2)
 
     def test_random_brightness_bounds(self):
+        # reference op contract: factor is sampled directly in
+        # [min_factor, max_factor] (image_random-inl.h:675-677)
         mx.random.seed(1)
         x = np.full((4, 4, 3), 100.0, np.float32)
-        out = mx.nd.image.random_brightness(mx.nd.array(x), min_factor=-0.2,
-                                            max_factor=0.2).asnumpy()
+        out = mx.nd.image.random_brightness(mx.nd.array(x), min_factor=0.8,
+                                            max_factor=1.2).asnumpy()
         assert 80.0 - 1e-3 <= out.mean() <= 120.0 + 1e-3
 
-    def test_random_contrast_preserves_mean(self):
+    def test_random_contrast_zero_factor_is_gray_mean(self):
+        # factor=0 collapses the image to its BT.601 luminance mean
         mx.random.seed(2)
         x = np.random.RandomState(0).rand(6, 6, 3).astype(np.float32)
-        out = mx.nd.image.random_contrast(mx.nd.array(x), min_factor=-0.5,
-                                          max_factor=0.5).asnumpy()
-        np.testing.assert_allclose(out.mean(), x.mean(), rtol=0.02)
+        out = mx.nd.image.random_contrast(mx.nd.array(x), min_factor=0.0,
+                                          max_factor=0.0).asnumpy()
+        gray_mean = (x * [0.299, 0.587, 0.114]).sum(-1).mean()
+        np.testing.assert_allclose(out, gray_mean, atol=1e-5)
+
+    def test_random_contrast_identity_factor(self):
+        mx.random.seed(2)
+        x = np.random.RandomState(0).rand(6, 6, 3).astype(np.float32)
+        out = mx.nd.image.random_contrast(mx.nd.array(x), min_factor=1.0,
+                                          max_factor=1.0).asnumpy()
+        np.testing.assert_allclose(out, x, atol=1e-5)
 
     def test_random_saturation_gray_invariant(self):
         mx.random.seed(3)
         gray = np.full((4, 4, 3), 0.5, np.float32)
         out = mx.nd.image.random_saturation(mx.nd.array(gray),
-                                            min_factor=-0.9,
-                                            max_factor=0.9).asnumpy()
+                                            min_factor=0.1,
+                                            max_factor=1.9).asnumpy()
         np.testing.assert_allclose(out, 0.5, atol=1e-3)
 
     def test_random_lighting_batched(self):

@@ -52,6 +52,7 @@ def launch_local(n, cmd, port=None, env_extra=None):
 
         rc = 0
         live = list(procs)
+        term_deadline = None  # set when SIGTERM was sent; escalate to SIGKILL
         while live:
             for p in list(live):
                 code = p.poll()
@@ -62,6 +63,12 @@ def launch_local(n, cmd, port=None, env_extra=None):
                     rc = rc or code
                     for q in live:
                         q.send_signal(signal.SIGTERM)
+                    if term_deadline is None:
+                        term_deadline = time.monotonic() + 10.0
+            if term_deadline is not None and time.monotonic() > term_deadline:
+                for q in live:
+                    if q.poll() is None:
+                        q.kill()
             time.sleep(0.1)
         return rc
     finally:
