@@ -49,37 +49,60 @@ def main():
 
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
 
-    net = vision.resnet50_v1()
-    net.initialize(mx.initializer.Xavier())
-    net(mx.nd.zeros((2, 3, 224, 224)))  # materialize params
-
     mesh = parallel.create_mesh({"dp": 1}, jax.devices()[:1])
     rng = np.random.RandomState(0)
 
-    configs = ([("bfloat16", 256), ("bfloat16", 128), (None, 128)]
-               if on_tpu else [(None, 8)])
+    # (net kwargs, dtype, batch): the TPU-native config (channels-last +
+    # space-to-depth stem, PERF.md) leads; the reference-layout NCHW net
+    # and fp32 run for comparison
+    configs = ([({"layout": "NHWC", "stem": "s2d"}, "bfloat16", 256),
+                ({}, "bfloat16", 256),
+                ({}, "bfloat16", 128),  # OOM fallback
+                ({}, None, 128)]
+               if on_tpu else [({}, None, 8)])
     iters = 30 if on_tpu else 3
 
+    nets = {}
     best = None
-    for dtype, batch in configs:
+    for net_kw, dtype, batch in configs:
+        if dtype == "bfloat16" and batch == 128 and best is not None:
+            continue  # OOM fallback only needed when bs=256 failed
+        key = tuple(sorted(net_kw.items()))
+        if key not in nets:
+            net = vision.resnet50_v1(**net_kw)
+            net.initialize(mx.initializer.Xavier())
+            net(mx.nd.zeros((2, 3, 224, 224)))  # materialize params
+            nets[key] = net
+        net = nets[key]
         trainer = parallel.ShardedTrainer(
             net, gluon.loss.SoftmaxCrossEntropyLoss(),
             "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
             dtype=dtype)
         x = rng.rand(batch, 3, 224, 224).astype(np.float32)
         y = (rng.rand(batch) * 1000).astype(np.float32)
-        try:
-            img_s = _throughput(trainer, x, y, iters)
-        except Exception as e:  # OOM at large batch: fall through
-            print(f"# bs={batch} dtype={dtype}: {type(e).__name__}: {e}",
-                  file=sys.stderr)
+        img_s = None
+        for attempt in range(3):  # the remote-compile tunnel can flake
+            try:
+                img_s = _throughput(trainer, x, y, iters)
+                break
+            except Exception as e:
+                print(f"# bs={batch} dtype={dtype} attempt {attempt}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                if "RESOURCE_EXHAUSTED" in str(e):
+                    break  # OOM: don't retry
+        if img_s is None:
             continue
         mfu = img_s * RESNET50_TRAIN_FLOPS_PER_IMG / V5E_BF16_PEAK
-        print(f"# bs={batch} dtype={dtype or 'float32'}: "
+        print(f"# bs={batch} dtype={dtype or 'float32'} {net_kw or 'NCHW'}: "
               f"{img_s:.1f} img/s, MFU={100 * mfu:.1f}%", file=sys.stderr)
         if best is None or img_s > best[0]:
             best = (img_s, dtype, batch)
 
+    if best is None:
+        print(json.dumps({
+            "metric": "resnet50_train_throughput", "value": 0.0,
+            "unit": "img/s/chip", "vs_baseline": 0.0, "error": "all configs failed"}))
+        return
     img_s = best[0]
     print(json.dumps({
         "metric": "resnet50_train_throughput",

@@ -121,6 +121,12 @@ class Executor:
                 outs, new_aux = pure_fn(full, aux_vals, True)
                 return tuple(outs), new_aux
 
+            # MXNET_BACKWARD_DO_MIRROR: recompute activations in backward
+            # instead of keeping them (reference graph_executor.cc:357)
+            from .remat import mirror_enabled
+
+            if mirror_enabled():
+                of_diff = jax.checkpoint(of_diff)
             diff_vals = tuple(arg_vals[i] for i in diff_idx)
             outs, vjp_fn, new_aux = jax.vjp(of_diff, *diff_vals, has_aux=True)
             grads = vjp_fn(tuple(head_grads))

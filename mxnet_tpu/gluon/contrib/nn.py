@@ -11,7 +11,7 @@ import warnings
 from .. import nn as _nn
 from ..block import Block, HybridBlock
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+__all__ = ["Remat", "Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
            "SyncBatchNorm"]
 
 
@@ -93,3 +93,65 @@ class SyncBatchNorm(_nn.BatchNorm):
                  epsilon=1e-5, **kwargs):
         super().__init__(momentum=momentum, epsilon=epsilon,
                          in_channels=in_channels, **kwargs)
+
+
+class Remat(HybridBlock):
+    """Segment-level activation rematerialization around any block.
+
+    Inside a compiled trace (hybridize / ShardedTrainer / Executor bind)
+    the wrapped block runs under ``jax.checkpoint``: its internal
+    activations are recomputed during the backward instead of kept —
+    the segment-granular form of the reference's gradient mirroring
+    (src/nnvm/gradient.cc:107-148). In plain eager mode it is a
+    transparent pass-through.
+
+    Example::
+
+        stage = contrib.nn.Remat(resnet_stage)   # per-stage remat
+    """
+
+    def __init__(self, block, policy=None, **kwargs):
+        super().__init__(**kwargs)
+        from ...remat import resolve_policy
+        with self.name_scope():
+            self.block = block
+        self._policy = resolve_policy(policy)
+
+    def forward(self, *args):
+        from ...jit import _active, _notify_io, _notify_mutation
+        from ...ndarray.ndarray import NDArray
+
+        if _active() is None:  # eager: no compiled backward to remat
+            return self.block(*args)
+
+        import jax
+
+        from ... import autograd
+        from ...parallel.functional import (
+            functional_call, param_arrays, aux_arrays, RNG_KEY)
+        from ... import random as _random
+
+        fn = functional_call(self.block, train=autograd.is_training())
+        pvals = param_arrays(self.block)
+        avals = aux_arrays(self.block)
+        xs = [a.data_ if isinstance(a, NDArray) else a for a in args]
+        out, new_aux = jax.checkpoint(fn, policy=self._policy)(
+            pvals, avals, *xs)
+        # surface the sub-block's aux mutations (BN stats, rng key) to the
+        # enclosing trace session
+        cells = {name: p.data()
+                 for name, p in self.block.collect_params().items()}
+        for name, val in new_aux.items():
+            if name == RNG_KEY:
+                cell = _random.generator_key()
+            else:
+                cell = cells[name]
+            cell._data = val
+            _notify_mutation(cell)
+        outs = ([NDArray(o) for o in out] if isinstance(out, tuple)
+                else [NDArray(out)])
+        _notify_io([a for a in args if isinstance(a, NDArray)], outs)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def hybrid_forward(self, F, *args):  # pragma: no cover - forward() used
+        return self.block(*args)

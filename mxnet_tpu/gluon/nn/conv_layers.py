@@ -22,7 +22,9 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
 def _tuple(v, n):
     if isinstance(v, (int, _np.integer)):
         return (int(v),) * n
-    return tuple(int(x) for x in v)
+    # asymmetric (lo, hi) padding pairs pass through untouched
+    return tuple(tuple(int(y) for y in x) if isinstance(x, (tuple, list))
+                 else int(x) for x in v)
 
 
 class _Conv(HybridBlock):
@@ -49,7 +51,12 @@ class _Conv(HybridBlock):
 
         with self.name_scope():
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups) + tuple(kernel_size)
+                if layout and layout[1] != "C":  # channels-last: OHWI weights
+                    wshape = (channels,) + tuple(kernel_size) + \
+                        (in_channels // groups,)
+                else:
+                    wshape = (channels, in_channels // groups) + \
+                        tuple(kernel_size)
             else:  # Deconvolution: weight is (in, out/groups, *k)
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get(
@@ -96,9 +103,10 @@ class _Conv(HybridBlock):
             s += ", {}".format(self.act)
         s += ")"
         shape = self.weight.shape
+        layout = self._kwargs.get("layout")
+        in_ch = shape[-1] if (layout and layout[1] != "C") else shape[1]
         return s.format(name=self.__class__.__name__,
-                        mapping="{0} -> {1}".format(
-                            shape[1] if shape[1] else None, shape[0]),
+                        mapping="{0} -> {1}".format(in_ch or None, shape[0]),
                         **self._kwargs)
 
 
@@ -124,7 +132,7 @@ class Conv2D(_Conv):
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
-        assert layout in ("NCHW",), "Only NCHW layout is supported"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size,) * 2
         super().__init__(channels, kernel_size, strides, padding, dilation,
@@ -175,7 +183,8 @@ class Conv2DTranspose(_Conv):
                  layout="NCHW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
+        assert layout == "NCHW", \
+            "Deconvolution supports only NCHW (no NHWC kernel path)"
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size,) * 2
         if isinstance(output_padding, int):
@@ -220,7 +229,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -250,7 +260,7 @@ class MaxPool1D(_Pooling):
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
         super().__init__(pool_size, strides, padding, ceil_mode, False,
@@ -281,7 +291,7 @@ class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True,
                  **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
         super().__init__(pool_size, strides, padding, ceil_mode, False,
@@ -307,7 +317,7 @@ class GlobalMaxPool1D(_Pooling):
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         super().__init__((1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
@@ -325,7 +335,7 @@ class GlobalAvgPool1D(_Pooling):
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        assert layout == "NCHW", "Only NCHW layout is supported"
+        assert layout in ("NCHW", "NHWC"), "layout must be NCHW or NHWC"
         super().__init__((1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 

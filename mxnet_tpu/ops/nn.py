@@ -47,12 +47,27 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, fl
 
 # ---------------------------------------------------------------- Convolution
 
-def _conv_dn(ndim):
-    if ndim == 3:
-        return ("NCW", "OIW", "NCW")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    return ("NCDHW", "OIDHW", "NCDHW")
+def _conv_dn(ndim, layout=None):
+    """Dimension-number triple for a data layout. Channels-first (the
+    reference's public default) keeps OIHW weights; channels-last — the
+    TPU-native layout, where C rides the 128-wide lane dimension — uses
+    OHWI weights (kernel dim 0 stays num_filter, like the reference's
+    NHWC conv contract)."""
+    default = {3: "NCW", 4: "NCHW", 5: "NCDHW"}[ndim]
+    layout = layout or default
+    if layout[1] == "C":          # channels-first: NCW/NCHW/NCDHW
+        w = "OI" + layout[2:]
+    else:                         # channels-last: NWC/NHWC/NDHWC
+        w = "O" + layout[1:-1] + "I"
+    return (layout, w, layout)
+
+
+def _conv_pads(pad):
+    """pad elements may be ints (symmetric) or (lo, hi) pairs — the
+    asymmetric form is what the space-to-depth stem's stride-folded
+    kernel needs."""
+    return [tuple(p) if isinstance(p, (tuple, list)) else (p, p)
+            for p in pad]
 
 
 @register("Convolution")
@@ -61,20 +76,25 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                  cudnn_tune=None, cudnn_off=False, workspace=None, layout=None):
     """Parity: src/operator/nn/convolution.cc:399. Groups via XLA
     feature_group_count (depthwise included — replaces
-    depthwise_convolution_tf.cuh)."""
+    depthwise_convolution_tf.cuh). layout='NHWC' (et al.) runs the conv
+    channels-last with OHWI weights — the TPU-native path."""
     sdims = data.ndim - 2
     stride = _pair(stride or 1, sdims)
     dilate = _pair(dilate or 1, sdims)
-    pad = _pair(pad or 0, sdims)
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dn(data.ndim))
+    pad = pad if isinstance(pad, (tuple, list)) else _pair(pad or 0, sdims)
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, _conv_dn(data.ndim, layout))
     # no preferred_element_type: MXU accumulates bf16 convs in f32 natively,
     # and the f32-typed intermediate breaks conv transpose under bf16 AD
     out = jax.lax.conv_general_dilated(
         data, weight, window_strides=stride,
-        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        padding=_conv_pads(pad), rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * sdims)
+        if layout and layout[1] != "C":
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * sdims)
     return out
 
 
@@ -119,10 +139,13 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
 def _pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
              pad=None, pooling_convention="valid", count_include_pad=True,
              cudnn_off=False, p_value=2, layout=None):
-    """Parity: src/operator/nn/pooling.cc (+pool.cuh). lax.reduce_window."""
+    """Parity: src/operator/nn/pooling.cc (+pool.cuh). lax.reduce_window.
+    layout='NHWC' (et al.) pools channels-last."""
     sdims = data.ndim - 2
+    channels_last = bool(layout) and layout[1] != "C"
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = (tuple(range(1, data.ndim - 1)) if channels_last
+                else tuple(range(2, data.ndim)))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type in ("avg", "sum"):
@@ -133,18 +156,27 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
     kernel = _pair(kernel, sdims)
     stride = _pair(stride or 1, sdims)
     pad = _pair(pad or 0, sdims)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    sp0 = 1 if channels_last else 2  # first spatial dim index
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
     if pooling_convention == "full":
         # ceil-mode output: pad high side enough for a final partial window
-        pads = [(0, 0), (0, 0)]
+        spads = []
         for i in range(sdims):
-            in_sz = data.shape[2 + i]
+            in_sz = data.shape[sp0 + i]
             out_sz = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
             needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
-            pads.append((pad[i], max(needed, pad[i])))
+            spads.append((pad[i], max(needed, pad[i])))
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        spads = [(p, p) for p in pad]
+    if channels_last:
+        pads = [(0, 0)] + spads + [(0, 0)]
+    else:
+        pads = [(0, 0), (0, 0)] + spads
     # init values must be PYTHON scalars: jax only recognizes the
     # max/add monoid (-> differentiable reduce_window_max/sum primitives)
     # for scalar inits; array inits fall back to the general reduce_window,
@@ -215,21 +247,36 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     """Parity: src/operator/nn/batch_norm.cc. Returns (out, new_mean, new_var)
     with the moving stats written back through mutate slots — the functional
     bridge for the reference's aux-state mutation."""
+    axis = axis if axis >= 0 else data.ndim + axis
     red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        # Single-pass f32 statistics (E[x²] − E[x]²): one fused read of the
+        # activation for both moments instead of mean-then-variance's two —
+        # this is the BN-statistics lever that dominates the train-step's
+        # HBM roofline (PERF.md). Stats stay f32 end-to-end; only the EMA
+        # write-back converts to the moving-stat dtype.
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(x32), axis=red) - jnp.square(mean), 0.0)
+        new_mm = (moving_mean.astype(jnp.float32) * momentum
+                  + mean * (1 - momentum)).astype(moving_mean.dtype)
+        new_mv = (moving_var.astype(jnp.float32) * momentum
+                  + var * (1 - momentum)).astype(moving_var.dtype)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
-    inv = jax.lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
-    return out.astype(data.dtype), new_mm, new_mv
+    # fold to a single multiply-add pass in the input dtype: scale/shift are
+    # per-channel vectors computed in f32
+    inv = jax.lax.rsqrt(var + eps) * g.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean * inv
+    out = (data * inv.astype(data.dtype).reshape(bshape)
+           + shift.astype(data.dtype).reshape(bshape))
+    return out, new_mm, new_mv
 
 
 @register("LayerNorm", aliases=("layer_norm",))

@@ -41,14 +41,25 @@ class ShardedTrainer:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_rules=(), batch_axis_name="dp",
-                 dtype=None):
+                 dtype=None, remat=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..remat import mirror_enabled, resolve_policy
 
         self.net = net
         self.mesh = mesh if mesh is not None else create_mesh()
         self.loss_fn = loss_fn
         self._fwd = functional_call(net, train=True)
+        # remat: False disables, None follows MXNET_BACKWARD_DO_MIRROR,
+        # True/str/callable select a jax.checkpoint policy (remat.py) —
+        # the backward then recomputes non-saved activations, trading
+        # FLOPs for peak HBM (reference gradient mirroring)
+        if remat is None:
+            remat = mirror_enabled()
+        if remat:
+            self._fwd = jax.checkpoint(
+                self._fwd, policy=resolve_policy(remat))
         self.params = param_arrays(net)
         self.aux = aux_arrays(net)
         self._compute_dtype = dtype
@@ -111,9 +122,12 @@ class ShardedTrainer:
 
         def compute_loss(params, aux, x, y):
             # AMP policy: bf16 params/activations in fwd+bwd; the cast sits
-            # inside the grad so gradients land back in fp32 master dtype
+            # inside the grad so gradients land back in fp32 master dtype.
+            # aux (BN moving stats, rng key) stays uncast: stats only feed
+            # the f32 EMA update, and casting them to bf16 forces layout
+            # copies into the BN-statistics fusions (PERF.md round 4)
             cp = cast_in(params)
-            ca = cast_in(aux)
+            ca = aux
             if cdtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
                 x_c = x.astype(cdtype)
             else:

@@ -1,0 +1,52 @@
+"""Activation rematerialization (gradient mirroring).
+
+The TPU-native counterpart of the reference's backward mirroring
+(``MXNET_BACKWARD_DO_MIRROR`` read at src/executor/graph_executor.cc:357;
+mirror pass src/nnvm/gradient.cc:107-148): instead of a graph pass marking
+cheap nodes for recompute, the traced forward is wrapped in
+``jax.checkpoint`` and XLA's scheduler recomputes non-saved activations
+during the backward — trading FLOPs for HBM, which is the right trade on a
+chip whose train step sits at the HBM roofline (PERF.md).
+
+Entry points:
+- ``ShardedTrainer(..., remat=...)`` — whole-forward policy remat.
+- ``gluon.contrib.Remat(block)`` — segment-level remat around any block.
+- env ``MXNET_BACKWARD_DO_MIRROR=1`` — reference-parity switch; picked up
+  by both paths and by ``Executor`` bind.
+"""
+from __future__ import annotations
+
+__all__ = ["resolve_policy", "mirror_enabled"]
+
+
+def mirror_enabled():
+    """True when the reference's mirroring env flag is set."""
+    from .util import getenv
+
+    v = getenv("MXNET_BACKWARD_DO_MIRROR")
+    return v not in (None, "", "0", "false", "False")
+
+
+def resolve_policy(spec):
+    """Map a user remat spec to a jax.checkpoint policy.
+
+    - ``True``/``None`` -> recompute everything not needed structurally
+      (the strongest memory reduction; reference mirror's spirit)
+    - a string -> attribute of ``jax.checkpoint_policies``
+      (e.g. ``'dots_with_no_batch_dims_saveable'`` for transformer stacks,
+      keeping matmul outputs and recomputing elementwise chains)
+    - a callable -> used as the policy directly
+    """
+    import jax
+
+    if spec is None or spec is True:
+        return None
+    if isinstance(spec, str):
+        try:
+            return getattr(jax.checkpoint_policies, spec)
+        except AttributeError:
+            raise ValueError(
+                f"unknown remat policy '{spec}'; see jax.checkpoint_policies")
+    if callable(spec):
+        return spec
+    raise TypeError(f"remat spec must be bool/str/callable, got {type(spec)}")

@@ -432,7 +432,11 @@ def _conv_hook(in_shapes, p):
     k = (k,) if isinstance(k, int) else tuple(k)
     nf = p["num_filter"]
     ng = p.get("num_group", 1)
-    hints = {1: (nf, data[1] // ng) + k}
+    layout = p.get("layout")
+    if layout and layout[1] != "C":  # channels-last: OHWI weights
+        hints = {1: (nf,) + k + (data[-1] // ng,)}
+    else:
+        hints = {1: (nf, data[1] // ng) + k}
     if len(in_shapes) > 2:
         hints[2] = (nf,)
     return hints

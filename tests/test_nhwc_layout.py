@@ -1,0 +1,186 @@
+"""Channels-last (NHWC) layout and space-to-depth stem correctness.
+
+The TPU-native layout path (PERF.md): convs/pools/BN run channels-last with
+OHWI weights; the model zoo's `layout='NHWC'`/`stem='s2d'` options must be
+numerically equivalent to the reference-parity NCHW graph.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+def test_conv_nhwc_matches_nchw():
+    x = _rand(2, 5, 9, 9)
+    w = _rand(4, 5, 3, 3, seed=1)
+    b = _rand(4, seed=2)
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                            num_filter=4).asnumpy()
+    out = mx.nd.Convolution(
+        mx.nd.array(x.transpose(0, 2, 3, 1)),
+        mx.nd.array(w.transpose(0, 2, 3, 1)),  # OIHW -> OHWI
+        mx.nd.array(b), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+        num_filter=4, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_conv_asymmetric_padding():
+    x = _rand(1, 3, 8, 8)
+    w = _rand(2, 3, 4, 4, seed=1)
+    ref = mx.nd.Convolution(
+        mx.nd.array(np.pad(x, ((0, 0), (0, 0), (2, 1), (2, 1)))),
+        mx.nd.array(w), kernel=(4, 4), num_filter=2, no_bias=True).asnumpy()
+    out = mx.nd.Convolution(
+        mx.nd.array(x), mx.nd.array(w), kernel=(4, 4), num_filter=2,
+        no_bias=True, pad=((2, 1), (2, 1))).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_nhwc_matches_nchw(pool_type):
+    x = _rand(2, 3, 9, 9)
+    ref = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2),
+                        pad=(1, 1), pool_type=pool_type).asnumpy()
+    out = mx.nd.Pooling(mx.nd.array(x.transpose(0, 2, 3, 1)), kernel=(3, 3),
+                        stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+                        layout="NHWC").asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_global_pool_nhwc():
+    x = _rand(2, 3, 5, 5)
+    ref = mx.nd.Pooling(mx.nd.array(x), pool_type="avg",
+                        global_pool=True).asnumpy()
+    out = mx.nd.Pooling(mx.nd.array(x.transpose(0, 2, 3, 1)),
+                        pool_type="avg", global_pool=True,
+                        layout="NHWC").asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_batchnorm_channels_last_axis():
+    x = _rand(2, 4, 6, 3)
+    gamma, beta = _rand(3, seed=1) + 0.5, _rand(3, seed=2)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                          mx.nd.array(beta), mx.nd.array(mm),
+                          mx.nd.array(mv), axis=3, fix_gamma=False,
+                          eps=1e-5).asnumpy()
+    xt = x.transpose(0, 3, 1, 2)
+    ref = mx.nd.BatchNorm(mx.nd.array(xt), mx.nd.array(gamma),
+                          mx.nd.array(beta), mx.nd.array(mm),
+                          mx.nd.array(mv), axis=1, fix_gamma=False,
+                          eps=1e-5).asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_batchnorm_single_pass_stats_match_numpy():
+    # the E[x²]−E[x]² rewrite must still match two-pass numpy statistics
+    x = _rand(4, 3, 5, 5) * 10 + 100  # large mean stresses cancellation
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    with mx.autograd.record():  # train mode -> batch statistics
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mx.nd.array(mm),
+                              mx.nd.array(mv), fix_gamma=False,
+                              eps=1e-5).asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+
+def _copy_params(dst, src, transform=None):
+    """Copy name-matched params from src net to dst net; `transform` maps
+    (name, array) -> array for layout changes."""
+    sp = {k.split("_", 1)[1]: v for k, v in src.collect_params().items()}
+    for name, p in dst.collect_params().items():
+        short = name.split("_", 1)[1]
+        v = sp[short].data().asnumpy()
+        if transform is not None:
+            v = transform(short, v, tuple(p.shape))
+        p.set_data(mx.nd.array(v))
+
+
+def test_resnet_nhwc_equivalent_to_nchw():
+    net_c = vision.resnet18_v1(classes=10, thumbnail=True)
+    net_c.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(_rand(2, 3, 32, 32))
+    ref = net_c(x)
+
+    net_l = vision.resnet18_v1(classes=10, thumbnail=True, layout="NHWC")
+    net_l.initialize(mx.initializer.Xavier())
+    net_l(x)  # materialize shapes
+
+    def to_nhwc(name, v, want):
+        if v.ndim == 4:  # every 4-d param is a conv weight: OIHW -> OHWI
+            return v.transpose(0, 2, 3, 1)
+        return v
+
+    _copy_params(net_l, net_c, to_nhwc)
+    out = net_l(x)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_resnet_s2d_stem_equivalent_to_conv7():
+    """The stride-2 7x7 stem folds exactly into s2d(2) + stride-1 4x4."""
+    net7 = vision.resnet18_v1(classes=10)
+    net7.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(_rand(1, 3, 64, 64))
+    ref = net7(x)
+
+    nets = vision.resnet18_v1(classes=10, stem="s2d")
+    nets.initialize(mx.initializer.Xavier())
+    nets(x)
+
+    def fold(name, v, want):
+        if v.shape == want:
+            return v
+        # stem: w7 (O,3,7,7) -> pad front to (O,3,8,8) -> w4 (O,12,4,4)
+        o = v.shape[0]
+        w8 = np.zeros((o, 3, 8, 8), np.float32)
+        w8[:, :, 1:, 1:] = v
+        w4 = np.zeros((o, 12, 4, 4), np.float32)
+        for dy in range(2):
+            for dx in range(2):
+                for c in range(3):
+                    # s2d channel order: (dy, dx, c) -> dy*6 + dx*3 + c
+                    w4[:, dy * 6 + dx * 3 + c] = w8[:, c, dy::2, dx::2]
+        return w4
+
+    _copy_params(nets, net7, fold)
+    out = nets(x)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_nhwc_conv_layer_gradients():
+    """Training step on an NHWC conv stack runs and produces finite grads."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC"))
+    net.add(nn.BatchNorm(axis=3))
+    net.add(nn.Activation("relu"))
+    net.add(nn.MaxPool2D(2, 2, layout="NHWC"))
+    net.add(nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(_rand(2, 8, 8, 3))
+    with mx.autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    for _, p in net.collect_params().items():
+        if p.grad_req != "null":
+            g = p.grad().asnumpy()
+            assert np.isfinite(g).all()
