@@ -74,14 +74,16 @@ def main():
             net(mx.nd.zeros((2, 3, 224, 224)))  # materialize params
             nets[key] = net
         net = nets[key]
-        trainer = parallel.ShardedTrainer(
-            net, gluon.loss.SoftmaxCrossEntropyLoss(),
-            "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
-            dtype=dtype)
         x = rng.rand(batch, 3, 224, 224).astype(np.float32)
         y = (rng.rand(batch) * 1000).astype(np.float32)
         img_s = None
         for attempt in range(3):  # the remote-compile tunnel can flake
+            # fresh trainer per attempt: a step that dies mid-flight has
+            # already donated the previous trainer's param buffers
+            trainer = parallel.ShardedTrainer(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+                dtype=dtype)
             try:
                 img_s = _throughput(trainer, x, y, iters)
                 break

@@ -98,12 +98,14 @@ class SyncBatchNorm(_nn.BatchNorm):
 class Remat(HybridBlock):
     """Segment-level activation rematerialization around any block.
 
-    Inside a compiled trace (hybridize / ShardedTrainer / Executor bind)
+    Inside a functional trace (ShardedTrainer / parallel.functional_call —
+    the compiled-training paths, where parameter cells hold jax tracers)
     the wrapped block runs under ``jax.checkpoint``: its internal
     activations are recomputed during the backward instead of kept —
     the segment-granular form of the reference's gradient mirroring
-    (src/nnvm/gradient.cc:107-148). In plain eager mode it is a
-    transparent pass-through.
+    (src/nnvm/gradient.cc:107-148). In plain eager mode and under
+    hybridize's discovery trace (where cells hold concrete values that
+    must be *captured*, not baked in) it is a transparent pass-through.
 
     Example::
 
@@ -125,6 +127,16 @@ class Remat(HybridBlock):
             return self.block(*args)
 
         import jax
+
+        # only checkpoint when the cells are already functional (tracers):
+        # in a TracedFunction discovery run the cells hold concrete arrays
+        # and reading them here would bake weights into the compiled cache
+        # as constants — pass through and let the tape capture them
+        cell_vals = [p.data().data_
+                     for p in self.block.collect_params().values()]
+        cell_vals += [a.data_ for a in args if isinstance(a, NDArray)]
+        if not any(isinstance(v, jax.core.Tracer) for v in cell_vals):
+            return self.block(*args)
 
         from ... import autograd
         from ...parallel.functional import (

@@ -27,6 +27,37 @@ def _bn_axis(layout):
     return 3 if layout == "NHWC" else 1
 
 
+def _add_stem(features, channels0, thumbnail, stem, layout):
+    """Append the shared input stem. stem='s2d' folds the stride-2 7x7
+    into s2d(2)+4x4/1 with (2,1) pads (7 padded to 8) — exact for V1
+    (tests/test_nhwc_layout.py); V2 rejects it because its input BatchNorm
+    must see raw channels, not (offset, channel) subgrids."""
+    ax = _bn_axis(layout)
+    if thumbnail:
+        features.add(_conv3x3(channels0, 1, 0, layout))
+        return
+    if stem == "s2d":
+        # 224^2 RGB -> s2d(2) -> 112^2 x 12
+        features.add(nn.Conv2D(channels0, 4, 1, ((2, 1), (2, 1)),
+                               use_bias=False, in_channels=12,
+                               layout=layout))
+    else:
+        features.add(nn.Conv2D(channels0, 7, 2, 3, use_bias=False,
+                               layout=layout))
+    features.add(nn.BatchNorm(axis=ax))
+    features.add(nn.Activation("relu"))
+    features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+
+
+def _input_preamble(F, x, stem, layout):
+    """NCHW API input -> internal layout (one transform at the graph edge)."""
+    if stem == "s2d":
+        x = F.space_to_depth(x, block_size=2)
+    if layout == "NHWC":
+        x = F.transpose(x, axes=(0, 2, 3, 1))
+    return x
+
+
 class BasicBlockV1(HybridBlock):
     """ResNet V1 basic block (model_zoo/vision/resnet.py:40)."""
 
@@ -182,24 +213,9 @@ class ResNetV1(HybridBlock):
             "stem='s2d' replaces the 7x7 stem; thumbnail nets have none"
         self._layout = layout
         self._stem = stem
-        ax = _bn_axis(layout)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0, layout))
-            else:
-                if stem == "s2d":
-                    # 224² RGB -> s2d(2) -> 112²x12; the stride-2 7x7 folds
-                    # into a stride-1 4x4 with (2,1) pads (7 padded to 8)
-                    self.features.add(nn.Conv2D(
-                        channels[0], 4, 1, ((2, 1), (2, 1)), use_bias=False,
-                        in_channels=12, layout=layout))
-                else:
-                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                                use_bias=False, layout=layout))
-                self.features.add(nn.BatchNorm(axis=ax))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+            _add_stem(self.features, channels[0], thumbnail, stem, layout)
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
@@ -220,10 +236,7 @@ class ResNetV1(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
-        if self._stem == "s2d":
-            x = F.space_to_depth(x, block_size=2)
-        if self._layout == "NHWC":
-            x = F.transpose(x, axes=(0, 2, 3, 1))
+        x = _input_preamble(F, x, self._stem, self._layout)
         x = self.features(x)
         return self.output(x)
 
@@ -235,9 +248,9 @@ class ResNetV2(HybridBlock):
                  thumbnail=False, layout="NCHW", stem="conv7", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
-        assert stem in ("conv7", "s2d")
-        assert not (thumbnail and stem == "s2d"), \
-            "stem='s2d' replaces the 7x7 stem; thumbnail nets have none"
+        assert stem == "conv7", \
+            "s2d stem is V1-only: V2's input BatchNorm must normalize raw " \
+            "channels, and s2d before it would regroup them per pixel parity"
         self._layout = layout
         self._stem = stem
         ax = _bn_axis(layout)
@@ -245,19 +258,7 @@ class ResNetV2(HybridBlock):
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False,
                                            axis=ax))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0, layout))
-            else:
-                if stem == "s2d":
-                    self.features.add(nn.Conv2D(
-                        channels[0], 4, 1, ((2, 1), (2, 1)), use_bias=False,
-                        in_channels=12, layout=layout))
-                else:
-                    self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                                use_bias=False, layout=layout))
-                self.features.add(nn.BatchNorm(axis=ax))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
+            _add_stem(self.features, channels[0], thumbnail, stem, layout)
             in_channels = channels[0]
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
@@ -283,10 +284,7 @@ class ResNetV2(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
-        if self._stem == "s2d":
-            x = F.space_to_depth(x, block_size=2)
-        if self._layout == "NHWC":
-            x = F.transpose(x, axes=(0, 2, 3, 1))
+        x = _input_preamble(F, x, self._stem, self._layout)
         x = self.features(x)
         return self.output(x)
 

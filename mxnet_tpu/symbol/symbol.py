@@ -637,6 +637,12 @@ def make_symbol_creator(opname):
                     s = Variable(f"{name}_{an}")
                     if idx in mutate_idx:
                         s._outputs[0][0].aux_mark = True
+            elif idx in mutate_idx:
+                # explicitly-passed bare variables in mutate slots are
+                # auxiliary state too (reference: mutable inputs are aux)
+                node = s._outputs[0][0]
+                if node.is_var:
+                    node.aux_mark = True
             final_inputs.append(s)
         return _create(opname, final_inputs, params, name=name, attr=attr)
 
