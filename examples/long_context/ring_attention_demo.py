@@ -34,6 +34,9 @@ def main():
     ap.add_argument("--seq-len", type=int, default=8192)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--impl", choices=["dense", "flash"], default="dense",
+                    help="per-hop kernel: flash streams each hop through "
+                         "the Pallas kernel (O(T_local*BLOCK) memory)")
     args = ap.parse_args()
 
     n = len(jax.devices())
@@ -48,11 +51,14 @@ def main():
         rng.randn(1, args.heads, T, args.dim).astype(np.float32) * 0.1,
         NamedSharding(mesh, spec)) for _ in range(3)]
 
+    interpret = jax.default_backend() == "cpu"
+
     def loss(q, k, v):
         f = jax.shard_map(
             lambda a, b, c: parallel.ring.ring_attention_inner(
-                a, b, c, causal=True),
-            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+                a, b, c, causal=True, impl=args.impl, interpret=interpret),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            check_vma=(args.impl != "flash"))
         return jnp.mean(f(q, k, v) ** 2)
 
     val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)

@@ -15,6 +15,7 @@ def __getattr__(name):
         "tensorboard": ".tensorboard",
         "quantization": ".quantization",
         "svrg_optimization": ".svrg_optimization",
+        "onnx": ".onnx",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

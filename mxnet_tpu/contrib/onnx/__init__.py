@@ -1,0 +1,62 @@
+"""ONNX export/import (parity: python/mxnet/contrib/onnx/).
+
+Reference surface: mx2onnx.export_model (export_model.py:35) and
+onnx2mx.import_model. The environment ships no onnx package, so the
+ModelProto is written/read by the self-contained wire codec in proto.py;
+round-trip fidelity is proven by tests/test_onnx.py (forward equivalence
+after export→import).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .export_onnx import export_symbol, TRANSLATORS, OPSET
+from .import_onnx import parse_model, build_symbol, BUILDERS
+
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "export_symbol", "parse_model"]
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol (or saved json path) + params (dict or .params
+    path) to an ONNX file (reference export_model.py:35)."""
+    from ...symbol import load as sym_load
+    from ... import ndarray as nd
+
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if isinstance(params, str):
+        loaded = nd.load(params)
+        params = {}
+        for k, v in loaded.items():
+            params[k.split(":", 1)[-1]] = v
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+    data_names = [n for n in sym.list_arguments() if n not in params]
+    input_shapes = dict(zip(data_names, input_shape))
+    blob = export_symbol(sym, params, input_shapes)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"ONNX model saved to {onnx_file_path} "
+              f"({len(blob)} bytes, opset {OPSET})")
+    return onnx_file_path
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params)
+    (reference onnx2mx/import_model.py)."""
+    with open(model_file, "rb") as f:
+        model = parse_model(f.read())
+    return build_symbol(model)
+
+
+def get_model_metadata(model_file):
+    """Input/output names of an ONNX model
+    (reference onnx2mx/import_model.py get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        model = parse_model(f.read())
+    return {"input_tensor_data": model["inputs"],
+            "output_tensor_data": model["outputs"],
+            "producer": model["producer"], "opset": model["opset"]}

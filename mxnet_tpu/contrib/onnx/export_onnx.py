@@ -1,0 +1,477 @@
+"""Symbol graph -> ONNX model serialization.
+
+Parity: python/mxnet/contrib/onnx/mx2onnx/export_onnx.py +
+_op_translations.py in the reference, rebuilt against this framework's
+Symbol graph (symbol/symbol.py) and the self-contained wire codec
+(proto.py) — the environment has no onnx package, so the ModelProto is
+emitted directly.
+
+Opset: 11 (attribute conventions below follow it — Reshape/Pad/Slice/Clip
+take tensor inputs, Dropout's ratio is an attribute).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from . import proto as P
+
+ONNX_FLOAT, ONNX_INT64 = 1, 7
+_ATTR_FLOAT, _ATTR_INT, _ATTR_STR, _ATTR_FLOATS, _ATTR_INTS, _ATTR_STRS = \
+    1, 2, 3, 6, 7, 8
+OPSET = 11
+
+
+# ---------------------------------------------------------------- protos
+
+def _attr(name, val):
+    b = P.emit_str(1, name)
+    if isinstance(val, float):
+        b += P.emit_float(2, val) + P.emit_int(20, _ATTR_FLOAT)
+    elif isinstance(val, bool) or isinstance(val, (int, np.integer)):
+        b += P.emit_int(3, int(val)) + P.emit_int(20, _ATTR_INT)
+    elif isinstance(val, str):
+        b += P.emit_bytes(4, val.encode()) + P.emit_int(20, _ATTR_STR)
+    elif isinstance(val, (list, tuple)):
+        if val and isinstance(val[0], float):
+            b += b"".join(P.emit_float(7, v) for v in val)
+            b += P.emit_int(20, _ATTR_FLOATS)
+        else:
+            b += b"".join(P.emit_int(8, int(v)) for v in val)
+            b += P.emit_int(20, _ATTR_INTS)
+    else:  # pragma: no cover
+        raise TypeError(f"attribute {name}: {type(val)}")
+    return P.emit_bytes(5, b)
+
+
+def _node(op_type, inputs, outputs, name="", **attrs):
+    b = b"".join(P.emit_str(1, i) for i in inputs)
+    b += b"".join(P.emit_str(2, o) for o in outputs)
+    if name:
+        b += P.emit_str(3, name)
+    b += P.emit_str(4, op_type)
+    for k, v in attrs.items():
+        b += _attr(k, v)
+    return b
+
+
+def _tensor(name, arr):
+    arr = np.asarray(arr)
+    if arr.dtype in (np.int32, np.int64):
+        arr = arr.astype(np.int64)
+        dtype = ONNX_INT64
+    else:
+        arr = arr.astype(np.float32)
+        dtype = ONNX_FLOAT
+    b = b"".join(P.emit_int(1, d) for d in arr.shape)
+    b += P.emit_int(2, dtype)
+    b += P.emit_str(8, name)
+    b += P.emit_bytes(9, arr.tobytes())  # raw_data (little-endian)
+    return b
+
+
+def _value_info(name, shape, dtype=ONNX_FLOAT):
+    dims = b"".join(
+        P.emit_bytes(1, P.emit_int(1, d)) for d in shape)  # Dimension
+    shape_proto = P.emit_bytes(2, dims)  # TensorShapeProto
+    tensor_type = P.emit_bytes(1, P.emit_int(1, dtype) + shape_proto)
+    return P.emit_str(1, name) + P.emit_bytes(2, tensor_type)
+
+
+def _graph(nodes, name, initializers, inputs, outputs):
+    b = b"".join(P.emit_bytes(1, n) for n in nodes)
+    b += P.emit_str(2, name)
+    b += b"".join(P.emit_bytes(5, t) for t in initializers)
+    b += b"".join(P.emit_bytes(11, v) for v in inputs)
+    b += b"".join(P.emit_bytes(12, v) for v in outputs)
+    return b
+
+
+def _model(graph):
+    b = P.emit_int(1, 6)  # ir_version 6 <-> opset 11 era
+    b += P.emit_str(2, "mxnet_tpu")
+    b += P.emit_str(3, "1.6.0")
+    b += P.emit_bytes(7, graph)
+    b += P.emit_bytes(14, P.emit_str(1, "") + P.emit_int(2, OPSET))
+    return b
+
+
+# ------------------------------------------------------- op translations
+#
+# Each translator: fn(ctx, node_name, inputs, params) -> list[node bytes].
+# `inputs` are resolved ONNX value names; output name == node_name.
+
+def _pads2(pad):
+    """Symbol pad tuple -> ONNX pads [x1b, x2b, ..., x1e, x2e]."""
+    begins, ends = [], []
+    for p in pad:
+        if isinstance(p, (tuple, list)):
+            begins.append(int(p[0]))
+            ends.append(int(p[1]))
+        else:
+            begins.append(int(p))
+            ends.append(int(p))
+    return begins + ends
+
+
+def _tuple_of(v, n=None):
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        v = (int(v),) * (n or 1)
+    return tuple(v)
+
+
+class _Ctx:
+    """Export state: extra initializers created by translators."""
+
+    def __init__(self):
+        self.extra_init = []
+        self._n = 0
+
+    def const(self, arr, hint="const"):
+        name = f"__{hint}_{self._n}"
+        self._n += 1
+        self.extra_init.append(_tensor(name, arr))
+        return name
+
+
+def _t_convolution(ctx, name, ins, p):
+    if p.get("layout") not in (None, "NCHW", "NCW", "NCDHW"):
+        raise ValueError("ONNX export supports channels-first layouts only")
+    kernel = _tuple_of(p.get("kernel"))
+    nd = len(kernel)
+    attrs = dict(kernel_shape=list(kernel),
+                 strides=list(_tuple_of(p.get("stride") or 1, nd)),
+                 dilations=list(_tuple_of(p.get("dilate") or 1, nd)),
+                 group=int(p.get("num_group", 1)),
+                 pads=_pads2(_tuple_of(p.get("pad") or 0, nd)))
+    return [_node("Conv", ins, [name], name, **attrs)]
+
+
+def _t_deconvolution(ctx, name, ins, p):
+    kernel = _tuple_of(p.get("kernel"))
+    nd = len(kernel)
+    attrs = dict(kernel_shape=list(kernel),
+                 strides=list(_tuple_of(p.get("stride") or 1, nd)),
+                 dilations=list(_tuple_of(p.get("dilate") or 1, nd)),
+                 group=int(p.get("num_group", 1)),
+                 pads=_pads2(_tuple_of(p.get("pad") or 0, nd)))
+    return [_node("ConvTranspose", ins, [name], name, **attrs)]
+
+
+def _t_fullyconnected(ctx, name, ins, p):
+    nodes = []
+    data = ins[0]
+    if p.get("flatten", True):
+        nodes.append(_node("Flatten", [data], [name + "_flat"],
+                           name + "_flat", axis=1))
+        data = name + "_flat"
+    if p.get("no_bias"):
+        zero = ctx.const(np.zeros(int(p["num_hidden"]), np.float32), "zb")
+        gemm_in = [data, ins[1], zero]
+    else:
+        gemm_in = [data, ins[1], ins[2]]
+    nodes.append(_node("Gemm", gemm_in, [name], name, alpha=1.0, beta=1.0,
+                       transA=0, transB=1))
+    return nodes
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _t_activation(ctx, name, ins, p):
+    return [_node(_ACT[p.get("act_type", "relu")], [ins[0]], [name], name)]
+
+
+def _t_leakyrelu(ctx, name, ins, p):
+    act = p.get("act_type", "leaky")
+    slope = float(p.get("slope", 0.25))
+    if act == "leaky":
+        return [_node("LeakyRelu", [ins[0]], [name], name, alpha=slope)]
+    if act == "elu":
+        return [_node("Elu", [ins[0]], [name], name, alpha=slope)]
+    if act == "selu":
+        return [_node("Selu", [ins[0]], [name], name)]
+    if act == "prelu":
+        return [_node("PRelu", [ins[0], ins[1]], [name], name)]
+    raise ValueError(f"LeakyReLU act_type {act} not expressible in ONNX")
+
+
+def _t_batchnorm(ctx, name, ins, p):
+    if int(p.get("axis", 1)) != 1:
+        raise ValueError("ONNX BatchNormalization is channels-first (axis=1)")
+    return [_node("BatchNormalization",
+                  [ins[0], ins[1], ins[2], ins[3], ins[4]], [name], name,
+                  epsilon=float(p.get("eps", 1e-3)),
+                  momentum=float(p.get("momentum", 0.9)))]
+
+
+def _t_pooling(ctx, name, ins, p):
+    ptype = p.get("pool_type", "max")
+    if p.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        return [_node(op, [ins[0]], [name], name)]
+    kernel = _tuple_of(p.get("kernel"))
+    nd = len(kernel)
+    attrs = dict(kernel_shape=list(kernel),
+                 strides=list(_tuple_of(p.get("stride") or 1, nd)),
+                 pads=_pads2(_tuple_of(p.get("pad") or 0, nd)))
+    if p.get("pooling_convention") == "full":
+        attrs["ceil_mode"] = 1
+    if ptype == "max":
+        return [_node("MaxPool", [ins[0]], [name], name, **attrs)]
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(p.get("count_include_pad", True))
+        return [_node("AveragePool", [ins[0]], [name], name, **attrs)]
+    raise ValueError(f"pool_type {ptype} not expressible in ONNX")
+
+
+def _t_softmax_output(ctx, name, ins, p):
+    # reference _op_translations.py: SoftmaxOutput exports as plain Softmax
+    # over the class axis (the loss head has no inference meaning)
+    return [_node("Softmax", [ins[0]], [name], name, axis=1)]
+
+
+def _t_softmax(ctx, name, ins, p):
+    return [_node("Softmax", [ins[0]], [name], name,
+                  axis=int(p.get("axis", -1)))]
+
+
+def _t_log_softmax(ctx, name, ins, p):
+    return [_node("LogSoftmax", [ins[0]], [name], name,
+                  axis=int(p.get("axis", -1)))]
+
+
+def _t_flatten(ctx, name, ins, p):
+    return [_node("Flatten", [ins[0]], [name], name, axis=1)]
+
+
+def _t_reshape(ctx, name, ins, p):
+    shape = ctx.const(np.asarray(p.get("shape"), np.int64), "shape")
+    return [_node("Reshape", [ins[0], shape], [name], name)]
+
+
+def _t_transpose(ctx, name, ins, p):
+    return [_node("Transpose", [ins[0]], [name], name,
+                  perm=list(p.get("axes") or []))]
+
+
+def _t_concat(ctx, name, ins, p):
+    return [_node("Concat", ins, [name], name, axis=int(p.get("dim", 1)))]
+
+
+def _t_elemwise(op_type):
+    def t(ctx, name, ins, p):
+        return [_node(op_type, ins, [name], name)]
+    return t
+
+
+def _t_scalar(op_type):
+    def t(ctx, name, ins, p):
+        scalar = ctx.const(np.float32(p.get("scalar", 0.0)), "scalar")
+        ins2 = [scalar, ins[0]] if p.get("reverse") else [ins[0], scalar]
+        return [_node(op_type, ins2, [name], name)]
+    return t
+
+
+def _t_dropout(ctx, name, ins, p):
+    return [_node("Dropout", [ins[0]], [name], name,
+                  ratio=float(p.get("p", 0.5)))]
+
+
+def _t_lrn(ctx, name, ins, p):
+    return [_node("LRN", [ins[0]], [name], name,
+                  alpha=float(p.get("alpha", 1e-4)),
+                  beta=float(p.get("beta", 0.75)),
+                  bias=float(p.get("knorm", 2.0)),
+                  size=int(p.get("nsize")))]
+
+
+def _t_embedding(ctx, name, ins, p):
+    cast = name + "_idx"
+    return [_node("Cast", [ins[0]], [cast], cast, to=ONNX_INT64),
+            _node("Gather", [ins[1], cast], [name], name, axis=0)]
+
+
+def _t_identity(ctx, name, ins, p):
+    return [_node("Identity", [ins[0]], [name], name)]
+
+
+def _t_space_to_depth(ctx, name, ins, p):
+    return [_node("SpaceToDepth", [ins[0]], [name], name,
+                  blocksize=int(p.get("block_size", 1)))]
+
+
+def _t_depth_to_space(ctx, name, ins, p):
+    return [_node("DepthToSpace", [ins[0]], [name], name,
+                  blocksize=int(p.get("block_size", 1)))]
+
+
+def _t_slice_channel(ctx, name, ins, p):
+    n = int(p.get("num_outputs"))
+    outs = [f"{name}_out{i}" for i in range(n)]
+    return [_node("Split", [ins[0]], outs, name,
+                  axis=int(p.get("axis", 1)))]
+
+
+def _t_reduce(op_type):
+    def t(ctx, name, ins, p):
+        axis = p.get("axis")
+        attrs = {"keepdims": int(p.get("keepdims", False))}
+        if axis is not None:
+            axis = [axis] if isinstance(axis, int) else list(axis)
+            attrs["axes"] = axis
+        return [_node(op_type, [ins[0]], [name], name, **attrs)]
+    return t
+
+
+def _t_dot(ctx, name, ins, p):
+    if p.get("transpose_a") or p.get("transpose_b"):
+        raise ValueError("dot with transpose flags not supported in export")
+    return [_node("MatMul", ins, [name], name)]
+
+
+def _t_clip(ctx, name, ins, p):
+    lo = ctx.const(np.float32(p.get("a_min")), "min")
+    hi = ctx.const(np.float32(p.get("a_max")), "max")
+    return [_node("Clip", [ins[0], lo, hi], [name], name)]
+
+
+def _t_pad(ctx, name, ins, p):
+    mode = p.get("mode", "constant")
+    pw = p.get("pad_width") or ()
+    n = len(pw) // 2
+    begins = [int(pw[2 * i]) for i in range(n)]
+    ends = [int(pw[2 * i + 1]) for i in range(n)]
+    pads = ctx.const(np.asarray(begins + ends, np.int64), "pads")
+    return [_node("Pad", [ins[0], pads], [name], name,
+                  mode={"constant": "constant", "edge": "edge",
+                        "reflect": "reflect"}[mode])]
+
+
+TRANSLATORS = {
+    "Convolution": _t_convolution,
+    "Deconvolution": _t_deconvolution,
+    "FullyConnected": _t_fullyconnected,
+    "Activation": _t_activation,
+    "LeakyReLU": _t_leakyrelu,
+    "BatchNorm": _t_batchnorm,
+    "Pooling": _t_pooling,
+    "SoftmaxOutput": _t_softmax_output,
+    "softmax": _t_softmax,
+    "log_softmax": _t_log_softmax,
+    "SoftmaxActivation": _t_softmax_output,
+    "Flatten": _t_flatten,
+    "Reshape": _t_reshape,
+    "transpose": _t_transpose,
+    "Concat": _t_concat,
+    "elemwise_add": _t_elemwise("Add"),
+    "elemwise_sub": _t_elemwise("Sub"),
+    "elemwise_mul": _t_elemwise("Mul"),
+    "elemwise_div": _t_elemwise("Div"),
+    "broadcast_add": _t_elemwise("Add"),
+    "broadcast_sub": _t_elemwise("Sub"),
+    "broadcast_mul": _t_elemwise("Mul"),
+    "broadcast_div": _t_elemwise("Div"),
+    "elemwise_add_scalar": _t_scalar("Add"),
+    "elemwise_sub_scalar": _t_scalar("Sub"),
+    "elemwise_mul_scalar": _t_scalar("Mul"),
+    "elemwise_div_scalar": _t_scalar("Div"),
+    "Dropout": _t_dropout,
+    "LRN": _t_lrn,
+    "Embedding": _t_embedding,
+    "identity": _t_identity,
+    "BlockGrad": _t_identity,
+    "space_to_depth": _t_space_to_depth,
+    "depth_to_space": _t_depth_to_space,
+    "SliceChannel": _t_slice_channel,
+    "sum": _t_reduce("ReduceSum"),
+    "mean": _t_reduce("ReduceMean"),
+    "max": _t_reduce("ReduceMax"),
+    "min": _t_reduce("ReduceMin"),
+    "dot": _t_dot,
+    "clip": _t_clip,
+    "pad": _t_pad,
+    "relu": lambda ctx, name, ins, p: [_node("Relu", [ins[0]], [name], name)],
+    "sigmoid": lambda ctx, name, ins, p: [_node("Sigmoid", [ins[0]], [name], name)],
+    "tanh": lambda ctx, name, ins, p: [_node("Tanh", [ins[0]], [name], name)],
+    "exp": lambda ctx, name, ins, p: [_node("Exp", [ins[0]], [name], name)],
+    "log": lambda ctx, name, ins, p: [_node("Log", [ins[0]], [name], name)],
+    "sqrt": lambda ctx, name, ins, p: [_node("Sqrt", [ins[0]], [name], name)],
+    "abs": lambda ctx, name, ins, p: [_node("Abs", [ins[0]], [name], name)],
+    "negative": lambda ctx, name, ins, p: [_node("Neg", [ins[0]], [name], name)],
+}
+
+
+def export_symbol(symbol, params, input_shapes, input_dtype=np.float32,
+                  graph_name="mxnet_tpu_graph"):
+    """Serialize a Symbol + {name: ndarray} params into ONNX ModelProto
+    bytes. ``input_shapes`` is {input_name: shape} for the data inputs
+    (everything in list_arguments() not found in params)."""
+    from ...ndarray.ndarray import NDArray
+
+    params = {k: (v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
+              for k, v in params.items()}
+
+    nodes_b = []
+    ctx = _Ctx()
+    name_of = {}  # (id(node), slot) -> ONNX value name
+    used_names = set()
+
+    def uniq(name):
+        # gluon traces name every layer's op node "fwd"; ONNX value names
+        # must be graph-unique
+        if name not in used_names:
+            used_names.add(name)
+            return name
+        k = 1
+        while f"{name}_{k}" in used_names:
+            k += 1
+        used_names.add(f"{name}_{k}")
+        return f"{name}_{k}"
+
+    graph_nodes = symbol._topo_nodes()
+    out_specs = symbol._outputs
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+
+    data_inputs = [n for n in arg_names if n not in params]
+    missing = [n for n in data_inputs if n not in input_shapes]
+    if missing:
+        raise ValueError(f"export: provide input_shapes for {missing}")
+
+    for node in graph_nodes:
+        if node.is_var:
+            name_of[(id(node), 0)] = uniq(node.name)
+            continue
+        op = node.op
+        t = TRANSLATORS.get(op)
+        if t is None:
+            raise ValueError(
+                f"ONNX export: op '{op}' has no translator "
+                f"(node '{node.name}'); supported: {sorted(TRANSLATORS)}")
+        from ...ops.registry import get_op
+
+        p = get_op(op).normalize(node.params)
+        ins = [name_of[(id(i), s)] for i, s in node.inputs]
+        node_name = uniq(node.name)
+        out_nodes = t(ctx, node_name, ins, p)
+        nodes_b.extend(out_nodes)
+        # register outputs: single-output default; Split declares its own
+        if op == "SliceChannel":
+            for i in range(int(p.get("num_outputs"))):
+                name_of[(id(node), i)] = f"{node_name}_out{i}"
+        else:
+            name_of[(id(node), 0)] = node_name
+
+    initializers = [_tensor(k, v) for k, v in params.items()
+                    if k in set(arg_names) | set(aux_names)]
+    initializers += ctx.extra_init
+    inputs = [_value_info(n, input_shapes[n]) for n in data_inputs]
+    outputs = [_value_info(name_of[(id(n), i)], ())
+               for n, i in out_specs]
+    graph = _graph(nodes_b, graph_name, initializers, inputs, outputs)
+    return _model(graph)

@@ -1,0 +1,396 @@
+"""ONNX model -> Symbol graph deserialization.
+
+Parity: python/mxnet/contrib/onnx/onnx2mx/import_onnx.py. Covers the op
+set this framework's exporter emits (export_onnx.TRANSLATORS) so
+export→import round-trips reproduce the original network; models produced
+by other exporters work as long as they stay inside that op set.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto as P
+from .export_onnx import ONNX_FLOAT, ONNX_INT64
+
+# AttributeProto.type values
+_AF, _AI, _AS, _AT, _AFS, _AIS, _ASS = 1, 2, 3, 4, 6, 7, 8
+
+
+def _ints(field_vals):
+    """Repeated int64 field: proto3 serializers pack the list into one
+    LEN blob; our own emitter writes them unpacked. Accept both."""
+    out = []
+    for v in field_vals:
+        if isinstance(v, (bytes, bytearray)):
+            out.extend(P.parse_packed_ints(v))
+        else:
+            out.append(int(v))
+    return out
+
+
+def _floats(field_vals):
+    out = []
+    for v in field_vals:
+        if isinstance(v, (bytes, bytearray)):
+            out.extend(P.parse_packed_floats(v))
+        else:
+            out.append(float(v))
+    return out
+
+
+def _parse_tensor(raw):
+    f = P.parse_message(raw)
+    dims = _ints(f.get(1, []))
+    dtype = P.first_int(f, 2, ONNX_FLOAT)
+    name = P.first_str(f, 8)
+    if 9 in f:  # raw_data
+        buf = f[9][0]
+        np_dtype = np.float32 if dtype == ONNX_FLOAT else np.int64
+        arr = np.frombuffer(buf, dtype=np_dtype).reshape(dims)
+    elif dtype == ONNX_FLOAT and 4 in f:
+        arr = np.asarray(_floats(f[4]), np.float32).reshape(dims)
+    elif dtype == ONNX_INT64 and 7 in f:
+        arr = np.asarray(_ints(f[7]), np.int64).reshape(dims)
+    else:
+        arr = np.zeros(dims, np.float32)
+    return name, arr
+
+
+def _parse_attr(raw):
+    f = P.parse_message(raw)
+    name = P.first_str(f, 1)
+    atype = P.first_int(f, 20)
+    if atype == _AF:
+        return name, float(f[2][0])
+    if atype == _AI:
+        return name, int(f[3][0])
+    if atype == _AS:
+        return name, f[4][0].decode()
+    if atype == _AFS:
+        return name, _floats(f.get(7, []))
+    if atype == _AIS:
+        return name, _ints(f.get(8, []))
+    if atype == _AT:
+        return name, _parse_tensor(f[5][0])
+    raise ValueError(f"attribute {name}: unsupported type {atype}")
+
+
+def _parse_node(raw):
+    f = P.parse_message(raw)
+    return {
+        "inputs": [v.decode() for v in f.get(1, [])],
+        "outputs": [v.decode() for v in f.get(2, [])],
+        "name": P.first_str(f, 3),
+        "op": P.first_str(f, 4),
+        "attrs": dict(_parse_attr(a) for a in f.get(5, [])),
+    }
+
+
+def parse_model(data: bytes):
+    """ModelProto bytes -> dict with nodes/initializers/inputs/outputs."""
+    mf = P.parse_message(data)
+    graph = P.parse_message(P.first_bytes(mf, 7))
+    nodes = [_parse_node(n) for n in graph.get(1, [])]
+    inits = dict(_parse_tensor(t) for t in graph.get(5, []))
+
+    def _vi_name(raw):
+        return P.first_str(P.parse_message(raw), 1)
+
+    inputs = [_vi_name(v) for v in graph.get(11, [])]
+    outputs = [_vi_name(v) for v in graph.get(12, [])]
+    opset = 0
+    for os_raw in mf.get(14, []):
+        osf = P.parse_message(os_raw)
+        opset = max(opset, P.first_int(osf, 2))
+    return {"nodes": nodes, "initializers": inits, "inputs": inputs,
+            "outputs": outputs, "opset": opset,
+            "producer": P.first_str(mf, 2)}
+
+
+# ------------------------------------------------------- op constructors
+#
+# Each builder: fn(sym_mod, ins(list of Symbols/values), attrs, consts)
+# -> Symbol (or list of Symbols for multi-output).
+
+def _b_conv(sym, ins, a, consts):
+    kernel = tuple(a["kernel_shape"])
+    nd = len(kernel)
+    pads = a.get("pads") or [0] * (2 * nd)
+    begins, ends = pads[:nd], pads[nd:]
+    pad = tuple((b, e) for b, e in zip(begins, ends))
+    if all(b == e for b, e in pad):
+        pad = tuple(b for b, _ in pad)
+    nf = int(consts.shape_of(ins[1])[0])
+    return sym.Convolution(*ins, kernel=kernel,
+                           stride=tuple(a.get("strides") or (1,) * nd),
+                           dilate=tuple(a.get("dilations") or (1,) * nd),
+                           pad=pad, num_group=int(a.get("group", 1)),
+                           num_filter=nf, no_bias=len(ins) < 3)
+
+
+def _b_deconv(sym, ins, a, consts):
+    kernel = tuple(a["kernel_shape"])
+    nd = len(kernel)
+    pads = a.get("pads") or [0] * (2 * nd)
+    g = int(a.get("group", 1))
+    nf = int(consts.shape_of(ins[1])[1]) * g
+    return sym.Deconvolution(*ins, kernel=kernel,
+                             stride=tuple(a.get("strides") or (1,) * nd),
+                             dilate=tuple(a.get("dilations") or (1,) * nd),
+                             pad=tuple(pads[:nd]),
+                             num_group=g, num_filter=nf,
+                             no_bias=len(ins) < 3)
+
+
+def _b_gemm(sym, ins, a, consts):
+    assert a.get("transB", 0) == 1 and a.get("transA", 0) == 0, \
+        "only Gemm(transB=1) (the FullyConnected export form) supported"
+    num_hidden = consts.shape_of(ins[1])[0]
+    return sym.FullyConnected(ins[0], ins[1], ins[2],
+                              num_hidden=int(num_hidden), flatten=False)
+
+
+def _b_bn(sym, ins, a, consts):
+    return sym.BatchNorm(ins[0], ins[1], ins[2], ins[3], ins[4],
+                         eps=float(a.get("epsilon", 1e-5)),
+                         momentum=float(a.get("momentum", 0.9)),
+                         fix_gamma=False)
+
+
+def _b_pool(op_type):
+    def b(sym, ins, a, consts):
+        if op_type in ("GlobalMaxPool", "GlobalAveragePool"):
+            return sym.Pooling(
+                ins[0], global_pool=True, kernel=(1, 1),
+                pool_type="max" if "Max" in op_type else "avg")
+        kernel = tuple(a["kernel_shape"])
+        nd = len(kernel)
+        pads = a.get("pads") or [0] * (2 * nd)
+        kw = dict(kernel=kernel,
+                  stride=tuple(a.get("strides") or (1,) * nd),
+                  pad=tuple(pads[:nd]),
+                  pool_type="max" if op_type == "MaxPool" else "avg")
+        if a.get("ceil_mode"):
+            kw["pooling_convention"] = "full"
+        if op_type == "AveragePool":
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 1))
+        return sym.Pooling(ins[0], **kw)
+    return b
+
+
+def _b_simple(mx_op, **fixed):
+    def b(sym, ins, a, consts):
+        return getattr(sym, mx_op)(*ins, **fixed)
+    return b
+
+
+def _b_softmax(mx_op):
+    def b(sym, ins, a, consts):
+        return getattr(sym, mx_op)(ins[0], axis=int(a.get("axis", -1)))
+    return b
+
+
+def _b_reshape(sym, ins, a, consts):
+    shape = consts.value_of(ins[1])
+    return sym.Reshape(ins[0], shape=tuple(int(v) for v in shape))
+
+
+def _b_transpose(sym, ins, a, consts):
+    return sym.transpose(ins[0], axes=tuple(a.get("perm") or ()))
+
+
+def _b_concat(sym, ins, a, consts):
+    return sym.Concat(*ins, dim=int(a.get("axis", 1)))
+
+
+def _b_clip(sym, ins, a, consts):
+    lo = float(consts.value_of(ins[1])) if len(ins) > 1 else float(a["min"])
+    hi = float(consts.value_of(ins[2])) if len(ins) > 2 else float(a["max"])
+    return sym.clip(ins[0], a_min=lo, a_max=hi)
+
+
+def _b_pad(sym, ins, a, consts):
+    pads = [int(v) for v in consts.value_of(ins[1])]
+    n = len(pads) // 2
+    pw = []
+    for i in range(n):
+        pw += [pads[i], pads[n + i]]
+    return sym.pad(ins[0], mode=a.get("mode", "constant"),
+                   pad_width=tuple(pw))
+
+
+def _b_dropout(sym, ins, a, consts):
+    return sym.Dropout(ins[0], p=float(a.get("ratio", 0.5)))
+
+
+def _b_lrn(sym, ins, a, consts):
+    return sym.LRN(ins[0], alpha=float(a.get("alpha", 1e-4)),
+                   beta=float(a.get("beta", 0.75)),
+                   knorm=float(a.get("bias", 2.0)),
+                   nsize=int(a["size"]))
+
+
+def _b_gather(sym, ins, a, consts):
+    # exporter form: Gather(weight, Cast(idx)) == Embedding
+    w_shape = consts.shape_of(ins[0])
+    return sym.Embedding(ins[1], ins[0], input_dim=int(w_shape[0]),
+                         output_dim=int(w_shape[1]))
+
+
+def _b_cast(sym, ins, a, consts):
+    to = int(a.get("to", ONNX_FLOAT))
+    return sym.Cast(ins[0],
+                    dtype="int64" if to == ONNX_INT64 else "float32")
+
+
+def _b_split(sym, ins, a, consts):
+    nout = len(a["__outputs__"])
+    return sym.SliceChannel(ins[0], num_outputs=nout,
+                            axis=int(a.get("axis", 1)))
+
+
+def _b_reduce(mx_op):
+    def b(sym, ins, a, consts):
+        axes = a.get("axes")
+        kw = {"keepdims": bool(a.get("keepdims", 1))}
+        if axes is not None:
+            kw["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
+        return getattr(sym, mx_op)(ins[0], **kw)
+    return b
+
+
+def _b_s2d(mx_op):
+    def b(sym, ins, a, consts):
+        return getattr(sym, mx_op)(ins[0], block_size=int(a["blocksize"]))
+    return b
+
+
+def _b_leaky(sym, ins, a, consts):
+    return sym.LeakyReLU(ins[0], act_type="leaky",
+                         slope=float(a.get("alpha", 0.01)))
+
+
+def _b_elu(sym, ins, a, consts):
+    return sym.LeakyReLU(ins[0], act_type="elu",
+                         slope=float(a.get("alpha", 1.0)))
+
+
+BUILDERS = {
+    "Conv": _b_conv,
+    "ConvTranspose": _b_deconv,
+    "Gemm": _b_gemm,
+    "BatchNormalization": _b_bn,
+    "MaxPool": _b_pool("MaxPool"),
+    "AveragePool": _b_pool("AveragePool"),
+    "GlobalMaxPool": _b_pool("GlobalMaxPool"),
+    "GlobalAveragePool": _b_pool("GlobalAveragePool"),
+    "Relu": _b_simple("relu"),
+    "Sigmoid": _b_simple("sigmoid"),
+    "Tanh": _b_simple("tanh"),
+    "Softplus": lambda sym, ins, a, c: sym.Activation(ins[0], act_type="softrelu"),
+    "Softsign": _b_simple("softsign"),
+    "LeakyRelu": _b_leaky,
+    "Elu": _b_elu,
+    "Selu": lambda sym, ins, a, c: sym.LeakyReLU(ins[0], act_type="selu"),
+    "PRelu": lambda sym, ins, a, c: sym.LeakyReLU(ins[0], ins[1], act_type="prelu"),
+    "Softmax": _b_softmax("softmax"),
+    "LogSoftmax": _b_softmax("log_softmax"),
+    "Flatten": _b_simple("Flatten"),
+    "Reshape": _b_reshape,
+    "Transpose": _b_transpose,
+    "Concat": _b_concat,
+    "Add": _b_simple("broadcast_add"),
+    "Sub": _b_simple("broadcast_sub"),
+    "Mul": _b_simple("broadcast_mul"),
+    "Div": _b_simple("broadcast_div"),
+    "Sum": _b_simple("add_n"),
+    "MatMul": _b_simple("dot"),
+    "Dropout": _b_dropout,
+    "LRN": _b_lrn,
+    "Gather": _b_gather,
+    "Cast": _b_cast,
+    "Identity": _b_simple("identity"),
+    "SpaceToDepth": _b_s2d("space_to_depth"),
+    "DepthToSpace": _b_s2d("depth_to_space"),
+    "Split": _b_split,
+    "ReduceSum": _b_reduce("sum"),
+    "ReduceMean": _b_reduce("mean"),
+    "ReduceMax": _b_reduce("max"),
+    "ReduceMin": _b_reduce("min"),
+    "Clip": _b_clip,
+    "Pad": _b_pad,
+    "Exp": _b_simple("exp"),
+    "Log": _b_simple("log"),
+    "Sqrt": _b_simple("sqrt"),
+    "Abs": _b_simple("abs"),
+    "Neg": _b_simple("negative"),
+}
+
+
+def build_symbol(model):
+    """Parsed model dict -> (Symbol, arg_params, aux_params)."""
+    import mxnet_tpu.symbol as S
+    import mxnet_tpu.ndarray as nd
+
+    inits = model["initializers"]
+    values = {}          # ONNX value name -> Symbol
+    consumed_consts = set()
+
+    for name in model["inputs"]:
+        if name not in inits:
+            values[name] = S.Variable(name)
+    for name in inits:
+        values[name] = S.Variable(name)
+
+    class _C:
+        """Constant lookup by Symbol (mapped back to its value name)."""
+
+        def __init__(self):
+            self._sym_names = {id(s): n for n, s in values.items()}
+
+        def value_of(self, x):
+            name = self._sym_names.get(id(x), x)
+            return inits[name]
+
+        def shape_of(self, x):
+            return self.value_of(x).shape
+
+    for node in model["nodes"]:
+        b = BUILDERS.get(node["op"])
+        if b is None:
+            raise ValueError(f"ONNX import: unsupported op {node['op']}")
+        ins = []
+        for i in node["inputs"]:
+            v = values.get(i)
+            if v is None:
+                raise ValueError(f"ONNX import: undefined input '{i}'")
+            ins.append(v)
+        attrs = dict(node["attrs"])
+        attrs["__outputs__"] = node["outputs"]
+        out = b(S, ins, attrs, _C())
+        if node["op"] == "Split":
+            outs = [out[i] for i in range(len(node["outputs"]))]
+        else:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+        for oname, osym in zip(node["outputs"], outs):
+            values[oname] = osym
+        # constants consumed structurally (Reshape shape, Clip bounds, pads)
+        if node["op"] in ("Reshape", "Clip", "Pad"):
+            for i in node["inputs"][1:]:
+                consumed_consts.add(i)
+
+    out_syms = [values[o] for o in model["outputs"]]
+    out = out_syms[0] if len(out_syms) == 1 else S.Group(out_syms)
+
+    arg_names = set(out.list_arguments())
+    aux_names = set(out.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name, arr in inits.items():
+        if name in consumed_consts:
+            continue
+        target = aux_params if (name in aux_names or
+                                "moving_" in name or "running_" in name) \
+            else arg_params
+        if name in arg_names or name in aux_names:
+            target[name] = nd.array(np.asarray(arr, np.float32))
+    return out, arg_params, aux_params

@@ -1,0 +1,129 @@
+"""Self-contained protobuf wire codec for the ONNX schema subset.
+
+The environment ships no ``onnx`` package (and none is needed at runtime on
+TPU), so serialization is done directly against the protobuf wire format
+(proto3). Only the message fields the exporter/importer use are modeled —
+see the ONNX spec (onnx/onnx.proto) for field numbers.
+
+Messages are represented as plain dicts; repeated fields as lists. The
+encoder/decoder pair is exercised by the round-trip tests in
+tests/test_onnx.py.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:  # proto int64: 10-byte two's complement
+        n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int):
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            # interpret as signed int64
+            if result >= 1 << 63:
+                result -= 1 << 64
+            return result, pos
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def emit_int(field: int, v: int) -> bytes:
+    return _tag(field, _VARINT) + _varint(int(v))
+
+
+def emit_float(field: int, v: float) -> bytes:
+    return _tag(field, _I32) + struct.pack("<f", float(v))
+
+
+def emit_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def emit_str(field: int, s: str) -> bytes:
+    return emit_bytes(field, s.encode("utf-8"))
+
+
+def emit_packed_ints(field: int, vals) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in vals)
+    return emit_bytes(field, payload)
+
+
+def emit_packed_floats(field: int, vals) -> bytes:
+    payload = b"".join(struct.pack("<f", float(v)) for v in vals)
+    return emit_bytes(field, payload)
+
+
+def parse_message(buf: bytes):
+    """Decode a message into {field_number: [raw values]} where varints come
+    back as ints and length-delimited fields as bytes (caller interprets
+    nested messages / strings / packed arrays)."""
+    fields: dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            v, pos = _read_varint(buf, pos)
+        elif wire == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == _I32:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == _I64:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:  # pragma: no cover - malformed input
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def parse_packed_ints(raw: bytes):
+    vals, pos = [], 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        vals.append(v)
+    return vals
+
+
+def parse_packed_floats(raw: bytes):
+    return list(struct.unpack(f"<{len(raw) // 4}f", raw))
+
+
+def first_int(fields, num, default=0):
+    v = fields.get(num)
+    return int(v[0]) if v else default
+
+
+def first_bytes(fields, num, default=b""):
+    v = fields.get(num)
+    return v[0] if v else default
+
+
+def first_str(fields, num, default=""):
+    v = fields.get(num)
+    return v[0].decode("utf-8") if v else default

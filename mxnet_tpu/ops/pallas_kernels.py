@@ -19,7 +19,7 @@ import functools
 import numpy as _np
 
 __all__ = ["flash_attention", "flash_attention_with_grad",
-           "pallas_available"]
+           "flash_attention_with_lse", "pallas_available"]
 
 _BLOCK_Q = 128
 _BLOCK_K = 128
@@ -36,11 +36,17 @@ def pallas_available():
         return False
 
 
-def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, n_kb):
+def _mha_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale, causal, n_kb):
     """Grid = (BH, n_q_blocks, n_k_blocks); the k dimension is innermost,
     so the VMEM scratch (m, l, acc) carries across K blocks of one
     (batch*head, q-block) pair and the output writes on the last step.
+
+    qoff_ref/koff_ref: scalar-prefetch global position offsets — ring
+    attention runs the kernel on (local Q, rotated K/V) block pairs whose
+    causal relation is decided by where each block sits in the GLOBAL
+    sequence, and the offsets are traced values (lax.axis_index), so they
+    arrive in SMEM rather than being baked into the compiled kernel.
 
     q_ref (1, BQ, D) / k_ref, v_ref (1, BK, D) / o_ref (1, BQ, D).
     """
@@ -62,7 +68,11 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     # under causal masking, K blocks strictly in this q block's future are
     # all-masked: skip their HBM reads and MXU work entirely (~2x on long
     # sequences)
-    live = (kb * bk <= (qi + 1) * bq - 1) if causal else (kb >= 0)
+    if causal:
+        live = (koff_ref[0] + kb * bk <=
+                qoff_ref[0] + (qi + 1) * bq - 1)
+    else:
+        live = kb >= 0
 
     @pl.when(live)
     def _compute():
@@ -72,8 +82,10 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            qpos = qoff_ref[0] + qi * bq + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = koff_ref[0] + kb * bk + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
         m_prev = m_ref[:]
         blk_max = jnp.max(s, axis=1, keepdims=True)
@@ -107,26 +119,30 @@ def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
     n_kb = t // bk
     kernel = functools.partial(_mha_kernel, scale=scale, causal=causal,
                                n_kb=n_kb)
-    return pl.pallas_call(
-        kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # q_offset, k_offset (SMEM)
         grid=(bh, t // bq, n_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, kb: (b, kb, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, kb, *_: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kb, *_: (b, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, kb, *_: (b, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, kb: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), jnp.dtype(dtype_str)),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            pl.BlockSpec((1, bq, d), lambda b, i, kb, *_: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, kb, *_: (b, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max m
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum l
             pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), jnp.dtype(dtype_str)),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -146,9 +162,13 @@ def _unwrap_nd(q, k, v, interpret):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
-                    return_lse=False):
+                    return_lse=False, q_offset=0, k_offset=0):
     """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D)
     (plus the per-row log-sum-exp when return_lse=True).
+
+    q_offset/k_offset (int or traced scalar) place the Q and K/V blocks in
+    a larger global sequence for causal masking — the ring-attention hop
+    case, where K/V blocks rotate past stationary local queries.
 
     Requirements: T divisible by the 128 block (or T <= 128), D <= 256,
     self-attention shapes. Raises ValueError otherwise — callers fall back
@@ -158,13 +178,16 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
     TPU device automatically (or run in interpret mode on CPU-only hosts),
     since a program compiled for a CPU device cannot lower the kernel.
     """
+    import jax.numpy as jnp
+
     if hasattr(q, "_data"):
         from ..ndarray.ndarray import NDArray
 
         ctx = getattr(q, "_ctx", None)
         raw, interpret = _unwrap_nd(q, k, v, interpret)
         out = flash_attention(*raw, causal=causal, scale=scale,
-                              interpret=interpret, return_lse=return_lse)
+                              interpret=interpret, return_lse=return_lse,
+                              q_offset=q_offset, k_offset=k_offset)
         if return_lse:
             return NDArray(out[0], ctx), NDArray(out[1], ctx)
         return NDArray(out, ctx)
@@ -182,7 +205,9 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
-    out, lse = fn(qf, kf, vf)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    out, lse = fn(qo, ko, qf, kf, vf)
     out = out.reshape(b, h, t, d)
     if return_lse:
         return out, lse.reshape(b, h, t, 1)
@@ -195,10 +220,13 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
 # never materialized in either direction)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k):
+def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k,
+                         dlse=None, q_offset=0, k_offset=0):
     """Standard flash-attention backward with recomputed probabilities,
     scanned over K blocks; `lse` comes from the forward kernel's scratch
-    (no recomputation sweep)."""
+    (no recomputation sweep). `dlse` carries the cotangent of the emitted
+    log-sum-exp (nonzero when the caller merges hop results by lse, as
+    ring attention does): d lse / d s = p folds in as ds += p * dlse."""
     import jax
     import jax.numpy as jnp
 
@@ -207,14 +235,16 @@ def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k):
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     o32, do32 = out.astype(jnp.float32), dout.astype(jnp.float32)
     D = jnp.sum(do32 * o32, axis=-1, keepdims=True)  # (b,h,t,1)
-    qpos = jnp.arange(t)
+    if dlse is not None:
+        D = D - dlse.astype(jnp.float32)
+    qpos = q_offset + jnp.arange(t)
 
     def body(dq, kb):
         ks = jax.lax.dynamic_slice_in_dim(k32, kb * block_k, block_k, axis=2)
         vs = jax.lax.dynamic_slice_in_dim(v32, kb * block_k, block_k, axis=2)
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, ks) * scale
         if causal:
-            kpos = kb * block_k + jnp.arange(block_k)
+            kpos = k_offset + kb * block_k + jnp.arange(block_k)
             s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG)
         p = jnp.exp(s - lse)  # (b,h,t,bk)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vs)
@@ -230,6 +260,46 @@ def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k):
     dk = jnp.moveaxis(dk_blks, 0, 2).reshape(b, h, t, d)
     dv = jnp.moveaxis(dv_blks, 0, 2).reshape(b, h, t, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             interpret=False, q_offset=0, k_offset=0):
+    """Differentiable (out, lse) pair — the ring-attention building block:
+    per-hop results merge by log-sum-exp, so the lse output needs a
+    gradient path too (folded into the blockwise backward as ds += p*dlse).
+    Offsets may be traced scalars (lax.axis_index inside shard_map);
+    custom_vjp cannot close over tracers, so they ride along as float
+    primals with zero cotangents."""
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+
+    s = scale if scale is not None else 1.0 / _np.sqrt(q.shape[-1])
+    bk = min(_BLOCK_K, q.shape[2])
+
+    @_ft.partial(jax.custom_vjp)
+    def f(q, k, v, qo, ko):
+        return flash_attention(q, k, v, causal=causal, scale=s,
+                               interpret=interpret, return_lse=True,
+                               q_offset=qo.astype(jnp.int32),
+                               k_offset=ko.astype(jnp.int32))
+
+    def f_fwd(q, k, v, qo, ko):
+        out, lse = f(q, k, v, qo, ko)
+        return (out, lse), (q, k, v, out, lse, qo, ko)
+
+    def f_bwd(res, cot):
+        q, k, v, out, lse, qo, ko = res
+        dout, dlse = cot
+        dq, dk, dv = _flash_bwd_blockwise(
+            q, k, v, out, lse, dout, s, causal, bk, dlse=dlse,
+            q_offset=qo.astype(jnp.int32), k_offset=ko.astype(jnp.int32))
+        return dq, dk, dv, jnp.zeros_like(qo), jnp.zeros_like(ko)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(q, k, v, jnp.asarray(q_offset, jnp.float32),
+             jnp.asarray(k_offset, jnp.float32))
 
 
 def flash_attention_with_grad(q, k, v, causal=False, scale=None,

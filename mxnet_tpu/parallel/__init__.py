@@ -16,9 +16,10 @@ from . import trainer
 def __getattr__(name):
     import importlib
 
-    if name in ("ring", "ring_attention"):
+    if name in ("ring", "ring_attention", "attention"):
         mod = importlib.import_module(".ring_attention", __name__)
         globals()["ring"] = mod
         globals()["ring_attention"] = mod.ring_attention
+        globals()["attention"] = mod.attention
         return globals()[name]
     raise AttributeError(name)

@@ -24,17 +24,26 @@ import functools
 
 import numpy as _np
 
-__all__ = ["ring_attention", "ring_attention_inner"]
+__all__ = ["ring_attention", "ring_attention_inner", "attention"]
 
 _NEG = -1e30
 
 
-def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None):
+def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None,
+                         impl="dense", interpret=False):
     """Per-shard ring attention body (call inside shard_map).
 
     q, k, v: (B, H, T_local, D) — this device's sequence block. Returns
     (B, H, T_local, D) attention output for the local queries over the
     GLOBAL sequence.
+
+    impl='dense' materializes the per-hop (T_local, T_local) score block;
+    impl='flash' runs each hop through the Pallas streaming kernel
+    (ops/pallas_kernels.py) with global positional offsets, dropping
+    per-device attention memory from O(T_local²) to O(T_local·BLOCK_K) —
+    the two kernels composed. Hop results merge by log-sum-exp, and the
+    kernel's custom_vjp carries the lse cotangent, so reverse-mode AD
+    through the ring works for both implementations.
     """
     import jax
     import jax.numpy as jnp
@@ -53,6 +62,32 @@ def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None):
     l0 = q32[..., :1] * 0
     o0 = q32 * 0
     qpos = my_idx * t + jnp.arange(t)
+
+    if impl == "flash":
+        from ..ops.pallas_kernels import flash_attention_with_lse
+
+        def body(i, carry):
+            m, w, o, kc, vc = carry
+            src = (my_idx - i) % axis_size
+            # per-hop streaming kernel: normalized block output + its lse
+            out_i, lse_i = flash_attention_with_lse(
+                q, kc, vc, causal=causal, scale=s_scale,
+                interpret=interpret, q_offset=my_idx * t,
+                k_offset=src * t)
+            # merge normalized hop results by log-sum-exp weight
+            lse32 = lse_i.astype(jnp.float32)
+            m_new = jnp.maximum(m, lse32)
+            corr = jnp.exp(m - m_new)
+            wi = jnp.exp(lse32 - m_new)
+            o_new = o * corr + wi * out_i.astype(jnp.float32)
+            w_new = w * corr + wi
+            perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            return m_new, w_new, o_new, kc, vc
+
+        m, w, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, k, v))
+        return (o / jnp.maximum(w, 1e-20)).astype(q.dtype)
 
     def body(i, carry):
         m, l, o, kc, vc = carry
@@ -83,27 +118,53 @@ def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None):
 
 
 @functools.lru_cache(maxsize=64)
-def _ring_fn(mesh, axis_name, causal, scale):
-    """One jitted SPMD program per (mesh, axis, causal, scale) — re-built
-    closures would defeat jax.jit's identity-keyed cache and recompile on
-    every call."""
+def _ring_fn(mesh, axis_name, causal, scale, impl, interpret):
+    """One jitted SPMD program per config — re-built closures would defeat
+    jax.jit's identity-keyed cache and recompile on every call."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)
     inner = functools.partial(ring_attention_inner, axis_name=axis_name,
-                              causal=causal, scale=scale)
+                              causal=causal, scale=scale, impl=impl,
+                              interpret=interpret)
+    # pallas_call outputs carry no varying-mesh-axes (vma) annotation, so
+    # the flash path runs with the vma type check off
     return jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec))
+                                 out_specs=spec,
+                                 check_vma=(impl != "flash")))
+
+
+def _pick_impl(impl, t_local, d, ring=True):
+    from ..ops.pallas_kernels import pallas_available, _BLOCK_Q
+
+    if impl != "auto":
+        return impl, False
+    bq = min(_BLOCK_Q, t_local)
+    shapes_ok = (t_local % bq == 0 and d <= 256)
+    if not shapes_ok:
+        return "dense", False
+    if pallas_available():
+        return "flash", False
+    # CPU hosts: Pallas interpret mode is emulation-slow; for ring hops
+    # it is still the only way past a huge per-hop dense block, but the
+    # single-device path should keep XLA's fast dense composition
+    if ring and t_local >= 4096:
+        return "flash", True
+    return "dense", False
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
-                   scale=None):
+                   scale=None, impl="auto", interpret=False):
     """Sequence-parallel attention over global arrays.
 
     q, k, v: (B, H, T, D) NDArrays or jax arrays with T divisible by the
     mesh's `axis_name` size. The sequence axis is sharded over the ring;
     output has the same global shape/sharding.
+
+    impl: 'dense' | 'flash' | 'auto'. 'flash' streams each hop through
+    the Pallas kernel (O(T_local·BLOCK_K) memory per device); 'auto'
+    picks flash on TPU when shapes allow, dense otherwise.
     """
     import jax
     import jax.numpy as jnp
@@ -124,8 +185,10 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     if t % n != 0:
         raise ValueError(f"sequence length {t} not divisible by "
                          f"{axis_name} size {n}")
+    chosen, auto_interp = _pick_impl(impl, t // n, raw[0].shape[3])
+    interpret = interpret or auto_interp
     spec = P(None, None, axis_name, None)
-    fn = _ring_fn(mesh, axis_name, causal, scale)
+    fn = _ring_fn(mesh, axis_name, causal, scale, chosen, bool(interpret))
     arrs = [jax.device_put(a, NamedSharding(mesh, spec)) for a in raw]
     out = fn(*arrs)
     if hasattr(q, "_data"):
@@ -133,3 +196,39 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
 
         return NDArray(out, getattr(q, "_ctx", None))
     return out
+
+
+def attention(q, k, v, causal=False, scale=None, mesh=None,
+              axis_name="sp", impl="auto", interpret=False):
+    """Unified attention entry: picks dense / flash / ring by shape+mesh.
+
+    - a mesh with an `axis_name` axis of size > 1 -> ring attention
+      (sequence parallel; per-hop kernel chosen by `impl`)
+    - single device, flash-compatible shape on TPU -> Pallas flash kernel
+    - otherwise -> the fused XLA dense composition
+      (ops/nn.py scaled_dot_product_attention)
+    """
+    import jax.numpy as jnp
+
+    if mesh is not None and mesh.shape.get(axis_name, 1) > 1:
+        return ring_attention(q, k, v, mesh=mesh, axis_name=axis_name,
+                              causal=causal, scale=scale, impl=impl,
+                              interpret=interpret)
+    raw_q = q._data if hasattr(q, "_data") else jnp.asarray(q)
+    b, h, t, d = raw_q.shape
+    chosen, auto_interp = _pick_impl(impl, t, d, ring=False)
+    if chosen == "flash":
+        from ..ops.pallas_kernels import flash_attention_with_grad
+
+        return flash_attention_with_grad(
+            q, k, v, causal=causal, scale=scale,
+            interpret=interpret or auto_interp)
+    if hasattr(q, "_data"):
+        from .. import ndarray as nd
+
+        return nd.scaled_dot_product_attention(q, k, v, causal=causal,
+                                               scale=scale)
+    from ..ops.nn import _sdpa
+
+    return _sdpa(raw_q, jnp.asarray(k), jnp.asarray(v), causal=causal,
+                 scale=scale)

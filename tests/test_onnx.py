@@ -1,0 +1,243 @@
+"""ONNX export/import round-trip tests.
+
+Parity: python/mxnet/contrib/onnx/ (mx2onnx + onnx2mx). The environment
+has no onnx package, so fidelity is proven by round-tripping through the
+self-contained wire codec: export a network, re-import the bytes, rebuild
+the symbol, and demand forward equivalence.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.ndarray as nd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import gluon
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+
+RNG = np.random.RandomState(3)
+
+
+def _eval_symbol(out, args, aux=None, is_train=False):
+    arg_nd = {k: nd.array(v) for k, v in args.items()}
+    aux_nd = {k: nd.array(v) for k, v in (aux or {}).items()}
+    ex = out.bind(mx.cpu(), arg_nd, aux_states=aux_nd or None)
+    return [o.asnumpy() for o in ex.forward(is_train=is_train)]
+
+
+def _roundtrip(out, params, data, tmp_path, aux=None):
+    """Export symbol+params, import back, compare forwards on `data`."""
+    path = str(tmp_path / "model.onnx")
+    all_params = {**params, **(aux or {})}
+    onnx_mxnet.export_model(out, {k: nd.array(v)
+                                  for k, v in all_params.items()},
+                            [data.shape], onnx_file_path=path)
+    assert os.path.getsize(path) > 0
+
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    ref = _eval_symbol(out, {**params, "data": data}, aux)
+    got = _eval_symbol(sym2, {**{k: v.asnumpy() for k, v in arg2.items()},
+                              "data": data},
+                       {k: v.asnumpy() for k, v in aux2.items()})
+    assert len(ref) == len(got)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-5)
+    return sym2
+
+
+def test_proto_roundtrip_primitives():
+    from mxnet_tpu.contrib.onnx import proto as P
+
+    msg = (P.emit_int(1, 6) + P.emit_str(2, "hello") +
+           P.emit_float(3, 2.5) + P.emit_packed_ints(4, [1, -2, 300]))
+    f = P.parse_message(msg)
+    assert P.first_int(f, 1) == 6
+    assert P.first_str(f, 2) == "hello"
+    assert abs(f[3][0] - 2.5) < 1e-6
+    assert P.parse_packed_ints(f[4][0]) == [1, -2, 300]
+
+
+def test_mlp_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=4, name="fc2")
+    out = sym.softmax(h, axis=-1)
+    params = {"fc1_weight": RNG.rand(8, 5).astype(np.float32),
+              "fc1_bias": RNG.rand(8).astype(np.float32),
+              "fc2_weight": RNG.rand(4, 8).astype(np.float32),
+              "fc2_bias": RNG.rand(4).astype(np.float32)}
+    x = RNG.rand(2, 5).astype(np.float32)
+    _roundtrip(out, params, x, tmp_path)
+
+
+def test_softmax_output_exports_as_softmax(tmp_path):
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    h = sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = sym.SoftmaxOutput(h, label, name="sm")
+    params = {"fc_weight": RNG.rand(4, 5).astype(np.float32),
+              "fc_bias": RNG.rand(4).astype(np.float32)}
+    path = str(tmp_path / "sm.onnx")
+    x = RNG.rand(2, 5).astype(np.float32)
+    onnx_mxnet.export_model(out, {k: nd.array(v) for k, v in params.items()},
+                            [(2, 5), (2,)], onnx_file_path=path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    ref = _eval_symbol(out, {**params, "data": x,
+                             "label": np.zeros(2, np.float32)})
+    got = _eval_symbol(sym2, {**{k: v.asnumpy() for k, v in arg2.items()},
+                              "data": x})
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-4, atol=1e-5)
+
+
+def test_convnet_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    h = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                        name="c1")
+    h = sym.BatchNorm(h, fix_gamma=False, name="bn1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = sym.Flatten(h)
+    out = sym.FullyConnected(h, num_hidden=3, name="fc")
+    params = {"c1_weight": RNG.rand(4, 2, 3, 3).astype(np.float32) * 0.3,
+              "c1_bias": RNG.rand(4).astype(np.float32),
+              "bn1_gamma": RNG.rand(4).astype(np.float32) + 0.5,
+              "bn1_beta": RNG.rand(4).astype(np.float32),
+              "fc_weight": RNG.rand(3, 64).astype(np.float32) * 0.2,
+              "fc_bias": RNG.rand(3).astype(np.float32)}
+    aux = {"bn1_moving_mean": RNG.rand(4).astype(np.float32) * 0.1,
+           "bn1_moving_var": RNG.rand(4).astype(np.float32) + 0.8}
+    x = RNG.rand(2, 2, 8, 8).astype(np.float32)
+    _roundtrip(out, params, x, tmp_path, aux=aux)
+
+
+def test_resnet18_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(RNG.rand(1, 3, 32, 32).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    # gluon -> symbol + params (the reference's export path)
+    data = sym.Variable("data")
+    out = net(data)
+    params, aux = {}, {}
+    for name, p in net.collect_params().items():
+        (aux if "running" in name or "moving" in name
+         else params)[name] = p.data().asnumpy()
+
+    path = str(tmp_path / "resnet18.onnx")
+    onnx_mxnet.export_model(
+        out, {k: nd.array(v) for k, v in {**params, **aux}.items()},
+        [(1, 3, 32, 32)], onnx_file_path=path)
+
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    got = _eval_symbol(
+        sym2, {**{k: v.asnumpy() for k, v in arg2.items()},
+               "data": x.asnumpy()},
+        {k: v.asnumpy() for k, v in aux2.items()})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_various_ops_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    h = sym.space_to_depth(data, block_size=2)
+    h = sym.transpose(h, axes=(0, 2, 3, 1))
+    h = sym.Reshape(h, shape=(2, -1))
+    h = sym.clip(h, a_min=-0.8, a_max=0.8)
+    h = h * 2.0 + 0.5
+    out = sym.log_softmax(h)
+    x = RNG.rand(2, 4, 4, 4).astype(np.float32)
+    _roundtrip(out, {}, x, tmp_path)
+
+
+def test_concat_split_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1)
+    out = sym.Concat(parts[0] * 2.0, parts[1], dim=1)
+    x = RNG.rand(2, 4, 3).astype(np.float32)
+    _roundtrip(out, {}, x, tmp_path)
+
+
+def test_embedding_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    out = sym.Embedding(data, input_dim=6, output_dim=3, name="emb")
+    params = {"emb_weight": RNG.rand(6, 3).astype(np.float32)}
+    x = np.array([[0, 2, 5]], np.float32)
+    _roundtrip(out, params, x, tmp_path)
+
+
+def test_metadata(tmp_path):
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=2, name="fc")
+    path = str(tmp_path / "m.onnx")
+    onnx_mxnet.export_model(
+        out, {"fc_weight": nd.array(RNG.rand(2, 3).astype(np.float32)),
+              "fc_bias": nd.array(RNG.rand(2).astype(np.float32))},
+        [(1, 3)], onnx_file_path=path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == ["data"]
+    assert meta["producer"] == "mxnet_tpu"
+    assert meta["opset"] == 11
+
+
+def test_unsupported_op_raises(tmp_path):
+    out = sym.contrib.ROIAlign(sym.Variable("data"), sym.Variable("rois"),
+                               pooled_size=(2, 2), spatial_scale=1.0)
+    with pytest.raises(ValueError, match="no translator"):
+        onnx_mxnet.export_model(out, {}, [(1, 1, 4, 4), (1, 5)],
+                                onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+@pytest.mark.parametrize("ctor", ["squeezenet1_0", "mobilenet_v1_025",
+                                  "alexnet"])
+def test_model_zoo_roundtrip(ctor, tmp_path):
+    """Model-zoo export→import forward equivalence (224² input)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    fn = {"squeezenet1_0": getattr(vision, "squeezenet1_0", None),
+          "mobilenet_v1_025": getattr(vision, "mobilenet0_25", None),
+          "alexnet": getattr(vision, "alexnet", None)}[ctor]
+    if fn is None:
+        pytest.skip(f"{ctor} not in zoo")
+    net = fn(classes=10)
+    net.initialize(mx.initializer.Xavier())
+    x = mx.nd.array(RNG.rand(1, 3, 224, 224).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    data = sym.Variable("data")
+    out = net(data)
+    allp = {k: p.data() for k, p in net.collect_params().items()}
+    path = str(tmp_path / f"{ctor}.onnx")
+    onnx_mxnet.export_model(out, allp, [(1, 3, 224, 224)],
+                            onnx_file_path=path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    got = _eval_symbol(
+        sym2, {**{k: v.asnumpy() for k, v in arg2.items()},
+               "data": x.asnumpy()},
+        {k: v.asnumpy() for k, v in aux2.items()})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_import_accepts_packed_repeated_fields():
+    """proto3 serializers (the real onnx package, PyTorch exporters) pack
+    repeated numeric fields into one LEN blob; the importer must accept
+    both packed and unpacked encodings."""
+    from mxnet_tpu.contrib.onnx import proto as P
+    from mxnet_tpu.contrib.onnx.import_onnx import (_parse_attr,
+                                                    _parse_tensor)
+
+    # packed ints attribute (kernel_shape=[3, 3], type INTS=7)
+    attr = (P.emit_str(1, "kernel_shape") + P.emit_packed_ints(8, [3, 3])
+            + P.emit_int(20, 7))
+    name, val = _parse_attr(attr)
+    assert (name, val) == ("kernel_shape", [3, 3])
+
+    # packed dims tensor
+    t = (P.emit_packed_ints(1, [2, 3]) + P.emit_int(2, 1)
+         + P.emit_str(8, "w")
+         + P.emit_bytes(9, np.arange(6, dtype=np.float32).tobytes()))
+    tname, arr = _parse_tensor(t)
+    assert tname == "w" and arr.shape == (2, 3)
