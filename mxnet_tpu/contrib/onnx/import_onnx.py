@@ -60,12 +60,13 @@ def _parse_attr(raw):
     f = P.parse_message(raw)
     name = P.first_str(f, 1)
     atype = P.first_int(f, 20)
+    # proto3 omits zero-valued scalars from the wire: default them
     if atype == _AF:
-        return name, float(f[2][0])
+        return name, float(f.get(2, [0.0])[0])
     if atype == _AI:
-        return name, int(f[3][0])
+        return name, int(f.get(3, [0])[0])
     if atype == _AS:
-        return name, f[4][0].decode()
+        return name, f.get(4, [b""])[0].decode()
     if atype == _AFS:
         return name, _floats(f.get(7, []))
     if atype == _AIS:
@@ -132,6 +133,9 @@ def _b_deconv(sym, ins, a, consts):
     kernel = tuple(a["kernel_shape"])
     nd = len(kernel)
     pads = a.get("pads") or [0] * (2 * nd)
+    if pads[:nd] != pads[nd:]:
+        raise ValueError(
+            f"asymmetric ConvTranspose pads {pads} not supported on import")
     g = int(a.get("group", 1))
     nf = int(consts.shape_of(ins[1])[1]) * g
     return sym.Deconvolution(*ins, kernel=kernel,
@@ -166,6 +170,9 @@ def _b_pool(op_type):
         kernel = tuple(a["kernel_shape"])
         nd = len(kernel)
         pads = a.get("pads") or [0] * (2 * nd)
+        if pads[:nd] != pads[nd:]:
+            raise ValueError(
+                f"asymmetric pooling pads {pads} not supported on import")
         kw = dict(kernel=kernel,
                   stride=tuple(a.get("strides") or (1,) * nd),
                   pad=tuple(pads[:nd]),
@@ -343,10 +350,11 @@ def build_symbol(model):
         values[name] = S.Variable(name)
 
     class _C:
-        """Constant lookup by Symbol (mapped back to its value name)."""
+        """Constant lookup by Symbol: only initializer variables can be
+        constants, and those are all created above — index them once."""
 
         def __init__(self):
-            self._sym_names = {id(s): n for n, s in values.items()}
+            self._sym_names = {id(values[n]): n for n in inits}
 
         def value_of(self, x):
             name = self._sym_names.get(id(x), x)
@@ -355,6 +363,7 @@ def build_symbol(model):
         def shape_of(self, x):
             return self.value_of(x).shape
 
+    consts_lookup = _C()
     for node in model["nodes"]:
         b = BUILDERS.get(node["op"])
         if b is None:
@@ -367,7 +376,7 @@ def build_symbol(model):
             ins.append(v)
         attrs = dict(node["attrs"])
         attrs["__outputs__"] = node["outputs"]
-        out = b(S, ins, attrs, _C())
+        out = b(S, ins, attrs, consts_lookup)
         if node["op"] == "Split":
             outs = [out[i] for i in range(len(node["outputs"]))]
         else:

@@ -100,14 +100,22 @@ class _WorkerRing:
         self._fns = {}
 
     def allreduce(self, arr):
-        """Sum `arr` (host numpy, same shape on every worker) across all
-        workers; returns host numpy."""
+        """Sum `arr` (same shape on every worker) across all workers.
+
+        Accepts host numpy (returns numpy) or a local device array
+        (returns the replicated result's local device buffer — the
+        gradient never round-trips through the host, so on a pod the
+        reduction rides ICI end-to-end; the numpy path exists for
+        host-resident values like the barrier's token)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        arr = _np.ascontiguousarray(arr)
-        key = (arr.shape, arr.dtype.str)
+        device_in = isinstance(arr, jax.Array)
+        if not device_in:
+            arr = _np.ascontiguousarray(arr)
+        shape = tuple(arr.shape)
+        key = (shape, _np.dtype(arr.dtype).str)
         if key not in self._fns:
             sharding = NamedSharding(self.mesh, P("worker"))
             out_sharding = NamedSharding(self.mesh, P())
@@ -115,10 +123,14 @@ class _WorkerRing:
                          out_shardings=out_sharding)
             self._fns[key] = (fn, sharding)
         fn, sharding = self._fns[key]
-        local = jax.device_put(arr[None], self._local)
+        local = jax.device_put(
+            arr.reshape((1,) + shape), self._local)
         global_arr = jax.make_array_from_single_device_arrays(
-            (self.n,) + arr.shape, sharding, [local])
-        return _np.asarray(fn(global_arr))
+            (self.n,) + shape, sharding, [local])
+        out = fn(global_arr)
+        if device_in:
+            return out.addressable_shards[0].data
+        return _np.asarray(out)
 
 
 class KVStoreDist(KVStoreTPU):
@@ -155,10 +167,14 @@ class KVStoreDist(KVStoreTPU):
                 self._data[k] = _from_np(synced, v)
 
     def _global_merge(self, merged):
-        """Cross-worker allreduce inserted into the base push path."""
+        """Cross-worker allreduce inserted into the base push path —
+        device-resident: the NDArray's jax buffer goes straight into the
+        collective and the result wraps back without touching the host."""
         if self.num_workers > 1:
-            summed = self._get_ring().allreduce(merged.asnumpy())
-            merged = _from_np(summed, merged)
+            from ..ndarray.ndarray import NDArray
+
+            summed = self._get_ring().allreduce(merged.data_)
+            merged = NDArray(summed, getattr(merged, "_ctx", None))
         return merged
 
     def barrier(self):

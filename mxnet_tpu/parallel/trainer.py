@@ -80,14 +80,26 @@ class ShardedTrainer:
         repl = NamedSharding(self.mesh, P())
         self._aux_sharding = {k: repl for k in self.aux}
         self._batch_sharding = NamedSharding(self.mesh, P(batch_axis_name))
+        self._multiproc = self._is_multiprocess()
         self._place()
         self._step = None
 
     def _place(self):
+        import numpy as np
+
         import jax
         import jax.numpy as jnp
 
+        multiproc = self._multiproc
+
         def put(v, sharding):
+            if multiproc:
+                # every process holds the full host value; build each local
+                # shard from it directly — device_put would attempt a
+                # cross-host transfer
+                arr = np.asarray(v)
+                return jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx: arr[idx])
             # device_put may alias the input buffer when placement already
             # matches; always copy so step donation never deletes a buffer
             # the net (or another trainer) still references. Init-only cost.
@@ -161,8 +173,46 @@ class ShardedTrainer:
             out_shardings=out_shardings,
             donate_argnums=(0, 1, 2))
 
+    @classmethod
+    def for_multihost(cls, net, loss_fn, optimizer="sgd",
+                      optimizer_params=None, axes=None, coordinator=None,
+                      num_processes=None, process_id=None, **kwargs):
+        """Build a trainer over a GLOBAL mesh spanning every process of a
+        multi-host job (the pod entry point: jax.distributed bootstrap +
+        all-devices mesh — the TPU-native replacement for the reference's
+        dist_sync worker group).
+
+        Bootstraps jax.distributed from args or the DMLC_* env protocol
+        (kvstore/dist.py) if not already initialized. `axes` is the mesh
+        axes dict (default: pure data parallel over all global devices).
+        In `step`, each process feeds its LOCAL batch shard (numpy) —
+        shards are assembled into the global batch along the dp axis.
+        """
+        from ..kvstore.dist import init_distributed
+
+        init_distributed(coordinator, num_processes, process_id)
+        import jax
+
+        devs = jax.devices()
+        axes = dict(axes or {"dp": len(devs)})
+        mesh = create_mesh(axes, devs)
+        return cls(net, loss_fn, optimizer, optimizer_params, mesh=mesh,
+                   **kwargs)
+
+    def _is_multiprocess(self):
+        import jax
+
+        return any(d.process_index != jax.process_index()
+                   for d in self.mesh.devices.flat)
+
     def step(self, x, y):
-        """Run one sharded training step; returns the scalar loss."""
+        """Run one sharded training step; returns the scalar loss.
+
+        On a multi-process mesh, `x`/`y` are this process's LOCAL shard of
+        the global batch (assembled with
+        jax.make_array_from_process_local_data); single-process meshes
+        take the full batch.
+        """
         import jax
 
         from ..ndarray.ndarray import NDArray
@@ -173,8 +223,23 @@ class ShardedTrainer:
             x = x.data_
         if isinstance(y, NDArray):
             y = y.data_
-        x = jax.device_put(x, self._batch_sharding)
-        y = jax.device_put(y, self._batch_sharding)
+        if self._multiproc:
+            import numpy as np
+
+            def assemble(a):
+                # a single-device local array (NDArray.data_) is still a
+                # process-local shard: pull to host and assemble globally
+                if isinstance(a, jax.Array) and \
+                        a.sharding.num_devices > 1:
+                    return a  # already a global array
+                return jax.make_array_from_process_local_data(
+                    self._batch_sharding, np.asarray(a))
+
+            x = assemble(x)
+            y = assemble(y)
+        else:
+            x = jax.device_put(x, self._batch_sharding)
+            y = jax.device_put(y, self._batch_sharding)
         self.params, self.aux, self.opt_state, loss = self._step(
             self.params, self.aux, self.opt_state, x, y)
         return loss
@@ -188,8 +253,19 @@ class ShardedTrainer:
         from .. import random as _random
 
         dev = self.mesh.devices.flat[0]
+        multiproc = self._multiproc
 
         def fetch(v):
+            if multiproc:
+                # replicated values: the local shard IS the full array;
+                # cross-process-sharded params would need an allgather
+                shard = v.addressable_shards[0]
+                if shard.data.shape != v.shape:
+                    raise NotImplementedError(
+                        "sync_to_net on a multi-host mesh supports "
+                        "replicated params only; allgather sharded params "
+                        "explicitly")
+                return jax.device_put(shard.data, jax.local_devices()[0])
             return jax.device_put(v, dev)
 
         for name, p in self.net.collect_params().items():
