@@ -35,6 +35,8 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.002)
     ap.add_argument("--use-resnet", action="store_true")
+    ap.add_argument("--data", default=None,
+                    help="CIFAR-10 batches dir (default: synthetic fallback)")
     ap.add_argument("--out-dir", default="output",
                     help="checkpoint/export directory (gitignored)")
     args = ap.parse_args()
@@ -42,10 +44,14 @@ def main():
 
     transform = gluon.data.vision.transforms.Compose([
         gluon.data.vision.transforms.ToTensor()])
-    train_ds = gluon.data.vision.CIFAR10(train=True).transform_first(
-        transform)
+    ds_kw = {"root": args.data} if args.data else {}
+    train_ds = gluon.data.vision.CIFAR10(train=True, **ds_kw) \
+        .transform_first(transform)
+    val_ds = gluon.data.vision.CIFAR10(train=False, **ds_kw) \
+        .transform_first(transform)
     loader = gluon.data.DataLoader(train_ds, batch_size=args.batch_size,
                                    shuffle=True)
+    val_loader = gluon.data.DataLoader(val_ds, batch_size=args.batch_size)
 
     net = build_net(args.use_resnet)
     net.initialize(mx.initializer.Xavier())
@@ -65,6 +71,10 @@ def main():
             trainer.step(x.shape[0])
             metric.update([y], [out])
         print(f"epoch {epoch}: train {metric.get()}")
+    val_metric = mx.metric.Accuracy()
+    for x, y in val_loader:
+        val_metric.update([y], [net(x)])
+    print(f"final validation: {val_metric.get()}")
     net.export(os.path.join(args.out_dir, "cifar10_model"))
     print(f"exported to {args.out_dir}/cifar10_model-*.params/.json")
 

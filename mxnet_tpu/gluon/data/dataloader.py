@@ -1,14 +1,18 @@
 """Gluon DataLoader.
 
-Parity: python/mxnet/gluon/data/dataloader.py:533. TPU redesign: workers are
-threads feeding a host-side prefetch queue of numpy batches (JPEG decode and
-augmentation release the GIL via numpy/PIL), and the final device_put
-overlaps with TPU compute — the reference's fork-based multiprocess pool +
-shared-memory NDArray pickling (dataloader.py:134-156) existed to dodge the
-Python GIL for CPU-bound OpenCV augmentation and to share buffers with the
-engine process; with PJRT the host→HBM copy is already async so thread
-workers + pinned-free numpy staging deliver the same overlap with far less
-machinery. num_workers>0 therefore maps to a thread pool.
+Parity: python/mxnet/gluon/data/dataloader.py:533. Two worker modes:
+
+- ``thread_pool=True``: threads feeding a host-side prefetch queue (JPEG
+  decode and numpy augmentation release the GIL), final device_put overlaps
+  with TPU compute.
+- ``thread_pool=False`` (default, reference semantics): fork-based worker
+  PROCESSES with shared-memory batch transport — the counterpart of the
+  reference's multiprocess pool + shm NDArray pickling
+  (dataloader.py:134-156). Pure-Python Dataset transforms that hold the
+  GIL scale across cores this way. Workers run host-side numpy only
+  (never the jax/TPU client — a forked PJRT client is unusable), so in
+  process mode samples/batches must be numpy; device conversion happens
+  in the parent.
 """
 from __future__ import annotations
 
@@ -20,7 +24,7 @@ import numpy as np
 from ... import ndarray as nd
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -33,6 +37,94 @@ def default_batchify_fn(data):
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
     return nd.array(arr)
+
+
+def numpy_batchify_fn(data):
+    """Stack samples into numpy batches — the worker-process form of
+    default_batchify_fn (no device arrays in forked children)."""
+    if isinstance(data[0], tuple):
+        return tuple(numpy_batchify_fn(list(i)) for i in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _to_device(batch):
+    if isinstance(batch, tuple):
+        return tuple(_to_device(b) for b in batch)
+    return nd.array(batch)
+
+
+def _shm_export(batch, shms):
+    """Copy a numpy batch (array or tuple tree) into SharedMemory blocks;
+    returns a picklable descriptor. The reference pickles NDArrays through
+    shared memory the same way (dataloader.py:134-156)."""
+    from multiprocessing import shared_memory
+
+    if isinstance(batch, tuple):
+        return ("tuple", [_shm_export(b, shms) for b in batch])
+    arr = np.ascontiguousarray(batch)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    shms.append(shm)
+    view = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+    view[...] = arr
+    return ("array", shm.name, arr.shape, str(arr.dtype))
+
+
+def _shm_import(desc):
+    """Materialize a descriptor into numpy copies and release the blocks."""
+    from multiprocessing import shared_memory
+
+    if desc[0] == "tuple":
+        return tuple(_shm_import(d) for d in desc[1])
+    _, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        out = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        shm.unlink()
+    return out
+
+
+def _shm_discard(desc):
+    """Unlink an un-consumed descriptor's blocks (abandoned iterator)."""
+    from multiprocessing import shared_memory
+
+    if desc[0] == "tuple":
+        for d in desc[1]:
+            _shm_discard(d)
+        return
+    try:
+        shm = shared_memory.SharedMemory(name=desc[1])
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _mp_worker(dataset, batchify_fn, job_q, result_q):
+    """Worker-process loop: fetch index lists, batchify with numpy, ship
+    through shared memory. Runs no jax."""
+    while True:
+        job = job_q.get()
+        if job is None:
+            return
+        j, batch_idx = job
+        try:
+            out = batchify_fn([dataset[i] for i in batch_idx])
+            shms = []
+            desc = _shm_export(out, shms)
+            result_q.put((j, "ok", desc))
+            for shm in shms:
+                shm.close()
+        except BaseException as e:  # noqa: BLE001 - propagate to parent
+            import traceback
+
+            result_q.put((j, "error",
+                          f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc()}"))
 
 
 class DataLoader:
@@ -63,8 +155,13 @@ class DataLoader:
                 "batch_size, shuffle, sampler and last_batch must not be "
                 "specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._thread_pool = thread_pool
         self._num_workers = max(0, num_workers)
+        self._mp = self._num_workers > 0 and not thread_pool
+        if batchify_fn is None:
+            batchify_fn = numpy_batchify_fn if self._mp \
+                else default_batchify_fn
+        self._batchify_fn = batchify_fn
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
 
@@ -76,7 +173,105 @@ class DataLoader:
             for batch_idx in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch_idx])
             return
-        yield from self._threaded_iter()
+        if self._mp and self._fork_safe():
+            yield from self._mp_iter()
+        else:
+            yield from self._threaded_iter()
+
+    def _fork_safe(self):
+        """Process workers must never touch the jax client a fork
+        inherited — datasets yielding device NDArrays run on the thread
+        pool instead (probe one sample once)."""
+        if not hasattr(self, "_fork_ok"):
+            def any_nd(x):
+                if isinstance(x, nd.NDArray):
+                    return True
+                if isinstance(x, (tuple, list)):
+                    return any(any_nd(i) for i in x)
+                return False
+
+            self._fork_ok = len(self._dataset) == 0 or \
+                not any_nd(self._dataset[0])
+            if not self._fork_ok:
+                import warnings
+
+                warnings.warn(
+                    "DataLoader: dataset yields device NDArrays, which "
+                    "cannot cross a fork — falling back to thread workers. "
+                    "Return numpy from the Dataset (or pass "
+                    "thread_pool=True) to silence this.")
+                if self._batchify_fn is numpy_batchify_fn:
+                    self._batchify_fn = default_batchify_fn
+        return self._fork_ok
+
+    def _mp_iter(self):
+        """Fork worker processes; batches return via shared memory and are
+        converted to device arrays in the parent (reference multiprocess
+        DataLoader semantics, dataloader.py:533)."""
+        import multiprocessing as mp
+        import time as _time
+
+        ctx = mp.get_context("fork")
+        job_q = ctx.Queue()
+        result_q = ctx.Queue()
+        workers = [ctx.Process(target=_mp_worker,
+                               args=(self._dataset, self._batchify_fn,
+                                     job_q, result_q), daemon=True)
+                   for _ in range(self._num_workers)]
+        for w in workers:
+            w.start()
+        batches = list(self._batch_sampler)
+        pending: dict[int, object] = {}
+        try:
+            depth = min(len(batches),
+                        self._prefetch or 2 * self._num_workers)
+            submitted = 0
+            for submitted in range(depth):
+                job_q.put((submitted, batches[submitted]))
+            submitted = depth
+            for j in range(len(batches)):
+                deadline = _time.monotonic() + self._timeout
+                while j not in pending:
+                    try:
+                        got_j, status, payload = result_q.get(timeout=1.0)
+                    except queue.Empty:
+                        if not any(w.is_alive() for w in workers):
+                            raise RuntimeError(
+                                "DataLoader worker processes died "
+                                "(killed/segfault?) before delivering "
+                                f"batch {j}")
+                        if _time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self._timeout}s waiting for batch {j}")
+                        continue
+                    if status == "error":
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {got_j}: "
+                            f"{payload}")
+                    pending[got_j] = payload
+                if submitted < len(batches):
+                    job_q.put((submitted, batches[submitted]))
+                    submitted += 1
+                yield _to_device(_shm_import(pending.pop(j)))
+        finally:
+            for _ in workers:
+                job_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+            # reclaim shared memory of batches never consumed (abandoned
+            # iterator / error path): drain the queue, then pending
+            while True:
+                try:
+                    _, status, payload = result_q.get(timeout=0.2)
+                except (queue.Empty, OSError):
+                    break
+                if status == "ok":
+                    _shm_discard(payload)
+            for desc in pending.values():
+                _shm_discard(desc)
 
     def _threaded_iter(self):
         """Ordered prefetch over a thread pool (see module docstring)."""

@@ -63,7 +63,9 @@ class ShardedTrainer:
         self.params = param_arrays(net)
         self.aux = aux_arrays(net)
         self._compute_dtype = dtype
-        init, update = make_update_fn(optimizer, optimizer_params)
+        self._optimizer = optimizer
+        self._optimizer_params = dict(optimizer_params or {})
+        init, update = make_update_fn(optimizer, dict(self._optimizer_params))
         self.opt_state = init(self.params)
         self._update = update
         self._rules = [(re.compile(pat), spec) for pat, spec in param_rules]
@@ -198,6 +200,21 @@ class ShardedTrainer:
         mesh = create_mesh(axes, devs)
         return cls(net, loss_fn, optimizer, optimizer_params, mesh=mesh,
                    **kwargs)
+
+    def set_learning_rate(self, lr):
+        """Change the learning rate (gluon Trainer.set_learning_rate
+        parity). Hyperparameters are baked into the compiled step, so the
+        next step() recompiles — schedule changes at epoch boundaries, not
+        per step (use a lr_scheduler-style optimizer for per-step decay)."""
+        self._optimizer_params["learning_rate"] = float(lr)
+        _, update = make_update_fn(self._optimizer,
+                                   dict(self._optimizer_params))
+        self._update = update
+        self._step = None  # rebuild (and recompile) with the new rate
+
+    @property
+    def learning_rate(self):
+        return self._optimizer_params.get("learning_rate")
 
     def _is_multiprocess(self):
         import jax

@@ -90,3 +90,79 @@ def test_batch_sampler():
     s = data.BatchSampler(data.SequentialSampler(10), 3, "keep")
     assert list(s) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
     assert len(s) == 4
+
+
+# ------------------------------------------------------------------
+# round 4: multiprocess (fork + shared memory) DataLoader
+# ------------------------------------------------------------------
+
+class _SquareDataset:
+    """Top-level so forked workers can resolve it."""
+
+    def __init__(self, n):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        x = np.full((3, 4), float(i), np.float32)
+        return x * x, np.float32(i)
+
+
+def test_dataloader_multiprocess_matches_serial():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareDataset(13)
+    serial = list(DataLoader(ds, batch_size=4))
+    mp_out = list(DataLoader(ds, batch_size=4, num_workers=2))
+    assert len(serial) == len(mp_out)
+    for s, m in zip(serial, mp_out):
+        np.testing.assert_allclose(s[0].asnumpy(), m[0].asnumpy())
+        np.testing.assert_allclose(s[1].asnumpy(), m[1].asnumpy())
+
+
+def test_dataloader_multiprocess_shuffle_and_order():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareDataset(20)
+    out = list(DataLoader(ds, batch_size=5, shuffle=True, num_workers=3))
+    labels = np.concatenate([b[1].asnumpy() for b in out])
+    assert sorted(labels.tolist()) == list(map(float, range(20)))
+
+
+class _FailingDataset(_SquareDataset):
+    def __getitem__(self, i):
+        if i == 7:
+            raise ValueError("boom at 7")
+        return super().__getitem__(i)
+
+
+def test_dataloader_multiprocess_error_propagates():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    with pytest.raises(RuntimeError, match="boom at 7"):
+        list(DataLoader(_FailingDataset(12), batch_size=4, num_workers=2))
+
+
+def test_dataloader_thread_pool_flag_keeps_threads():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareDataset(8)
+    out = list(DataLoader(ds, batch_size=4, num_workers=2,
+                          thread_pool=True))
+    assert len(out) == 2
+
+
+def test_dataloader_multiprocess_abandoned_iterator_reclaims_shm():
+    import glob
+
+    from mxnet_tpu.gluon.data import DataLoader
+
+    before = set(glob.glob("/dev/shm/*"))
+    it = iter(DataLoader(_SquareDataset(40), batch_size=4, num_workers=2,
+                         prefetch=6))
+    next(it)
+    it.close()  # abandon with prefetched batches in flight
+    leaked = set(glob.glob("/dev/shm/*")) - before
+    assert not leaked, f"leaked shared memory: {leaked}"

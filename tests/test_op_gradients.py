@@ -658,7 +658,7 @@ NONDIFF = {
     # detection assignment/suppression (reference backward: zeros; the
     # zero-grad contract is asserted in test_multibox_target_zero_grad)
     "_contrib_MultiBoxPrior", "_contrib_MultiBoxDetection",
-    "_contrib_box_nms",
+    "_contrib_box_nms", "_contrib_Proposal",
     # host-side image preprocessing (+stochastic variants)
     "_image_to_tensor", "_image_normalize", "_image_flip_left_right",
     "_image_flip_top_bottom", "_image_random_flip_left_right",
@@ -698,6 +698,10 @@ EXPLICIT = {
     "one_hot",  # composition test above
     # gradient-checked in sibling test files
     "Custom",           # tests/test_custom_op.py backward tests
+    # tests/test_vision_extra.py finite-difference checks
+    "BilinearSampler", "GridGenerator", "SpatialTransformer", "ROIPooling",
+    "Correlation", "_contrib_DeformableConvolution", "_contrib_fft",
+    "_contrib_ifft", "_contrib_count_sketch",
 }
 
 

@@ -1,0 +1,368 @@
+"""Legacy vision / contrib long-tail ops: forward numerics vs numpy
+references + finite-difference gradients.
+
+Parity: src/operator/{spatial_transformer,bilinear_sampler,grid_generator,
+roi_pooling,correlation}.cc and src/operator/contrib/{proposal,
+deformable_convolution,fft,count_sketch}.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+RNG = np.random.RandomState(21)
+
+
+def _r(*shape, scale=1.0):
+    return (RNG.rand(*shape).astype(np.float32) * scale)
+
+
+# ------------------------------------------------------------ BilinearSampler
+
+def _np_bilinear_sample(data, grid):
+    n, c, h, w = data.shape
+    _, _, oh, ow = grid.shape
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for b in range(n):
+        for i in range(oh):
+            for j in range(ow):
+                x = (grid[b, 0, i, j] + 1) * (w - 1) / 2
+                y = (grid[b, 1, i, j] + 1) * (h - 1) / 2
+                x0, y0 = int(np.floor(x)), int(np.floor(y))
+                for dy in (0, 1):
+                    for dx in (0, 1):
+                        yy, xx = y0 + dy, x0 + dx
+                        if 0 <= yy < h and 0 <= xx < w:
+                            wgt = (1 - abs(y - yy)) * (1 - abs(x - xx))
+                            out[b, :, i, j] += wgt * data[b, :, yy, xx]
+    return out
+
+
+def test_bilinear_sampler_forward():
+    data = _r(2, 3, 5, 6)
+    grid = (RNG.rand(2, 2, 4, 4).astype(np.float32) * 2.4 - 1.2)
+    out = mx.nd.BilinearSampler(mx.nd.array(data),
+                                mx.nd.array(grid)).asnumpy()
+    assert_almost_equal(out, _np_bilinear_sample(data, grid), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_bilinear_sampler_identity_grid():
+    data = _r(1, 2, 4, 4)
+    ys = np.linspace(-1, 1, 4, dtype=np.float32)
+    gx, gy = np.meshgrid(ys, ys)
+    grid = np.stack([gx, gy])[None]
+    out = mx.nd.BilinearSampler(mx.nd.array(data),
+                                mx.nd.array(grid)).asnumpy()
+    assert_almost_equal(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_sampler_grad():
+    data = _r(1, 1, 4, 4)
+    grid = (RNG.rand(1, 2, 3, 3).astype(np.float32) * 1.4 - 0.7)
+    out = sym.BilinearSampler(sym.Variable("data"), sym.Variable("grid"))
+    check_numeric_gradient(out, {"data": data, "grid": grid},
+                           numeric_eps=1e-3, rtol=0.08, atol=0.03)
+
+
+# -------------------------------------------------------------- GridGenerator
+
+def test_grid_generator_affine_identity():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape=(3, 5)).asnumpy()
+    assert grid.shape == (1, 2, 3, 5)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 3, 4), np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(flow),
+                               transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_grid_generator_grad():
+    out = sym.GridGenerator(sym.Variable("data"), transform_type="affine",
+                            target_shape=(3, 3))
+    check_numeric_gradient(out, {"data": _r(2, 6)}, numeric_eps=1e-3,
+                           rtol=0.05, atol=0.02)
+
+
+# --------------------------------------------------------- SpatialTransformer
+
+def test_spatial_transformer_identity():
+    data = _r(1, 2, 4, 4)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(theta),
+                                   target_shape=(4, 4)).asnumpy()
+    assert_almost_equal(out, data, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_transformer_grad():
+    data = _r(1, 1, 4, 4)
+    theta = np.array([[0.9, 0.1, 0.05, -0.1, 0.8, -0.05]], np.float32)
+    out = sym.SpatialTransformer(sym.Variable("data"), sym.Variable("loc"),
+                                 target_shape=(3, 3))
+    check_numeric_gradient(out, {"data": data, "loc": theta},
+                           numeric_eps=1e-3, rtol=0.08, atol=0.03)
+
+
+# ----------------------------------------------------------------- ROIPooling
+
+def _np_roi_pool(data, rois, ph, pw, scale):
+    r_out = np.zeros((len(rois), data.shape[1], ph, pw), np.float32)
+    h, w = data.shape[2:]
+    for ri, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi[1:]]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                hs = int(np.floor(i * rh / ph)) + y1
+                he = int(np.ceil((i + 1) * rh / ph)) + y1
+                ws = int(np.floor(j * rw / pw)) + x1
+                we = int(np.ceil((j + 1) * rw / pw)) + x1
+                hs, he = max(hs, 0), min(he, h)
+                ws, we = max(ws, 0), min(we, w)
+                if he > hs and we > ws:
+                    r_out[ri, :, i, j] = data[b, :, hs:he, ws:we] \
+                        .max(axis=(1, 2))
+    return r_out
+
+
+def test_roi_pooling_forward():
+    data = _r(2, 3, 8, 8)
+    rois = np.array([[0, 1, 1, 6, 6], [1, 0, 0, 3, 7], [0, 2, 3, 2, 3]],
+                    np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert_almost_equal(out, _np_roi_pool(data, rois, 2, 2, 1.0), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_roi_pooling_spatial_scale():
+    data = _r(1, 1, 8, 8)
+    rois = np.array([[0, 2, 2, 14, 14]], np.float32)  # scaled by 0.5 -> 1..7
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=0.5).asnumpy()
+    assert_almost_equal(out, _np_roi_pool(data, rois, 2, 2, 0.5), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_roi_pooling_grad():
+    # distinct values keep the max selection stable under FD perturbation
+    data = (np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8) * 0.37
+            + _r(1, 1, 8, 8, scale=0.01))
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out = sym.ROIPooling(sym.Variable("data"), sym.Variable("rois"),
+                         pooled_size=(2, 2), spatial_scale=1.0)
+    check_numeric_gradient(out, {"data": data, "rois": rois},
+                           grad_nodes=["data"], numeric_eps=1e-2,
+                           rtol=0.08, atol=0.03)
+
+
+# ---------------------------------------------------------------- Correlation
+
+def _np_correlation(d1, d2, k, max_d, s1, s2, pad, multiply):
+    n, c, h, w = d1.shape
+    kr = (k - 1) // 2
+    border = max_d + kr
+    ph_, pw_ = h + 2 * pad, w + 2 * pad
+    top_h = int(np.ceil((ph_ - 2 * border) / s1))
+    top_w = int(np.ceil((pw_ - 2 * border) / s1))
+    ngr = max_d // s2
+    D = 2 * ngr + 1
+    p1 = np.zeros((n, c, ph_, pw_), np.float32)
+    p2 = np.zeros((n, c, ph_, pw_), np.float32)
+    p1[:, :, pad:pad + h, pad:pad + w] = d1
+    p2[:, :, pad:pad + h, pad:pad + w] = d2
+    out = np.zeros((n, D * D, top_h, top_w), np.float32)
+    for b in range(n):
+        for di, dy in enumerate(range(-max_d, max_d + 1, s2)):
+            for dj, dx in enumerate(range(-max_d, max_d + 1, s2)):
+                for i in range(top_h):
+                    for j in range(top_w):
+                        y0 = border + i * s1
+                        x0 = border + j * s1
+                        acc = 0.0
+                        for ky in range(-kr, kr + 1):
+                            for kx in range(-kr, kr + 1):
+                                a = p1[b, :, y0 + ky, x0 + kx]
+                                yy, xx = y0 + ky + dy, x0 + kx + dx
+                                if 0 <= yy < ph_ and 0 <= xx < pw_:
+                                    v = p2[b, :, yy, xx]
+                                else:
+                                    v = 0.0
+                                acc += (a * v).sum() if multiply else \
+                                    np.abs(a - v).sum()
+                        out[b, di * D + dj, i, j] = acc / (k * k * c)
+    return out
+
+
+@pytest.mark.parametrize("multiply", [True, False])
+def test_correlation_forward(multiply):
+    d1, d2 = _r(1, 2, 6, 6), _r(1, 2, 6, 6)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2), kernel_size=1,
+                            max_displacement=2, stride1=1, stride2=1,
+                            pad_size=2, is_multiply=multiply).asnumpy()
+    ref = _np_correlation(d1, d2, 1, 2, 1, 1, 2, multiply)
+    assert out.shape == ref.shape
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_kernel3_stride2():
+    d1, d2 = _r(1, 2, 10, 10), _r(1, 2, 10, 10)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2), kernel_size=3,
+                            max_displacement=2, stride1=2, stride2=2,
+                            pad_size=3).asnumpy()
+    ref = _np_correlation(d1, d2, 3, 2, 2, 2, 3, True)
+    assert out.shape == ref.shape
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_grad():
+    out = sym.Correlation(sym.Variable("a"), sym.Variable("b"),
+                          kernel_size=1, max_displacement=1, pad_size=1)
+    check_numeric_gradient(out, {"a": _r(1, 1, 4, 4), "b": _r(1, 1, 4, 4)},
+                           numeric_eps=1e-2, rtol=0.08, atol=0.03)
+
+
+# ------------------------------------------------------------------- Proposal
+
+def test_proposal_forward():
+    fh = fw = 4
+    scales, ratios = (8.0,), (1.0,)
+    A = 1
+    cls = np.zeros((1, 2 * A, fh, fw), np.float32)
+    cls[0, A:] = 0.1
+    cls[0, A, 2, 1] = 0.9  # strongest anchor at (y=2, x=1)
+    bbox = np.zeros((1, 4 * A, fh, fw), np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        scales=scales, ratios=ratios, rpn_pre_nms_top_n=16,
+        rpn_post_nms_top_n=4, threshold=0.7, rpn_min_size=4,
+        feature_stride=16).asnumpy()
+    assert out.shape == (4, 5)
+    assert (out[:, 0] == 0).all()  # batch indices
+    # top roi: zero deltas -> the anchor itself at shift (x=16, y=32),
+    # base anchor 8*16=128 wide centered at 7.5 -> clipped to image
+    cx, cy = 7.5 + 16, 7.5 + 32
+    exp = [max(cx - 63.5, 0), max(cy - 63.5, 0),
+           min(cx + 63.5, 63), min(cy + 63.5, 63)]
+    np.testing.assert_allclose(out[0, 1:], exp, atol=1e-4)
+    # boxes inside the image
+    assert (out[:, 1:] >= 0).all()
+    assert (out[:, (1, 3)] <= 63).all() and (out[:, (2, 4)] <= 63).all()
+
+
+def test_proposal_output_score_and_batch():
+    cls = _r(2, 2, 3, 3)
+    bbox = (_r(2, 4, 3, 3) - 0.5) * 0.2
+    im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        scales=(4.0,), ratios=(1.0,), rpn_pre_nms_top_n=9,
+        rpn_post_nms_top_n=3, rpn_min_size=1, output_score=True)
+    assert rois.shape == (6, 5) and scores.shape == (6, 1)
+    r = rois.asnumpy()
+    assert (r[:3, 0] == 0).all() and (r[3:, 0] == 1).all()
+
+
+# -------------------------------------------------- DeformableConvolution
+
+def test_deformable_conv_zero_offset_equals_conv():
+    data = _r(2, 4, 7, 7)
+    weight = _r(6, 4, 3, 3, scale=0.3)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(off), mx.nd.array(weight),
+        kernel=(3, 3), pad=(1, 1), num_filter=6, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(data), mx.nd.array(weight),
+                            kernel=(3, 3), pad=(1, 1), num_filter=6,
+                            no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_stride_dilate_groups():
+    data = _r(1, 4, 9, 9)
+    weight = _r(4, 2, 3, 3, scale=0.3)
+    off = np.zeros((1, 2 * 9, 5, 5), np.float32)  # out 5x5 for 9x9/s2/p1
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(off), mx.nd.array(weight),
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), dilate=(1, 1),
+        num_filter=4, num_group=2, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(data), mx.nd.array(weight),
+                            kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                            num_filter=4, num_group=2,
+                            no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_nonzero_offset_grad():
+    data = _r(1, 1, 5, 5)
+    weight = _r(1, 1, 3, 3, scale=0.5)
+    off = (_r(1, 18, 3, 3) - 0.5) * 0.4
+    out = sym.contrib.DeformableConvolution(
+        sym.Variable("data"), sym.Variable("off"), sym.Variable("w"),
+        kernel=(3, 3), num_filter=1, no_bias=True)
+    check_numeric_gradient(out, {"data": data, "off": off, "w": weight},
+                           numeric_eps=1e-3, rtol=0.08, atol=0.03)
+
+
+# ----------------------------------------------------------------- fft / etc
+
+def test_fft_ifft_roundtrip():
+    x = _r(3, 8)
+    f = mx.nd.contrib.fft(mx.nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    assert_almost_equal(f[:, 0::2], ref.real.astype(np.float32), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(f[:, 1::2], ref.imag.astype(np.float32), rtol=1e-4,
+                        atol=1e-4)
+    # reference (cuFFT) does not normalize: ifft(fft(x)) = x * d
+    back = mx.nd.contrib.ifft(mx.nd.array(f)).asnumpy()
+    assert_almost_equal(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_grad():
+    out = getattr(sym, "_contrib_fft")(sym.Variable("data"))
+    check_numeric_gradient(out, {"data": _r(2, 4)}, numeric_eps=1e-3,
+                           rtol=0.05, atol=0.02)
+
+
+def test_count_sketch_forward_and_grad():
+    n, in_dim, out_dim = 3, 6, 5
+    data = _r(n, in_dim)
+    h = RNG.randint(0, out_dim, (1, in_dim)).astype(np.float32)
+    s = np.sign(RNG.rand(1, in_dim) - 0.5).astype(np.float32)
+    out = mx.nd.contrib.count_sketch(
+        mx.nd.array(data), mx.nd.array(h), mx.nd.array(s),
+        out_dim=out_dim).asnumpy()
+    ref = np.zeros((n, out_dim), np.float32)
+    for i in range(in_dim):
+        ref[:, int(h[0, i])] += s[0, i] * data[:, i]
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+    osym = getattr(sym, "_contrib_count_sketch")(
+        sym.Variable("data"), sym.Variable("h"), sym.Variable("s"),
+        out_dim=out_dim)
+    check_numeric_gradient(osym, {"data": data, "h": h, "s": s},
+                           grad_nodes=["data"], numeric_eps=1e-3,
+                           rtol=0.05, atol=0.02)
+
+
+def test_ifft_grad():
+    out = getattr(sym, "_contrib_ifft")(sym.Variable("data"))
+    check_numeric_gradient(out, {"data": _r(2, 8)}, numeric_eps=1e-3,
+                           rtol=0.05, atol=0.02)
