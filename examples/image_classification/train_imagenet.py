@@ -30,6 +30,9 @@ from mxnet_tpu.gluon.model_zoo import vision
 def make_iters(args):
     if not args.rec:
         return None, None
+    if not args.val_rec:
+        raise SystemExit("--rec requires --val-rec (held-out top-1); "
+                         "omit both for the synthetic smoke run")
     train = mx.io.ImageRecordIter(
         path_imgrec=args.rec, data_shape=(3, 224, 224),
         batch_size=args.batch_size, shuffle=True, random_resized_crop=True,

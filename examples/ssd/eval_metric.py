@@ -1,0 +1,103 @@
+"""VOC-style mean-average-precision metric for detection.
+
+Parity: example/ssd/evaluate/eval_metric.py (MApMetric / VOC07MApMetric)
+in the reference. Updates take MultiBoxDetection outputs
+(det (B, N, 6) = [cls, score, x1, y1, x2, y2], -1 class = padding) and
+ground-truth labels (B, M, 5+) = [cls, x1, y1, x2, y2]; get() returns the
+mAP over classes, with the VOC07 11-point interpolation when
+``use_voc07=True``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _iou(box, boxes):
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    iw = np.maximum(ix2 - ix1, 0)
+    ih = np.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return inter / np.maximum(a + b - inter, 1e-12)
+
+
+class MApMetric:
+    """Accumulates per-class detection records; AP by PR integration."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, use_voc07=False):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.use_voc07 = use_voc07
+        self.reset()
+
+    def reset(self):
+        self._records = {}   # cls -> list of (score, tp)
+        self._gt_count = {}  # cls -> int
+
+    def update(self, labels, preds):
+        """labels: (B, M, 5+) ndarray/numpy; preds: (B, N, 6)."""
+        labels = np.asarray(getattr(labels, "asnumpy", lambda: labels)())
+        preds = np.asarray(getattr(preds, "asnumpy", lambda: preds)())
+        for b in range(preds.shape[0]):
+            gts = labels[b]
+            gts = gts[gts[:, 0] >= 0]
+            dets = preds[b]
+            dets = dets[dets[:, 0] >= 0]
+            for c in np.unique(gts[:, 0]).astype(int):
+                self._gt_count[c] = self._gt_count.get(c, 0) + \
+                    int((gts[:, 0] == c).sum())
+            matched = np.zeros(len(gts), bool)
+            order = np.argsort(-dets[:, 1])
+            for d in dets[order]:
+                c = int(d[0])
+                cand = np.where((gts[:, 0] == c) & ~matched)[0]
+                tp = 0
+                if len(cand):
+                    ious = _iou(d[2:6], gts[cand, 1:5])
+                    j = int(np.argmax(ious))
+                    if ious[j] >= self.iou_thresh:
+                        matched[cand[j]] = True
+                        tp = 1
+                self._records.setdefault(c, []).append((float(d[1]), tp))
+
+    def _ap(self, recs, n_gt):
+        if not recs or n_gt == 0:
+            return 0.0
+        recs = sorted(recs, key=lambda r: -r[0])
+        tps = np.cumsum([r[1] for r in recs])
+        fps = np.cumsum([1 - r[1] for r in recs])
+        recall = tps / n_gt
+        precision = tps / np.maximum(tps + fps, 1e-12)
+        if self.use_voc07:
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t]
+                ap += (p.max() if len(p) else 0.0) / 11.0
+            return float(ap)
+        # all-point interpolation
+        mrec = np.concatenate([[0], recall, [1]])
+        mpre = np.concatenate([[0], precision, [0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        classes = sorted(self._gt_count)
+        if not classes:
+            return "mAP", 0.0
+        aps = [self._ap(self._records.get(c, []), self._gt_count[c])
+               for c in classes]
+        return "mAP", float(np.mean(aps))
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (the VOC07 convention the reference's
+    77.8 number uses)."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None):
+        super().__init__(iou_thresh, class_names, use_voc07=True)

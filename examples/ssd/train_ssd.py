@@ -5,6 +5,9 @@ ImageDetIter over synthetic box data offline (pass --imglist/--root for
 real data in the det .lst format).
 """
 import argparse
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
 import os
 import sys
 import tempfile
@@ -122,15 +125,24 @@ def main():
             tot.append(float(loss.asnumpy()))
         print(f"epoch {epoch}: loss {sum(tot)/len(tot):.4f}")
 
-    # detection on one batch
+    # VOC07-style mAP over the full (validation) iterator — the metric
+    # the reference's 77.8 acceptance number uses (eval_metric.py)
+    from eval_metric import VOC07MApMetric
+
+    metric = VOC07MApMetric(iou_thresh=0.5)
     it.reset()
-    batch = next(iter(it))
-    anchors, cp, lp = net(batch.data[0] / 255.0)
-    det = mx.nd.contrib.MultiBoxDetection(
-        mx.nd.softmax(cp, axis=1), lp, anchors, nms_topk=50)
-    kept = det.asnumpy()[0]
-    kept = kept[kept[:, 0] >= 0]
+    kept = None
+    for batch in it:
+        anchors, cp, lp = net(batch.data[0] / 255.0)
+        det = mx.nd.contrib.MultiBoxDetection(
+            mx.nd.softmax(cp, axis=1), lp, anchors, nms_topk=50)
+        metric.update(batch.label[0], det)
+        if kept is None:
+            k = det.asnumpy()[0]
+            kept = k[k[:, 0] >= 0]
+    name, value = metric.get()
     print(f"detections on image 0: {len(kept)} (top: {kept[:3].round(3)})")
+    print(f"{name}={value:.4f}")
 
 
 if __name__ == "__main__":

@@ -1,13 +1,20 @@
 """INT8 model quantization driver.
 
 Capability parity with python/mxnet/contrib/quantization.py
-(quantize_model: graph pass inserting quantize/dequantize around
-FullyConnected/Convolution + naive min/max calibration over a data set).
-TPU-native form: the pass produces a *fake-quant* graph — fp32 values are
-rounded through the int8 grid of ops/quantization.py at every quantized
-boundary — which reproduces the reference's int8 accuracy exactly while
-staying one XLA program; int8 kernels can replace the boundaries later
-without changing this surface.
+(quantize_model graph pass + calibration) and
+src/operator/quantization/calibrate.cc (entropy/KL threshold search).
+
+Two graph modes:
+- quantize_mode='fake' — fp32 values rounded through the int8 grid at
+  every quantized boundary (accuracy flow; one XLA program).
+- quantize_mode='full' — FullyConnected/Convolution replaced by REAL
+  int8 kernels (ops/quantization.py quantized_* — int8 operands, int32
+  MXU accumulation), quantize/dequantize at the boundaries. Requires
+  calibrated ranges (calib_mode 'naive' or 'entropy').
+
+Calibration modes: 'none' (runtime min/max), 'naive' (min/max over a
+calibration set), 'entropy' (KL-divergence-optimal clip threshold over
+activation histograms — calibrate.cc).
 """
 from __future__ import annotations
 
@@ -20,10 +27,24 @@ __all__ = ["quantize_model", "quantize_graph"]
 _QUANTIZABLE = ("FullyConnected", "Convolution")
 
 
+_FULL_OPS = {"FullyConnected": "_contrib_quantized_fully_connected",
+             "Convolution": "_contrib_quantized_conv"}
+_FULL_PARAMS = {
+    "FullyConnected": ("num_hidden", "no_bias", "flatten"),
+    "Convolution": ("kernel", "stride", "dilate", "pad", "num_filter",
+                    "num_group", "no_bias", "layout"),
+}
+
+
 def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
-                   calib_ranges=None):
-    """Clone `sym` with fake-quant (quantize_v2 -> dequantize) inserted on
-    the data and weight inputs of every quantizable node.
+                   calib_ranges=None, quantize_mode="fake"):
+    """Clone `sym` with int8 boundaries on every quantizable node.
+
+    quantize_mode='fake': quantize_v2 -> dequantize pairs on data/weight
+    inputs (values ride the int8 grid, compute stays fp32).
+    quantize_mode='full': the node itself becomes the int8 kernel
+    (quantized_fully_connected / quantized_conv, int32 accumulation)
+    followed by dequantize — requires calib_ranges for the data input.
 
     calib_ranges: optional {(producer_name, slot): (min, max)} from
     calibration; quantize_v2 nodes without a range compute min/max at
@@ -31,8 +52,20 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
     """
     from ..symbol.symbol import Symbol, _Node
 
+    if quantize_mode not in ("fake", "full"):
+        raise MXNetError(f"quantize_mode must be fake|full, "
+                         f"got {quantize_mode!r}")
     excluded = set(excluded_sym_names)
     mapping = {}
+
+    def make_quant(name, src, dtype="int8", key=None):
+        params = {"out_type": dtype}
+        if calib_ranges and key in calib_ranges:
+            lo, hi = calib_ranges[key]
+            params["min_calib_range"] = float(lo)
+            params["max_calib_range"] = float(hi)
+        return _Node("_contrib_quantize_v2", name, params=params,
+                     inputs=[src])
 
     def cloned(node):
         if id(node) in mapping:
@@ -42,23 +75,49 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
         new.aux_mark = node.aux_mark
         mapping[id(node)] = new
         new.inputs = [(cloned(n), s) for n, s in node.inputs]
-        if node.op in _QUANTIZABLE and node.name not in excluded:
-            # wrap data (slot 0) and weight (slot 1) in fake-quant pairs
-            for i in range(min(2, len(new.inputs))):
-                src_node, src_slot = new.inputs[i]
-                params = {"out_type": quantized_dtype}
-                key = (src_node.name, src_slot)
-                if calib_ranges and key in calib_ranges:
-                    lo, hi = calib_ranges[key]
-                    params["min_calib_range"] = float(lo)
-                    params["max_calib_range"] = float(hi)
-                q = _Node("_contrib_quantize_v2",
-                          f"{node.name}_in{i}_quantize", params=params,
-                          inputs=[(src_node, src_slot)])
-                dq = _Node("_contrib_dequantize",
-                           f"{node.name}_in{i}_dequantize",
-                           inputs=[(q, 0), (q, 1), (q, 2)])
-                new.inputs[i] = (dq, 0)
+        if node.op not in _QUANTIZABLE or node.name in excluded:
+            return new
+        if quantize_mode == "full":
+            # replace with the real int8 kernel + boundary dequantize.
+            # Range keys use the ORIGINAL producer name — a chained
+            # quantizable producer's clone is its '<name>_dequantize'
+            # node, which calibration never saw.
+            qins = []
+            for i, ((src_node, src_slot), (orig_src, orig_slot)) in \
+                    enumerate(zip(new.inputs[:3], node.inputs[:3])):
+                q = make_quant(f"{node.name}_in{i}_quantize",
+                               (src_node, src_slot), quantized_dtype,
+                               key=(orig_src.name, orig_slot))
+                qins.append(q)
+            inputs = [(qins[0], 0), (qins[1], 0)]
+            inputs += [(qins[2], 0)] if len(qins) > 2 else                 [(qins[1], 0)]  # dummy bias slot for no_bias nodes
+            inputs += [(qins[0], 1), (qins[0], 2), (qins[1], 1),
+                       (qins[1], 2)]
+            b = qins[2] if len(qins) > 2 else qins[1]
+            inputs += [(b, 1), (b, 2)]
+            qparams = {k: node.params[k]
+                       for k in _FULL_PARAMS[node.op]
+                       if k in node.params}
+            if len(qins) <= 2:
+                qparams["no_bias"] = True
+            qnode = _Node(_FULL_OPS[node.op], f"{node.name}_int8",
+                          params=qparams, inputs=inputs)
+            dq = _Node("_contrib_dequantize", f"{node.name}_dequantize",
+                       inputs=[(qnode, 0), (qnode, 1), (qnode, 2)])
+            # downstream consumers see this dequantized fp32 value
+            mapping[id(node)] = dq
+            return dq
+        # fake-quant: wrap data (slot 0) and weight (slot 1)
+        for i in range(min(2, len(new.inputs))):
+            src_node, src_slot = new.inputs[i]
+            orig_src, orig_slot = node.inputs[i]
+            q = make_quant(f"{node.name}_in{i}_quantize",
+                           (src_node, src_slot), quantized_dtype,
+                           key=(orig_src.name, orig_slot))
+            dq = _Node("_contrib_dequantize",
+                       f"{node.name}_in{i}_dequantize",
+                       inputs=[(q, 0), (q, 1), (q, 2)])
+            new.inputs[i] = (dq, 0)
         return new
 
     outputs = [(cloned(n), s) for n, s in sym._outputs]
@@ -75,7 +134,8 @@ def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
     targets = set()
     for node in sym._topo_nodes():
         if node.op in _QUANTIZABLE:
-            for n, s in node.inputs[:2]:
+            # data, weight, and (for the full-int8 kernels) bias
+            for n, s in node.inputs[:3]:
                 targets.add((n.name, s))
 
     ranges = {}
@@ -134,29 +194,177 @@ def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
     return ranges
 
 
+def _entropy_threshold(hist, edges, num_quantized_bins=255):
+    """KL-divergence-optimal clip threshold over an |activation| histogram
+    (src/operator/quantization/calibrate.cc ComputeEntropy; same algorithm
+    as TensorRT's calibrator). Returns the threshold value."""
+    nbins = len(hist)
+    half = (num_quantized_bins + 1) // 2
+    if nbins <= half:
+        return float(edges[-1])
+    hist = hist.astype(np.float64)
+
+    def smooth(d, eps=1e-4):
+        # calibrate.cc SmoothDistribution: move eps into empty bins so the
+        # KL penalty for mass the candidate cannot represent is counted
+        # instead of masked away
+        is_zero = d == 0
+        n_zero = int(is_zero.sum())
+        n_nonzero = d.size - n_zero
+        if n_nonzero == 0:
+            return None
+        if n_zero == 0:
+            return d
+        eps1 = eps * n_zero / n_nonzero
+        if eps1 >= 1.0:
+            return None
+        out = d.copy()
+        out[is_zero] = eps
+        out[~is_zero] -= eps1
+        return out
+
+    best_kl, best_i = np.inf, nbins
+    for i in range(half, nbins + 1):
+        # reference distribution: clip everything beyond bin i into bin i-1
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()
+        is_nonzero = hist[:i] > 0
+        # candidate: quantize the first i bins into `half` levels, then
+        # expand back over the nonzero support
+        q = np.zeros(i, np.float64)
+        group = i / half
+        for j in range(half):
+            lo = int(np.floor(j * group))
+            hi = int(np.floor((j + 1) * group)) if j < half - 1 else i
+            seg = slice(lo, max(hi, lo + 1))
+            total = hist[seg].sum()
+            nz = is_nonzero[seg].sum()
+            if nz:
+                q[seg] = np.where(is_nonzero[seg], total / nz, 0.0)
+        # smooth the raw COUNT distributions (calibrate.cc order: counts
+        # are >= 1 wherever nonzero, so eps never drives a bin negative),
+        # normalize afterwards
+        p = smooth(p)
+        q = smooth(q)
+        if p is None or q is None:
+            continue
+        p /= p.sum()
+        q /= q.sum()
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(p[mask] / q[mask])))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(edges[best_i])
+
+
+def _collect_entropy_ranges(sym, arg_params, aux_params, data_names,
+                            label_names, calib_data, num_calib_examples,
+                            num_bins=2048, logger=None):
+    """Two passes: (1) max|activation| per target via the naive collector,
+    (2) |activation| histograms, then the KL threshold per target.
+    Weight/bias params keep exact min/max (the reference also only
+    entropy-calibrates activations)."""
+    naive = _collect_ranges(sym, arg_params, aux_params, data_names,
+                            label_names, calib_data, num_calib_examples,
+                            logger)
+    param_keys = {k for k in naive if k[0] in arg_params}
+    act_keys = [k for k in naive if k not in param_keys]
+    max_abs = {k: max(abs(naive[k][0]), abs(naive[k][1]), 1e-20)
+               for k in act_keys}
+    hists = {k: np.zeros(num_bins, np.int64) for k in act_keys}
+
+    from .. import context as ctx_mod
+
+    name_of = {}
+    for node_name, slot in act_keys:
+        mon = (f"{node_name}_output" if slot == 0
+               else f"{node_name}_output{slot}")
+        name_of[mon] = (node_name, slot)
+
+    def tap(mon_name, arr):
+        key = name_of.get(mon_name)
+        if key is None:
+            return
+        a = np.abs(arr.asnumpy()).ravel()
+        hists[key] += np.histogram(a, bins=num_bins,
+                                   range=(0.0, max_abs[key]))[0]
+
+    seen = 0
+    ex = None
+    calib_data.reset()
+    for batch in calib_data:
+        for n, d in zip(data_names, batch.data):
+            key = (n, 0)
+            if key in hists:
+                a = np.abs(d.asnumpy()).ravel()
+                hists[key] += np.histogram(
+                    a, bins=num_bins, range=(0.0, max_abs[key]))[0]
+        if ex is None:
+            args = dict(arg_params)
+            for n, d in zip(data_names, batch.data):
+                args[n] = d
+            for ln in label_names or ():
+                if ln in sym.list_arguments() and ln not in args:
+                    from ..ndarray import ndarray as _nd
+
+                    args[ln] = _nd.zeros((batch.data[0].shape[0],))
+            ex = sym.bind(ctx_mod.current_context(), args,
+                          aux_states=dict(aux_params) if aux_params
+                          else None)
+            ex.set_monitor_callback(tap, monitor_all=True)
+            ex.forward(is_train=False)
+        else:
+            ex.forward(is_train=False,
+                       **{n: d for n, d in zip(data_names, batch.data)})
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+
+    ranges = dict(naive)  # params keep exact min/max
+    for k in act_keys:
+        edges = np.linspace(0.0, max_abs[k], num_bins + 1)
+        t = _entropy_threshold(hists[k], edges)
+        ranges[k] = (-t, t)
+        if logger:
+            logger.info("entropy calib %s: max|x| %.4f -> threshold %.4f",
+                        k, max_abs[k], t)
+    return ranges
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), excluded_sym_names=(),
                    calib_mode="none", calib_data=None,
                    num_calib_examples=None, quantized_dtype="int8",
-                   logger=None):
+                   quantize_mode="fake", logger=None):
     """Quantize a symbolic model (contrib/quantization.py:quantize_model).
 
-    calib_mode: 'none' (runtime min/max) or 'naive' (min/max collected
-    over calib_data; the reference's entropy mode is descoped — naive
-    calibration differs <0.2% mAP in the reference's own SSD table).
-    Returns (quantized_symbol, arg_params, aux_params).
+    calib_mode: 'none' (runtime min/max), 'naive' (min/max over
+    calib_data), or 'entropy' (KL-optimal clip thresholds,
+    calibrate.cc). quantize_mode: 'fake' (int8 grid, fp32 compute) or
+    'full' (real int8 kernels, int32 MXU accumulation — requires
+    calibration). Returns (quantized_symbol, arg_params, aux_params).
     """
     if quantized_dtype not in ("int8", "uint8"):
         raise MXNetError("quantized_dtype must be int8 or uint8")
     ranges = None
-    if calib_mode == "naive":
+    if calib_mode in ("naive", "entropy"):
         if calib_data is None:
-            raise MXNetError("calib_mode='naive' requires calib_data")
-        ranges = _collect_ranges(sym, arg_params, aux_params, data_names,
-                                 label_names, calib_data,
-                                 num_calib_examples, logger)
+            raise MXNetError(f"calib_mode={calib_mode!r} requires "
+                             "calib_data")
+        collect = (_collect_ranges if calib_mode == "naive"
+                   else _collect_entropy_ranges)
+        ranges = collect(sym, arg_params, aux_params, data_names,
+                         label_names, calib_data, num_calib_examples,
+                         logger=logger)
     elif calib_mode != "none":
         raise MXNetError(f"unsupported calib_mode {calib_mode!r} "
-                         "(supported: 'none', 'naive')")
-    qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype, ranges)
+                         "(supported: 'none', 'naive', 'entropy')")
+    if quantize_mode == "full" and ranges is None:
+        raise MXNetError("quantize_mode='full' requires calibration "
+                         "(calib_mode 'naive' or 'entropy')")
+    if quantize_mode == "full" and quantized_dtype != "int8":
+        raise MXNetError("quantize_mode='full' kernels are symmetric "
+                         "int8; use quantized_dtype='int8'")
+    qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype, ranges,
+                          quantize_mode=quantize_mode)
     return qsym, arg_params, aux_params

@@ -76,6 +76,10 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
         scale = (max_r - min_r) / 255.0
         return data.astype(jnp.float32) * scale + min_r
     real = _int8_range(min_r, max_r)
+    if data.dtype == jnp.int32:
+        # int32 accumulators span the full int32 grid
+        # (quantization_utils.h:87)
+        return data.astype(jnp.float32) * (real / 2147483647.0)
     return data.astype(jnp.float32) * (real / 127.0)
 
 
@@ -101,3 +105,80 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     q = jnp.clip(jnp.round(fp * 127.0 / jnp.maximum(real_out, 1e-20)),
                  -127, 127)
     return q.astype(jnp.int8), -real_out, real_out
+
+
+# ---------------------------------------------------------------------------
+# real int8 compute kernels: int8 operands feed the MXU directly
+# (lax.dot_general / conv_general_dilated with preferred_element_type=int32)
+# — the throughput half of the reference's quantized_fully_connected.cc /
+# quantized_conv.cc, not just the fake-quant accuracy flow
+# ---------------------------------------------------------------------------
+
+def _s8s8_out_range(min_d, max_d, min_w, max_w):
+    """Output float range of an int32 accumulator of int8*int8 products
+    (quantization_utils.h QuantizationRangeForS8S8Multiplication)."""
+    level = (_int8_range(min_d.reshape(()), max_d.reshape(())) / 127.0) *         (_int8_range(min_w.reshape(()), max_w.reshape(())) / 127.0)
+    hi = level * 2147483647.0
+    return -hi, hi, level
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3, no_grad=True,
+          aliases=("quantized_fully_connected",))
+def _quantized_fully_connected(data, weight, bias, min_data, max_data,
+                               min_weight, max_weight, min_bias=None,
+                               max_bias=None, num_hidden=None, no_bias=False,
+                               flatten=True):
+    """int8 GEMM with int32 accumulation
+    (src/operator/quantization/quantized_fully_connected.cc). data/weight
+    int8; bias int8 with its own range, rescaled into the accumulator
+    grid. Returns (int32 out, min_out, max_out)."""
+    import jax
+
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = jax.lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    lo, hi, level = _s8s8_out_range(min_data, max_data, min_weight,
+                                    max_weight)
+    if bias is not None and not no_bias:
+        real_b = _int8_range(min_bias.reshape(()), max_bias.reshape(()))
+        bias_fp = bias.astype(jnp.float32) * (real_b / 127.0)
+        out = out + jnp.round(bias_fp / level).astype(jnp.int32)
+    return out, lo, hi
+
+
+@register("_contrib_quantized_conv", num_outputs=3, no_grad=True,
+          aliases=("quantized_conv",))
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias=None, max_bias=None, kernel=None,
+                    stride=None, dilate=None, pad=None, num_filter=None,
+                    num_group=1, no_bias=False, layout=None, workspace=None,
+                    cudnn_tune=None, cudnn_off=False):
+    """int8 convolution with int32 accumulation
+    (src/operator/quantization/quantized_conv.cc). NCHW/OIHW like the
+    fp32 op; on TPU the int8 operands hit the MXU's int8 path."""
+    import jax
+
+    from .nn import _conv_dn, _conv_pads, _pair
+
+    sdims = data.ndim - 2
+    stride = _pair(stride or 1, sdims)
+    dilate = _pair(dilate or 1, sdims)
+    pad = pad if isinstance(pad, (tuple, list)) else _pair(pad or 0, sdims)
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape, _conv_dn(data.ndim, layout))
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=_conv_pads(pad),
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    lo, hi, level = _s8s8_out_range(min_data, max_data, min_weight,
+                                    max_weight)
+    if bias is not None and not no_bias:
+        real_b = _int8_range(min_bias.reshape(()), max_bias.reshape(()))
+        bias_fp = bias.astype(jnp.float32) * (real_b / 127.0)
+        bias_i32 = jnp.round(bias_fp / level).astype(jnp.int32)
+        out = out + bias_i32.reshape((1, -1) + (1,) * sdims)
+    return out, lo, hi

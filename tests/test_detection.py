@@ -319,3 +319,34 @@ def test_ssd_smoke_train():
     det = mx.nd.contrib.MultiBoxDetection(
         mx.nd.softmax(cp, axis=1), lp, anchors, nms_topk=20)
     assert det.shape[0] == B and det.shape[2] == 6
+
+
+def test_voc_map_metric():
+    """VOC07 mAP on hand-checkable detections (examples/ssd/eval_metric.py,
+    parity: reference example/ssd/evaluate/eval_metric.py)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "ssd"))
+    from eval_metric import MApMetric, VOC07MApMetric
+
+    # one image, one gt box of class 0; one perfect detection
+    gt = np.array([[[0, 0.2, 0.2, 0.6, 0.6]]], np.float32)
+    det = np.array([[[0, 0.9, 0.2, 0.2, 0.6, 0.6]]], np.float32)
+    m = MApMetric()
+    m.update(gt, det)
+    assert m.get() == ("mAP", 1.0)
+
+    # add a false positive with higher score: precision halves at the tp
+    m2 = VOC07MApMetric()
+    det2 = np.array([[[0, 0.95, 0.0, 0.0, 0.1, 0.1],
+                      [0, 0.90, 0.2, 0.2, 0.6, 0.6]]], np.float32)
+    m2.update(gt, det2)
+    name, v = m2.get()
+    assert name == "mAP" and 0.4 < v < 0.6  # 11-point AP = 6/11 ~ 0.545
+
+    # miss entirely -> 0
+    m3 = MApMetric()
+    m3.update(gt, np.array([[[0, 0.9, 0.7, 0.7, 0.9, 0.9]]], np.float32))
+    assert m3.get()[1] == 0.0
