@@ -39,30 +39,48 @@ class MApMetric:
         self._gt_count = {}  # cls -> int
 
     def update(self, labels, preds):
-        """labels: (B, M, 5+) ndarray/numpy; preds: (B, N, 6)."""
+        """labels: (B, M, 5+) ndarray/numpy (column 5, if present, is the
+        VOC 'difficult' flag); preds: (B, N, 6).
+
+        Matching follows the reference convention
+        (example/ssd/evaluate/eval_metric.py): each detection matches its
+        GLOBAL best-IoU ground truth of the same class; a second detection
+        on an already-matched gt is a false positive (not reassigned), and
+        detections whose best match is a difficult gt are ignored entirely.
+        Difficult gts are excluded from the recall denominator."""
         labels = np.asarray(getattr(labels, "asnumpy", lambda: labels)())
         preds = np.asarray(getattr(preds, "asnumpy", lambda: preds)())
         for b in range(preds.shape[0]):
             gts = labels[b]
             gts = gts[gts[:, 0] >= 0]
+            difficult = (gts[:, 5] > 0 if gts.shape[1] > 5
+                         else np.zeros(len(gts), bool))
             dets = preds[b]
             dets = dets[dets[:, 0] >= 0]
             for c in np.unique(gts[:, 0]).astype(int):
                 self._gt_count[c] = self._gt_count.get(c, 0) + \
-                    int((gts[:, 0] == c).sum())
+                    int(((gts[:, 0] == c) & ~difficult).sum())
             matched = np.zeros(len(gts), bool)
             order = np.argsort(-dets[:, 1])
             for d in dets[order]:
                 c = int(d[0])
-                cand = np.where((gts[:, 0] == c) & ~matched)[0]
-                tp = 0
+                cand = np.where(gts[:, 0] == c)[0]
                 if len(cand):
                     ious = _iou(d[2:6], gts[cand, 1:5])
                     j = int(np.argmax(ious))
+                    gi = cand[j]
                     if ious[j] >= self.iou_thresh:
-                        matched[cand[j]] = True
-                        tp = 1
-                self._records.setdefault(c, []).append((float(d[1]), tp))
+                        if difficult[gi]:
+                            continue  # neither tp nor fp
+                        if not matched[gi]:
+                            matched[gi] = True
+                            self._records.setdefault(c, []).append(
+                                (float(d[1]), 1))
+                        else:  # duplicate on a matched gt: fp
+                            self._records.setdefault(c, []).append(
+                                (float(d[1]), 0))
+                        continue
+                self._records.setdefault(c, []).append((float(d[1]), 0))
 
     def _ap(self, recs, n_gt):
         if not recs or n_gt == 0:

@@ -5,11 +5,10 @@ ImageDetIter over synthetic box data offline (pass --imglist/--root for
 real data in the det .lst format).
 """
 import argparse
-import os as _os
-import sys as _sys
-_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(

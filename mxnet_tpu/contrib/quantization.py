@@ -37,7 +37,8 @@ _FULL_PARAMS = {
 
 
 def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
-                   calib_ranges=None, quantize_mode="fake"):
+                   calib_ranges=None, quantize_mode="fake",
+                   offline_params=None, offline_out=None):
     """Clone `sym` with int8 boundaries on every quantizable node.
 
     quantize_mode='fake': quantize_v2 -> dequantize pairs on data/weight
@@ -49,14 +50,21 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
     calib_ranges: optional {(producer_name, slot): (min, max)} from
     calibration; quantize_v2 nodes without a range compute min/max at
     runtime (the reference's non-calibrated mode).
+
+    offline_params: {var_name: numpy array} — in full mode, weight/bias
+    variables in this dict are quantized OFFLINE (the reference's
+    quantize-params step): their quantize nodes become plain
+    '<name>_int8'/'_int8_min'/'_int8_max' variables whose values are
+    written into `offline_out`, so inference never re-quantizes weights.
     """
-    from ..symbol.symbol import Symbol, _Node
+    from ..symbol.symbol import Symbol, _Node, Variable
 
     if quantize_mode not in ("fake", "full"):
         raise MXNetError(f"quantize_mode must be fake|full, "
                          f"got {quantize_mode!r}")
     excluded = set(excluded_sym_names)
     mapping = {}
+    offline_params = offline_params or {}
 
     def make_quant(name, src, dtype="int8", key=None):
         params = {"out_type": dtype}
@@ -66,6 +74,34 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
             params["max_calib_range"] = float(hi)
         return _Node("_contrib_quantize_v2", name, params=params,
                      inputs=[src])
+
+    def make_offline(var_name, key):
+        """Quantize a parameter now (symmetric int8, same math as
+        quantize_v2) and emit variables carrying the results."""
+        a = np.asarray(offline_params[var_name], np.float32)
+        if calib_ranges and key in calib_ranges:
+            lo, hi = calib_ranges[key]
+        else:
+            lo, hi = float(a.min()), float(a.max())
+        real = max(abs(lo), abs(hi), 1e-20)
+        q = np.clip(np.round(a * (127.0 / real)), -127, 127) \
+            .astype(np.int8)
+        base = f"{var_name}_int8"
+        if offline_out is not None:
+            offline_out[base] = q
+            offline_out[base + "_min"] = np.float32(-real)
+            offline_out[base + "_max"] = np.float32(real)
+        nodes = [Variable(base)._outputs[0][0],
+                 Variable(base + "_min")._outputs[0][0],
+                 Variable(base + "_max")._outputs[0][0]]
+        # mimic a quantize node's (values, min, max) output triple
+
+        class _Triple:
+            pass
+
+        t = _Triple()
+        t.slots = [(nodes[0], 0), (nodes[1], 0), (nodes[2], 0)]
+        return t
 
     def cloned(node):
         if id(node) in mapping:
@@ -82,23 +118,24 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
             # Range keys use the ORIGINAL producer name — a chained
             # quantizable producer's clone is its '<name>_dequantize'
             # node, which calibration never saw.
-            qins = []
+            qslots = []  # per input: [(node, slot) x3] = values/min/max
             for i, ((src_node, src_slot), (orig_src, orig_slot)) in \
                     enumerate(zip(new.inputs[:3], node.inputs[:3])):
-                q = make_quant(f"{node.name}_in{i}_quantize",
-                               (src_node, src_slot), quantized_dtype,
-                               key=(orig_src.name, orig_slot))
-                qins.append(q)
-            inputs = [(qins[0], 0), (qins[1], 0)]
-            inputs += [(qins[2], 0)] if len(qins) > 2 else                 [(qins[1], 0)]  # dummy bias slot for no_bias nodes
-            inputs += [(qins[0], 1), (qins[0], 2), (qins[1], 1),
-                       (qins[1], 2)]
-            b = qins[2] if len(qins) > 2 else qins[1]
-            inputs += [(b, 1), (b, 2)]
+                key = (orig_src.name, orig_slot)
+                if orig_src.is_var and orig_src.name in offline_params:
+                    qslots.append(make_offline(orig_src.name, key).slots)
+                else:
+                    q = make_quant(f"{node.name}_in{i}_quantize",
+                                   (src_node, src_slot), quantized_dtype,
+                                   key=key)
+                    qslots.append([(q, 0), (q, 1), (q, 2)])
+            d, w = qslots[0], qslots[1]
+            b = qslots[2] if len(qslots) > 2 else qslots[1]
+            inputs = [d[0], w[0], b[0], d[1], d[2], w[1], w[2], b[1], b[2]]
             qparams = {k: node.params[k]
                        for k in _FULL_PARAMS[node.op]
                        if k in node.params}
-            if len(qins) <= 2:
+            if len(qslots) <= 2:
                 qparams["no_bias"] = True
             qnode = _Node(_FULL_OPS[node.op], f"{node.name}_int8",
                           params=qparams, inputs=inputs)
@@ -124,62 +161,47 @@ def quantize_graph(sym, excluded_sym_names=(), quantized_dtype="int8",
     return Symbol(outputs)
 
 
-def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
-                    calib_data, num_calib_examples, logger=None):
-    """Naive calibration: run the fp32 graph over calib batches recording
-    per-producer min/max (contrib/quantization.py _LayerOutputCollector)."""
-    from .. import context as ctx_mod
-    from ..executor import Executor  # noqa: F401  (bind path)
-
+def _quant_targets(sym):
+    """(producer_name, slot) keys needing ranges: data, weight, and (for
+    the full-int8 kernels) bias inputs of every quantizable node."""
     targets = set()
     for node in sym._topo_nodes():
         if node.op in _QUANTIZABLE:
-            # data, weight, and (for the full-int8 kernels) bias
             for n, s in node.inputs[:3]:
                 targets.add((n.name, s))
+    return targets
 
-    ranges = {}
-    # executor monitor names outputs "<node>_output[<i>]"
-    name_of = {}
-    for node_name, slot in targets:
-        mon = (f"{node_name}_output" if slot == 0
-               else f"{node_name}_output{slot}")
-        name_of[mon] = (node_name, slot)
 
-    def tap(mon_name, arr):
-        key = name_of.get(mon_name)
-        if key is None:
-            return
-        a = arr.asnumpy()
-        lo, hi = float(a.min()), float(a.max())
-        cur = ranges.get(key)
-        ranges[key] = ((lo, hi) if cur is None
-                       else (min(cur[0], lo), max(cur[1], hi)))
+def _monitor_names(targets):
+    """Executor monitor names outputs "<node>_output[<i>]"."""
+    return {(f"{name}_output" if slot == 0 else f"{name}_output{slot}"):
+            (name, slot) for name, slot in targets}
 
-    # range of weights/vars straight from params
-    for (name, slot) in targets:
-        if name in arg_params:
-            a = arg_params[name].asnumpy()
-            ranges[(name, slot)] = (float(a.min()), float(a.max()))
 
-    def _expand(key, a):
-        lo, hi = ranges.get(key, (np.inf, -np.inf))
-        ranges[key] = (min(lo, float(a.min())), max(hi, float(a.max())))
+def _calibration_forward(sym, arg_params, aux_params, data_names,
+                         label_names, calib_data, num_calib_examples,
+                         tap, on_batch=None):
+    """Shared calibration loop: bind once with a monitor callback, feed
+    each calib batch (labels synthesized as zeros), honor the example
+    cutoff. `tap(mon_name, arr)` observes every node output; `on_batch`
+    observes the raw input batch."""
+    from .. import context as ctx_mod
 
     seen = 0
     ex = None
     calib_data.reset()
     for batch in calib_data:
-        args = dict(arg_params)
-        for n, d in zip(data_names, batch.data):
-            args[n] = d
-            _expand((n, 0), d.asnumpy())
-        for ln in label_names or ():
-            if ln in sym.list_arguments() and ln not in args:
-                from ..ndarray import ndarray as _nd
-
-                args[ln] = _nd.zeros((batch.data[0].shape[0],))
+        if on_batch is not None:
+            on_batch(batch)
         if ex is None:  # bind once; later batches just feed new inputs
+            args = dict(arg_params)
+            for n, d in zip(data_names, batch.data):
+                args[n] = d
+            for ln in label_names or ():
+                if ln in sym.list_arguments() and ln not in args:
+                    from ..ndarray import ndarray as _nd
+
+                    args[ln] = _nd.zeros((batch.data[0].shape[0],))
             ex = sym.bind(ctx_mod.current_context(), args,
                           aux_states=dict(aux_params) if aux_params
                           else None)
@@ -191,6 +213,38 @@ def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
         seen += batch.data[0].shape[0]
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
+
+
+def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
+                    calib_data, num_calib_examples, logger=None):
+    """Naive calibration: run the fp32 graph over calib batches recording
+    per-producer min/max (contrib/quantization.py _LayerOutputCollector)."""
+    targets = _quant_targets(sym)
+    name_of = _monitor_names(targets)
+    ranges = {}
+
+    def _expand(key, a):
+        lo, hi = ranges.get(key, (np.inf, -np.inf))
+        ranges[key] = (min(lo, float(a.min())), max(hi, float(a.max())))
+
+    def tap(mon_name, arr):
+        key = name_of.get(mon_name)
+        if key is not None:
+            _expand(key, arr.asnumpy())
+
+    # range of weights/vars straight from params
+    for (name, slot) in targets:
+        if name in arg_params:
+            a = arg_params[name].asnumpy()
+            ranges[(name, slot)] = (float(a.min()), float(a.max()))
+
+    def on_batch(batch):
+        for n, d in zip(data_names, batch.data):
+            _expand((n, 0), d.asnumpy())
+
+    _calibration_forward(sym, arg_params, aux_params, data_names,
+                         label_names, calib_data, num_calib_examples,
+                         tap, on_batch)
     return ranges
 
 
@@ -272,53 +326,25 @@ def _collect_entropy_ranges(sym, arg_params, aux_params, data_names,
     max_abs = {k: max(abs(naive[k][0]), abs(naive[k][1]), 1e-20)
                for k in act_keys}
     hists = {k: np.zeros(num_bins, np.int64) for k in act_keys}
+    name_of = _monitor_names(act_keys)
 
-    from .. import context as ctx_mod
-
-    name_of = {}
-    for node_name, slot in act_keys:
-        mon = (f"{node_name}_output" if slot == 0
-               else f"{node_name}_output{slot}")
-        name_of[mon] = (node_name, slot)
+    def add_hist(key, a):
+        hists[key] += np.histogram(np.abs(a).ravel(), bins=num_bins,
+                                   range=(0.0, max_abs[key]))[0]
 
     def tap(mon_name, arr):
         key = name_of.get(mon_name)
-        if key is None:
-            return
-        a = np.abs(arr.asnumpy()).ravel()
-        hists[key] += np.histogram(a, bins=num_bins,
-                                   range=(0.0, max_abs[key]))[0]
+        if key is not None:
+            add_hist(key, arr.asnumpy())
 
-    seen = 0
-    ex = None
-    calib_data.reset()
-    for batch in calib_data:
+    def on_batch(batch):
         for n, d in zip(data_names, batch.data):
-            key = (n, 0)
-            if key in hists:
-                a = np.abs(d.asnumpy()).ravel()
-                hists[key] += np.histogram(
-                    a, bins=num_bins, range=(0.0, max_abs[key]))[0]
-        if ex is None:
-            args = dict(arg_params)
-            for n, d in zip(data_names, batch.data):
-                args[n] = d
-            for ln in label_names or ():
-                if ln in sym.list_arguments() and ln not in args:
-                    from ..ndarray import ndarray as _nd
+            if (n, 0) in hists:
+                add_hist((n, 0), d.asnumpy())
 
-                    args[ln] = _nd.zeros((batch.data[0].shape[0],))
-            ex = sym.bind(ctx_mod.current_context(), args,
-                          aux_states=dict(aux_params) if aux_params
-                          else None)
-            ex.set_monitor_callback(tap, monitor_all=True)
-            ex.forward(is_train=False)
-        else:
-            ex.forward(is_train=False,
-                       **{n: d for n, d in zip(data_names, batch.data)})
-        seen += batch.data[0].shape[0]
-        if num_calib_examples is not None and seen >= num_calib_examples:
-            break
+    _calibration_forward(sym, arg_params, aux_params, data_names,
+                         label_names, calib_data, num_calib_examples,
+                         tap, on_batch)
 
     ranges = dict(naive)  # params keep exact min/max
     for k in act_keys:
@@ -365,6 +391,24 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     if quantize_mode == "full" and quantized_dtype != "int8":
         raise MXNetError("quantize_mode='full' kernels are symmetric "
                          "int8; use quantized_dtype='int8'")
+    if quantize_mode == "full":
+        # quantize weights/biases OFFLINE (the reference's params step):
+        # inference graphs carry int8 params, not per-step re-quantization
+        from ..ndarray import ndarray as _nd
+
+        offline_in = {k: v.asnumpy() for k, v in arg_params.items()}
+        offline_out = {}
+        qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype,
+                              ranges, quantize_mode=quantize_mode,
+                              offline_params=offline_in,
+                              offline_out=offline_out)
+        new_args = {k: _nd.array(v, dtype=v.dtype)
+                    for k, v in offline_out.items()}
+        live = set(qsym.list_arguments())
+        for k, v in arg_params.items():
+            if k in live:  # fp32 params still consumed (e.g. excluded ops)
+                new_args[k] = v
+        return qsym, new_args, aux_params
     qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype, ranges,
                           quantize_mode=quantize_mode)
     return qsym, arg_params, aux_params

@@ -180,5 +180,8 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         real_b = _int8_range(min_bias.reshape(()), max_bias.reshape(()))
         bias_fp = bias.astype(jnp.float32) * (real_b / 127.0)
         bias_i32 = jnp.round(bias_fp / level).astype(jnp.int32)
-        out = out + bias_i32.reshape((1, -1) + (1,) * sdims)
+        if layout and layout[1] != "C":  # channels-last
+            out = out + bias_i32
+        else:
+            out = out + bias_i32.reshape((1, -1) + (1,) * sdims)
     return out, lo, hi
