@@ -2,7 +2,10 @@
 
 Capability parity with python/mxnet/attribute.py (AttrScope :28) and its
 uses: `with mx.AttrScope(ctx_group='stage1', lr_mult='0.1'):` stamps every
-node created in the scope. On TPU, `ctx_group` no longer drives manual
+node created in the scope. `ctx_group` + `bind(group2ctx=...)` gives the
+reference's manual model-parallel placement (executor.py resolves groups
+to jax devices and inserts cross-device transfers); it also no longer
+solely drives manual
 device placement (GSPMD shardings do — SURVEY.md §2.3 model parallelism
 row); the attrs still flow to `Symbol.attr_dict()` where
 `Module.init_optimizer` consumes `__lr_mult__`/`__wd_mult__`, and

@@ -23,9 +23,18 @@ from .ops import registry as _registry
 __all__ = ["Executor"]
 
 
-def _graph_program(symbol):
+def _graph_program(symbol, placement=None):
     """Build (pure_fn, arg_names, aux_names, out_count). pure_fn maps
-    (list arg_vals, list aux_vals, bool is_train) -> (outs, new_aux_vals)."""
+    (list arg_vals, list aux_vals, bool is_train) -> (outs, new_aux_vals).
+
+    placement: optional {node_name: jax.Device} from bind(group2ctx=...) —
+    the reference's manual model parallelism (symbol.py:1551,
+    graph_executor.cc:1961 cross_device_copy insertion). Each placed
+    node's inputs are device_put to its device (the cross-device copy);
+    placed programs run eagerly, like the reference's per-op engine
+    dispatch."""
+    import jax
+
     nodes = symbol._topo_nodes()
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
@@ -54,6 +63,10 @@ def _graph_program(symbol):
                     env[(id(n), 0)] = arg_vals[arg_pos[n.name]]
         for (n, op, params, has_train) in ops_meta:
             ins = [env[(id(i), s)] for i, s in n.inputs]
+            if placement:
+                dev = placement.get(n.name)
+                if dev is not None:
+                    ins = [jax.device_put(x, dev) for x in ins]
             p = dict(params)
             if has_train:
                 p["_train"] = is_train
@@ -88,7 +101,8 @@ def _alloc_for_name(name, shape, ctx, dtype=_np.float32):
 
 
 class Executor:
-    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict):
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                 group2ctx=None):
         import jax
 
         self._symbol = symbol
@@ -96,7 +110,22 @@ class Executor:
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict
         self.aux_dict = aux_dict
-        pure_fn, self._arg_names, self._aux_names, self._n_out = _graph_program(symbol)
+        # group2ctx (reference symbol.py:1551-1654 + graph_executor.cc
+        # cross_device_copy): resolve each node's __ctx_group__ attr to a
+        # jax device; placed graphs run eagerly with per-node transfers —
+        # the same per-op dispatch model the reference's engine used
+        placement = None
+        if group2ctx:
+            placement = {}
+            for n in symbol._topo_nodes():
+                g = (n.attrs or {}).get("__ctx_group__")
+                if not n.is_var and g in group2ctx:
+                    placement[n.name] = group2ctx[g].jax_device()
+            placement = placement or None
+        self._placement = placement
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        pure_fn, self._arg_names, self._aux_names, self._n_out = \
+            _graph_program(symbol, placement)
         self._pure = pure_fn
         if isinstance(grad_req, str):
             grad_req = {n: grad_req for n in self._arg_names}
@@ -109,7 +138,9 @@ class Executor:
         def fwd(arg_vals, aux_vals, is_train):
             return pure_fn(arg_vals, aux_vals, is_train)
 
-        self._jit_fwd = jax.jit(fwd, static_argnums=(2,))
+        # placed graphs cannot be one single-device XLA program
+        self._jit_fwd = (fwd if placement
+                         else jax.jit(fwd, static_argnums=(2,)))
 
         diff_idx = [self._arg_names.index(n) for n in self._diff_names]
 
@@ -132,7 +163,7 @@ class Executor:
             grads = vjp_fn(tuple(head_grads))
             return outs, list(grads), new_aux
 
-        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        self._jit_fwd_bwd = fwd_bwd if placement else jax.jit(fwd_bwd)
         self._outputs = None
         self._pending_train = False
         self.monitor_callback = None
@@ -216,6 +247,14 @@ class Executor:
         else:
             out_grads = [out_grads] if isinstance(out_grads, NDArray) else list(out_grads)
             heads = [g._data for g in out_grads]
+        if self._placement:
+            # head gradients must start on their output's placed device —
+            # jax transpose rules don't insert cross-device transfers
+            import jax
+
+            heads = [jax.device_put(g, self._placement[n.name])
+                     if n.name in self._placement else g
+                     for g, (n, _) in zip(heads, self._symbol._outputs)]
         outs, grads, new_aux = self._jit_fwd_bwd(arg_vals, aux_vals, heads)
         self._outputs = [NDArray(o, self._ctx) for o in outs]
         for n, v in zip(self._aux_names, new_aux):
@@ -254,11 +293,13 @@ class Executor:
         grad_dict = {n: nd_zeros(new_args[n].shape, self._ctx)
                      for n in self._diff_names}
         return Executor(self._symbol, self._ctx, new_args, grad_dict,
-                        self.grad_req, self.aux_dict)
+                        self.grad_req, self.aux_dict,
+                        group2ctx=self._group2ctx)
 
     # ------------------------------------------------------------- builders
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req="write", **shape_kwargs):
+    def _simple_bind(symbol, ctx, grad_req="write", group2ctx=None,
+                     **shape_kwargs):
         ctx = ctx or current_context()
         arg_shapes, _, aux_shapes = symbol._infer_shape_impl(partial=False,
                                                              **shape_kwargs)
@@ -278,11 +319,12 @@ class Executor:
         aux_dict = {}
         for n, s in zip(aux_names, aux_shapes):
             aux_dict[n] = _alloc_for_name(n, s or (2,), ctx)
-        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict)
+        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                        group2ctx=group2ctx)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad=None, grad_req="write",
-              aux_states=None):
+              aux_states=None, group2ctx=None):
         ctx = ctx or current_context()
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -316,7 +358,8 @@ class Executor:
             aux_dict = dict(zip(aux_names, aux_states))
         else:
             aux_dict = dict(aux_states)
-        return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict)
+        return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                        group2ctx=group2ctx)
 
 
 class _LazyOutputs(list):

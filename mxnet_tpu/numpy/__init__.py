@@ -158,26 +158,6 @@ def may_share_memory(a, b, max_work=None):
     return _unwrap(a) is _unwrap(b)
 
 
-class linalg:
-    """mx.np.linalg (numpy/linalg.py parity) — delegates to jnp.linalg."""
-
-    @staticmethod
-    def _d(name):
-        def fn(*args, **kwargs):
-            import jax.numpy as jnp
-
-            args = [_unwrap(a) for a in args]
-            return _wrap(getattr(jnp.linalg, name)(*args, **kwargs))
-        return fn
-
-
-for _name in ["norm", "svd", "cholesky", "qr", "inv", "pinv", "det",
-              "slogdet", "solve", "lstsq", "eig", "eigh", "eigvals",
-              "eigvalsh", "matrix_rank", "matrix_power", "multi_dot",
-              "tensorinv", "tensorsolve"]:
-    setattr(linalg, _name, staticmethod(linalg._d(_name)))
-
-
 class random:
     """mx.np.random (numpy/random.py parity) — seeded by mx.random.seed
     through the shared global key cell."""
@@ -244,6 +224,38 @@ class random:
 
         _r.shuffle(x, out=x)
         return None
+
+
+__all__ += ["pi", "e", "euler_gamma", "inf", "nan", "newaxis", "dtype",
+            "float16", "float32", "float64", "int8", "int16", "int32",
+            "int64", "uint8", "uint16", "uint32", "uint64", "bool_"]
+
+
+class _SubModule:
+    """Wrapped jnp submodule (linalg / fft): functions take/return
+    mx.np.ndarray (parity: python/mxnet/numpy/linalg.py, fft)."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def __getattr__(self, fname):
+        sub = getattr(_jnp(), self._name)
+        jfn = getattr(sub, fname)  # AttributeError propagates naturally
+
+        def fn(*args, **kwargs):
+            args = [_unwrap(a) if not isinstance(a, (list, tuple))
+                    else type(a)(_unwrap(x) for x in a) for a in args]
+            kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+            return _wrap(jfn(*args, **kwargs))
+
+        fn.__name__ = f"{self._name}.{fname}"
+        setattr(self, fname, fn)
+        return fn
+
+
+linalg = _SubModule("linalg")
+fft = _SubModule("fft")
+__all__ += ["linalg", "fft"]
 
 
 def __getattr__(name):

@@ -33,6 +33,8 @@ def _unwrap(x):
 
 def _wrap(x, ctx=None):
     """jax value -> mx.np.ndarray (scalars stay arrays; () shapes allowed)."""
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # NamedTuple (QR...)
+        return type(x)(*(_wrap(v, ctx) for v in x))
     if isinstance(x, (list, tuple)):
         return type(x)(_wrap(v, ctx) for v in x)
     if hasattr(x, "dtype") or isinstance(x, (int, float, complex, bool)):

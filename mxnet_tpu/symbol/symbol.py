@@ -343,13 +343,15 @@ class Symbol:
                     shared_exec=None, shared_buffer=None, **kwargs):
         from ..executor import Executor
 
-        return Executor._simple_bind(self, ctx, grad_req=grad_req, **kwargs)
+        return Executor._simple_bind(self, ctx, grad_req=grad_req,
+                                     group2ctx=group2ctx, **kwargs)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
 
-        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor._bind(self, ctx, args, args_grad, grad_req,
+                              aux_states, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         from ..context import current_context

@@ -83,3 +83,43 @@ class TestSetNp:
             mx.util.reset_np() if hasattr(mx.util, "reset_np") else \
                 mx.util.set_np(shape=False, array=False)
         assert not mx.util.is_np_array()
+
+
+# ------------------------------------------------------------------
+# round 4: constants, dtypes, wrapped linalg/fft submodules
+# ------------------------------------------------------------------
+
+def test_np_constants_and_dtypes():
+    assert abs(mx.np.pi - np.pi) < 1e-12
+    assert mx.np.inf == np.inf and np.isnan(mx.np.nan)
+    assert mx.np.newaxis is None
+    a = mx.np.zeros((2,), dtype=mx.np.float64)
+    assert str(a.dtype) in ("float64", "float32")  # x64 may be disabled
+    assert mx.np.dtype(mx.np.int32) == np.dtype("int32")
+
+
+def test_np_linalg_submodule():
+    a_np = np.array([[2.0, 0.3], [0.3, 1.0]], np.float32)
+    a = mx.np.array(a_np)
+    inv = mx.np.linalg.inv(a)
+    assert isinstance(inv, mx.np.ndarray)
+    np.testing.assert_allclose(np.asarray(inv.asnumpy()) @ a_np,
+                               np.eye(2), atol=1e-5)
+    assert abs(float(mx.np.linalg.det(a)) - np.linalg.det(a_np)) < 1e-5
+    w = mx.np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.sort(w.asnumpy()),
+                               np.sort(np.linalg.eigvalsh(a_np)), rtol=1e-5)
+    q, r = mx.np.linalg.qr(a)
+    np.testing.assert_allclose((q.asnumpy() @ r.asnumpy()), a_np, atol=1e-5)
+    n = mx.np.linalg.norm(a)
+    assert abs(float(n) - np.linalg.norm(a_np)) < 1e-5
+
+
+def test_np_fft_submodule():
+    x = np.random.RandomState(0).rand(8).astype(np.float32)
+    f = mx.np.fft.fft(mx.np.array(x))
+    assert isinstance(f, mx.np.ndarray)
+    np.testing.assert_allclose(f.asnumpy(), np.fft.fft(x), rtol=1e-4,
+                               atol=1e-4)
+    back = mx.np.fft.ifft(f)
+    np.testing.assert_allclose(back.asnumpy().real, x, rtol=1e-4, atol=1e-4)
