@@ -23,16 +23,17 @@ from .ops import registry as _registry
 __all__ = ["Executor"]
 
 
-def _graph_program(symbol, placement=None):
+def _graph_program(symbol, placement=None, default_device=None):
     """Build (pure_fn, arg_names, aux_names, out_count). pure_fn maps
     (list arg_vals, list aux_vals, bool is_train) -> (outs, new_aux_vals).
 
     placement: optional {node_name: jax.Device} from bind(group2ctx=...) —
     the reference's manual model parallelism (symbol.py:1551,
-    graph_executor.cc:1961 cross_device_copy insertion). Each placed
-    node's inputs are device_put to its device (the cross-device copy);
-    placed programs run eagerly, like the reference's per-op engine
-    dispatch."""
+    graph_executor.cc:1961 cross_device_copy insertion). Each node's
+    inputs are device_put to its device — unplaced nodes count as placed
+    on `default_device` (the bind ctx), like the reference's default
+    group — and placed programs run eagerly, like the reference's per-op
+    engine dispatch."""
     import jax
 
     nodes = symbol._topo_nodes()
@@ -64,7 +65,7 @@ def _graph_program(symbol, placement=None):
         for (n, op, params, has_train) in ops_meta:
             ins = [env[(id(i), s)] for i, s in n.inputs]
             if placement:
-                dev = placement.get(n.name)
+                dev = placement.get(n.name, default_device)
                 if dev is not None:
                     ins = [jax.device_put(x, dev) for x in ins]
             p = dict(params)
@@ -125,7 +126,8 @@ class Executor:
         self._placement = placement
         self._group2ctx = dict(group2ctx) if group2ctx else None
         pure_fn, self._arg_names, self._aux_names, self._n_out = \
-            _graph_program(symbol, placement)
+            _graph_program(symbol, placement,
+                           ctx.jax_device() if placement else None)
         self._pure = pure_fn
         if isinstance(grad_req, str):
             grad_req = {n: grad_req for n in self._arg_names}
@@ -305,7 +307,19 @@ class Executor:
                                                              **shape_kwargs)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
-        arg_dict = {n: _alloc_for_name(n, s, ctx)
+        # group2ctx: allocate each variable on its consumer's group device
+        # (reference simple_bind ctx resolution) so placed stages don't
+        # re-transfer weights every iteration
+        var_ctx = {}
+        if group2ctx:
+            for node in symbol._topo_nodes():
+                g = (node.attrs or {}).get("__ctx_group__")
+                if node.is_var or g not in group2ctx:
+                    continue
+                for (inp, _) in node.inputs:
+                    if inp.is_var and inp.name not in var_ctx:
+                        var_ctx[inp.name] = group2ctx[g]
+        arg_dict = {n: _alloc_for_name(n, s, var_ctx.get(n, ctx))
                     for n, s in zip(arg_names, arg_shapes)}
         if isinstance(grad_req, str):
             req = {n: grad_req for n in arg_names}
@@ -313,7 +327,8 @@ class Executor:
             req = dict(zip(arg_names, grad_req))
         else:
             req = dict(grad_req)
-        grad_dict = {n: nd_zeros(s, ctx) for n, s in zip(arg_names, arg_shapes)
+        grad_dict = {n: nd_zeros(s, var_ctx.get(n, ctx))
+                     for n, s in zip(arg_names, arg_shapes)
                      if req.get(n, "write") != "null"}
         # aux shapes may be underdetermined (rng keys): infer or allocate
         aux_dict = {}
@@ -345,19 +360,21 @@ class Executor:
             grad_dict = dict(args_grad)
         if aux_states is None:
             aux_dict = {}
-            for n in aux_names:
-                # shape from inference given arg shapes
-                shapes = {k: tuple(v.shape) for k, v in arg_dict.items()}
-                _, _, aux_shapes = symbol._infer_shape_impl(partial=True, **shapes)
-                for an, s in zip(aux_names, aux_shapes):
-                    aux_dict[an] = _alloc_for_name(an, s or (2,), ctx)
-                break
-            else:
-                aux_dict = {}
         elif isinstance(aux_states, (list, tuple)):
             aux_dict = dict(zip(aux_names, aux_states))
         else:
             aux_dict = dict(aux_states)
+        missing_aux = [n for n in aux_names if n not in aux_dict]
+        if missing_aux:
+            # partial aux dicts are common (e.g. ONNX-imported graphs have
+            # BN stats but not auto-created Dropout rng keys): allocate the
+            # rest like the aux_states=None path does
+            shapes = {k: tuple(v.shape) for k, v in arg_dict.items()}
+            _, _, aux_shapes = symbol._infer_shape_impl(partial=True,
+                                                        **shapes)
+            for an, s in zip(aux_names, aux_shapes):
+                if an in missing_aux:
+                    aux_dict[an] = _alloc_for_name(an, s or (2,), ctx)
         return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
                         group2ctx=group2ctx)
 

@@ -47,13 +47,16 @@ def _jnp():
     return jnp
 
 
+def _call_wrapped(jnp_fn, args, kwargs):
+    args = [_unwrap(a) if not isinstance(a, (list, tuple))
+            else type(a)(_unwrap(x) for x in a) for a in args]
+    kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+    return _wrap(jnp_fn(*args, **kwargs))
+
+
 def _delegate(name):
     def fn(*args, **kwargs):
-        jnp_fn = getattr(_jnp(), name)
-        args = [_unwrap(a) if not isinstance(a, (list, tuple))
-                else type(a)(_unwrap(x) for x in a) for a in args]
-        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        return _wrap(jnp_fn(*args, **kwargs))
+        return _call_wrapped(getattr(_jnp(), name), args, kwargs)
 
     fn.__name__ = name
     fn.__qualname__ = name
@@ -243,10 +246,7 @@ class _SubModule:
         jfn = getattr(sub, fname)  # AttributeError propagates naturally
 
         def fn(*args, **kwargs):
-            args = [_unwrap(a) if not isinstance(a, (list, tuple))
-                    else type(a)(_unwrap(x) for x in a) for a in args]
-            kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-            return _wrap(jfn(*args, **kwargs))
+            return _call_wrapped(jfn, args, kwargs)
 
         fn.__name__ = f"{self._name}.{fname}"
         setattr(self, fname, fn)

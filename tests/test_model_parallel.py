@@ -103,3 +103,50 @@ def test_group2ctx_survives_simple_bind_and_reshape():
     o = ex2.forward(is_train=False)[0]
     assert o.shape == (4, 4)
     assert list(o.data_.devices()) == [jax.devices("cpu")[1]]
+
+
+def test_group2ctx_unplaced_merge_node():
+    """Nodes outside any ctx_group act as the default group on the bind
+    ctx (reference: cross_device_copy back to the default device)."""
+    import jax
+
+    if len(jax.devices("cpu")) < 2:
+        import pytest
+
+        pytest.skip("needs 2 devices")
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="stage0"):
+        a = sym.FullyConnected(data, num_hidden=4, name="fca")
+    with mx.AttrScope(ctx_group="stage1"):
+        b = sym.FullyConnected(data, num_hidden=4, name="fcb")
+    out = a + b  # created outside any scope: default group
+    rng = np.random.RandomState(1)
+    args = {"data": nd.array(rng.rand(2, 5).astype(np.float32)),
+            "fca_weight": nd.array(rng.rand(4, 5).astype(np.float32)),
+            "fca_bias": nd.zeros((4,)),
+            "fcb_weight": nd.array(rng.rand(4, 5).astype(np.float32)),
+            "fcb_bias": nd.zeros((4,))}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    g2c = {"stage0": mx.Context("cpu", 0), "stage1": mx.Context("cpu", 1)}
+    ex = out.bind(mx.cpu(), args, args_grad=grads, group2ctx=g2c)
+    o = ex.forward(is_train=True)
+    ex.backward(nd.ones((2, 4)))
+    ref = out.bind(mx.cpu(), args).forward()[0]
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert np.abs(grads["fcb_weight"].asnumpy()).sum() > 0
+
+
+def test_group2ctx_simple_bind_allocates_on_group_device():
+    import jax
+
+    if len(jax.devices("cpu")) < 2:
+        import pytest
+
+        pytest.skip("needs 2 devices")
+    out = _two_stage_symbol()
+    g2c = {"stage0": mx.Context("cpu", 0), "stage1": mx.Context("cpu", 1)}
+    ex = out.simple_bind(mx.cpu(), group2ctx=g2c, data=(2, 5))
+    w2 = ex.arg_dict["fc2_weight"]
+    assert list(w2.data_.devices()) == [jax.devices("cpu")[1]], \
+        "stage-1 weight not allocated on its group device"

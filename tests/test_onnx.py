@@ -192,26 +192,31 @@ def test_unsupported_op_raises(tmp_path):
 
 
 @pytest.mark.parametrize("ctor", ["squeezenet1_0", "mobilenet_v1_025",
-                                  "alexnet"])
+                                  "alexnet", "vgg11", "densenet121",
+                                  "inception_v3"])
 def test_model_zoo_roundtrip(ctor, tmp_path):
     """Model-zoo export→import forward equivalence (224² input)."""
     from mxnet_tpu.gluon.model_zoo import vision
 
     fn = {"squeezenet1_0": getattr(vision, "squeezenet1_0", None),
           "mobilenet_v1_025": getattr(vision, "mobilenet0_25", None),
-          "alexnet": getattr(vision, "alexnet", None)}[ctor]
+          "alexnet": getattr(vision, "alexnet", None),
+          "vgg11": getattr(vision, "vgg11", None),
+          "densenet121": getattr(vision, "densenet121", None),
+          "inception_v3": getattr(vision, "inception_v3", None)}[ctor]
     if fn is None:
         pytest.skip(f"{ctor} not in zoo")
     net = fn(classes=10)
     net.initialize(mx.initializer.Xavier())
-    x = mx.nd.array(RNG.rand(1, 3, 224, 224).astype(np.float32))
+    size = 299 if ctor == "inception_v3" else 224
+    x = mx.nd.array(RNG.rand(1, 3, size, size).astype(np.float32))
     ref = net(x).asnumpy()
 
     data = sym.Variable("data")
     out = net(data)
     allp = {k: p.data() for k, p in net.collect_params().items()}
     path = str(tmp_path / f"{ctor}.onnx")
-    onnx_mxnet.export_model(out, allp, [(1, 3, 224, 224)],
+    onnx_mxnet.export_model(out, allp, [(1, 3, size, size)],
                             onnx_file_path=path)
     sym2, arg2, aux2 = onnx_mxnet.import_model(path)
     got = _eval_symbol(
