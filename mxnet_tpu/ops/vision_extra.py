@@ -395,3 +395,48 @@ def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
     sign = s.reshape(-1).astype(data.dtype)
     out = jnp.zeros((n, int(out_dim)), data.dtype)
     return out.at[:, idx].add(data * sign[None, :])
+
+
+# ------------------------------------------------------- small contrib tail
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Parity: src/operator/contrib/quadratic_op.cc (the tutorial op):
+    a*x^2 + b*x + c."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_index_array", no_grad=True, aliases=("index_array",))
+def _index_array(data, axes=None):
+    """Parity: src/operator/contrib/index_array.cc — per-element index
+    coordinates of `data` (optionally restricted to `axes`). The
+    reference emits int64; with x64 disabled jax arrays are int32
+    (ndarray-wide convention, ops/math.py)."""
+    shape = data.shape
+    sel = (tuple(range(len(shape))) if axes is None
+           else tuple(a if a >= 0 else a + len(shape) for a in axes))
+    import jax
+
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    # only materialize the selected axes' grids
+    return jnp.stack([jax.lax.broadcasted_iota(idt, shape, a)
+                      for a in sel], axis=-1)
+
+
+@register("_contrib_arange_like", no_grad=True, aliases=("arange_like",))
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Parity: src/operator/tensor/init_op.cc _contrib_arange_like —
+    arange shaped like `data` (or its `axis` extent), in `data`'s dtype
+    (ElemwiseType), with the reference's range_fwd repeat semantics
+    (start + (i // repeat) * step) in both branches."""
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        out_shape = data.shape
+    else:
+        ax = axis if axis >= 0 else axis + data.ndim
+        n = data.shape[ax]
+        out_shape = (n,)
+    i = jnp.arange(n) // max(int(repeat), 1)
+    return (start + step * i).astype(data.dtype).reshape(out_shape)

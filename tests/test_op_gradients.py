@@ -668,8 +668,9 @@ NONDIFF = {
     "_image_random_lighting",
     # stochastic op (gradient exercised via gluon tests, not FD-checkable)
     "Dropout",
-    # in-place index mutation utilities
-    "_contrib_index_copy", "_contrib_index_add",
+    # in-place index mutation utilities / integer index generators
+    "_contrib_index_copy", "_contrib_index_add", "_contrib_index_array",
+    "_contrib_arange_like",
     # eigendecomposition/QR: sign/ordering ambiguity breaks FD
     "linalg_syevd", "linalg_gelqf", "linalg_slogdet",
     # cast utilities (identity gradient, exercised everywhere via AMP)
@@ -701,7 +702,7 @@ EXPLICIT = {
     # tests/test_vision_extra.py finite-difference checks
     "BilinearSampler", "GridGenerator", "SpatialTransformer", "ROIPooling",
     "Correlation", "_contrib_DeformableConvolution", "_contrib_fft",
-    "_contrib_ifft", "_contrib_count_sketch",
+    "_contrib_ifft", "_contrib_count_sketch", "_contrib_quadratic",
 }
 
 

@@ -483,7 +483,8 @@ def test_coverage_fraction():
         "BilinearSampler", "GridGenerator", "SpatialTransformer",
         "ROIPooling", "Correlation", "_contrib_Proposal",
         "_contrib_DeformableConvolution", "_contrib_fft", "_contrib_ifft",
-        "_contrib_count_sketch",
+        "_contrib_count_sketch", "_contrib_quadratic",
+        "_contrib_index_array", "_contrib_arange_like",
         # test_image_ops.py
         "_image_to_tensor", "_image_normalize", "_image_flip_left_right",
         "_image_flip_top_bottom", "_image_random_flip_left_right",

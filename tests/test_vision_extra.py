@@ -366,3 +366,43 @@ def test_ifft_grad():
     out = getattr(sym, "_contrib_ifft")(sym.Variable("data"))
     check_numeric_gradient(out, {"data": _r(2, 8)}, numeric_eps=1e-3,
                            rtol=0.05, atol=0.02)
+
+
+def test_quadratic():
+    x = _r(2, 3)
+    out = mx.nd.contrib.quadratic(mx.nd.array(x), a=2.0, b=-1.0,
+                                  c=0.5).asnumpy()
+    assert_almost_equal(out, 2 * x * x - x + 0.5, rtol=1e-5, atol=1e-6)
+    osym = sym.contrib.quadratic(sym.Variable("data"), a=2.0, b=-1.0, c=0.5)
+    check_numeric_gradient(osym, {"data": x}, numeric_eps=1e-3, rtol=0.05,
+                           atol=0.02)
+
+
+def test_index_array():
+    x = np.zeros((2, 3), np.float32)
+    out = mx.nd.contrib.index_array(mx.nd.array(x)).asnumpy()
+    assert out.shape == (2, 3, 2)
+    assert out[1, 2, 0] == 1 and out[1, 2, 1] == 2
+    out2 = mx.nd.contrib.index_array(mx.nd.array(x), axes=(1,)).asnumpy()
+    assert out2.shape == (2, 3, 1)
+    np.testing.assert_array_equal(out2[:, :, 0], [[0, 1, 2], [0, 1, 2]])
+
+
+def test_arange_like():
+    x = np.zeros((2, 4), np.float32)
+    out = mx.nd.contrib.arange_like(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, np.arange(8, dtype=np.float32)
+                               .reshape(2, 4))
+    out2 = mx.nd.contrib.arange_like(mx.nd.array(x), start=2.0, step=0.5,
+                                     axis=1).asnumpy()
+    np.testing.assert_allclose(out2, [2.0, 2.5, 3.0, 3.5])
+    # reference range_fwd repeat semantics: start + (i // repeat) * step
+    out3 = mx.nd.contrib.arange_like(mx.nd.array(x), repeat=2).asnumpy()
+    np.testing.assert_allclose(out3.ravel(), [0, 0, 1, 1, 2, 2, 3, 3])
+    out4 = mx.nd.contrib.arange_like(mx.nd.array(x), axis=1,
+                                     repeat=2).asnumpy()
+    np.testing.assert_allclose(out4, [0, 0, 1, 1])
+    # dtype follows the input (ElemwiseType)
+    xi = np.zeros((3,), np.int32)
+    assert mx.nd.contrib.arange_like(mx.nd.array(xi, dtype="int32")
+                                     ).asnumpy().dtype == np.int32
