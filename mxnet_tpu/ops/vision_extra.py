@@ -415,8 +415,6 @@ def _index_array(data, axes=None):
     shape = data.shape
     sel = (tuple(range(len(shape))) if axes is None
            else tuple(a if a >= 0 else a + len(shape) for a in axes))
-    import jax
-
     idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     # only materialize the selected axes' grids
     return jnp.stack([jax.lax.broadcasted_iota(idt, shape, a)
@@ -440,3 +438,56 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
         out_shape = (n,)
     i = jnp.arange(n) // max(int(repeat), 1)
     return (start + step * i).astype(data.dtype).reshape(out_shape)
+
+
+@register("_contrib_hawkes_ll", num_outputs=2, aliases=("hawkes_ll",))
+def _hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Parity: src/operator/contrib/hawkes_ll.cc — log-likelihood of a
+    marked multivariate Hawkes process with exponential kernel.
+
+    mu (N,K) background rates; alpha/beta (K,) branching/decay; state
+    (N,K) initial intensity states; lags (N,T) inter-arrival times;
+    marks (N,T) int; valid_length (N,); max_time (N,). Returns
+    (loglike (N,), out_state (N,K)). The reference hand-writes the
+    backward; here jax differentiates through the lax.scan."""
+    from jax import lax
+
+    n, k = mu.shape
+    t_len = lags.shape[1]
+    marks_i = marks.astype(jnp.int32)
+    f32 = jnp.float32
+
+    def per_sample(mu_i, state0, lag_i, mark_i, vl, mt):
+        def step(carry, inp):
+            ll, t, last, st = carry
+            j, d_lag, ci = inp
+            valid = j < vl
+            # sanitize padded steps BEFORE the log/exp chain: with plain
+            # where-masking, a padded step whose lam <= 0 (or NaN lag
+            # padding) poisons the VJP through the untaken branch
+            d_lag = jnp.where(valid, d_lag, 0.0)
+            t_new = t + d_lag
+            d = t_new - last[ci]
+            ed = jnp.exp(-beta[ci] * d)
+            lam = mu_i[ci] + alpha[ci] * beta[ci] * st[ci] * ed
+            lam = jnp.where(valid, lam, 1.0)
+            comp = mu_i[ci] * d + alpha[ci] * st[ci] * (1.0 - ed)
+            ll = ll + jnp.where(valid, jnp.log(lam) - comp, 0.0)
+            st = st.at[ci].set(jnp.where(valid, 1.0 + st[ci] * ed, st[ci]))
+            last = last.at[ci].set(jnp.where(valid, t_new, last[ci]))
+            t = jnp.where(valid, t_new, t)
+            return (ll, t, last, st), None
+
+        init = (jnp.asarray(0.0, f32), jnp.asarray(0.0, f32),
+                jnp.zeros(k, f32), state0.astype(f32))
+        (ll, _, last, st), _ = lax.scan(
+            step, init,
+            (jnp.arange(t_len), lag_i.astype(f32), mark_i))
+        # remaining compensator up to max_time + final state decay
+        d = mt - last
+        ed = jnp.exp(-beta.astype(f32) * d)
+        rem = mu_i.astype(f32) * d + alpha.astype(f32) * st * (1.0 - ed)
+        return (ll - rem.sum()).astype(mu.dtype), (ed * st).astype(mu.dtype)
+
+    return jax.vmap(per_sample)(mu, state, lags, marks_i,
+                                valid_length, max_time)

@@ -406,3 +406,93 @@ def test_arange_like():
     xi = np.zeros((3,), np.int32)
     assert mx.nd.contrib.arange_like(mx.nd.array(xi, dtype="int32")
                                      ).asnumpy().dtype == np.int32
+
+
+def _np_hawkes(mu, alpha, beta, state, lags, marks, vl, mt):
+    n, k = mu.shape
+    lls = np.zeros(n)
+    out_state = state.astype(np.float64).copy()
+    for i in range(n):
+        t = 0.0
+        last = np.zeros(k)
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = np.exp(-beta[ci] * d)
+            lam = mu[i, ci] + alpha[ci] * beta[ci] * out_state[i, ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * out_state[i, ci] * (1 - ed)
+            lls[i] += np.log(lam) - comp
+            out_state[i, ci] = 1 + out_state[i, ci] * ed
+            last[ci] = t
+        d = mt[i] - last
+        ed = np.exp(-beta * d)
+        lls[i] -= (mu[i] * d + alpha * out_state[i] * (1 - ed)).sum()
+        out_state[i] = ed * out_state[i]
+    return lls, out_state
+
+
+def test_hawkes_ll_forward():
+    rng = np.random.RandomState(4)
+    N, K, T = 2, 3, 6
+    mu = rng.rand(N, K).astype(np.float32) * 0.5 + 0.2
+    alpha = rng.rand(K).astype(np.float32) * 0.5
+    beta = rng.rand(K).astype(np.float32) + 0.5
+    state = rng.rand(N, K).astype(np.float32)
+    lags = rng.rand(N, T).astype(np.float32) * 0.5 + 0.1
+    marks = rng.randint(0, K, (N, T)).astype(np.float32)
+    vl = np.array([6, 4], np.float32)
+    mt = np.array([5.0, 4.0], np.float32)
+    ll, st = mx.nd.contrib.hawkes_ll(
+        mx.nd.array(mu), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(vl), mx.nd.array(mt))
+    rll, rst = _np_hawkes(mu, alpha, beta, state, lags, marks, vl, mt)
+    assert_almost_equal(ll.asnumpy(), rll.astype(np.float32), rtol=1e-4,
+                        atol=1e-4)
+    assert_almost_equal(st.asnumpy(), rst.astype(np.float32), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_hawkes_ll_grad():
+    rng = np.random.RandomState(5)
+    N, K, T = 1, 2, 4
+    loc = {"mu": rng.rand(N, K).astype(np.float32) * 0.5 + 0.3,
+           "alpha": rng.rand(K).astype(np.float32) * 0.4 + 0.1,
+           "beta": rng.rand(K).astype(np.float32) + 0.8,
+           "state": rng.rand(N, K).astype(np.float32),
+           "lags": rng.rand(N, T).astype(np.float32) * 0.4 + 0.1,
+           "marks": rng.randint(0, K, (N, T)).astype(np.float32),
+           "vl": np.array([4], np.float32),
+           "mt": np.array([3.0], np.float32)}
+    out = getattr(sym.contrib, "hawkes_ll")(
+        *[sym.Variable(nm) for nm in
+          ("mu", "alpha", "beta", "state", "lags", "marks", "vl", "mt")])
+    check_numeric_gradient(out[0], loc, grad_nodes=["mu", "alpha", "beta"],
+                           numeric_eps=1e-3, rtol=0.08, atol=0.03)
+
+
+def test_hawkes_ll_padded_gradients_finite():
+    """Padded steps hitting a zero-rate channel must not poison gradients
+    (where-mask + log VJP interaction)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.vision_extra import _hawkes_ll
+
+    mu = jnp.array([[0.0, 0.5]], jnp.float32)  # channel 0 has zero rate
+    alpha = jnp.array([0.2, 0.2], jnp.float32)
+    beta = jnp.array([1.0, 1.0], jnp.float32)
+    state = jnp.zeros((1, 2), jnp.float32)
+    lags = jnp.array([[0.3, 0.4, 0.0, 0.0]], jnp.float32)
+    marks = jnp.array([[1, 1, 0, 0]], jnp.float32)  # padding on channel 0
+    vl = jnp.array([2.0], jnp.float32)
+    mt = jnp.array([1.0], jnp.float32)
+
+    def loss(mu, alpha, beta):
+        ll, _ = _hawkes_ll(mu, alpha, beta, state, lags, marks, vl, mt)
+        return ll.sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(mu, alpha, beta)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all(), g
