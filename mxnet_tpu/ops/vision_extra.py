@@ -104,6 +104,12 @@ def _spatial_transformer(data, loc, target_shape=(0, 0),
 
 # ----------------------------------------------------------------- ROI pool
 
+def _round_half_away(x):
+    """C round(): half away from zero (jnp.round is half-to-even, which
+    shifts bin geometry for .5-valued ROI coords)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
 @register("ROIPooling")
 def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
     """Parity: src/operator/roi_pooling.cc. rois (R,5) =
@@ -115,10 +121,10 @@ def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale)
-        y1 = jnp.round(roi[2] * spatial_scale)
-        x2 = jnp.round(roi[3] * spatial_scale)
-        y2 = jnp.round(roi[4] * spatial_scale)
+        x1 = _round_half_away(roi[1] * spatial_scale)
+        y1 = _round_half_away(roi[2] * spatial_scale)
+        x2 = _round_half_away(roi[3] * spatial_scale)
+        y2 = _round_half_away(roi[4] * spatial_scale)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         bin_h = rh / ph
@@ -450,8 +456,6 @@ def _hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
     marks (N,T) int; valid_length (N,); max_time (N,). Returns
     (loglike (N,), out_state (N,K)). The reference hand-writes the
     backward; here jax differentiates through the lax.scan."""
-    from jax import lax
-
     n, k = mu.shape
     t_len = lags.shape[1]
     marks_i = marks.astype(jnp.int32)
@@ -480,7 +484,7 @@ def _hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
 
         init = (jnp.asarray(0.0, f32), jnp.asarray(0.0, f32),
                 jnp.zeros(k, f32), state0.astype(f32))
-        (ll, _, last, st), _ = lax.scan(
+        (ll, _, last, st), _ = jax.lax.scan(
             step, init,
             (jnp.arange(t_len), lag_i.astype(f32), mark_i))
         # remaining compensator up to max_time + final state decay
@@ -491,3 +495,96 @@ def _hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
 
     return jax.vmap(per_sample)(mu, state, lags, marks_i,
                                 valid_length, max_time)
+
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=2,
+          aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=None, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Parity: src/operator/contrib/deformable_psroi_pooling.cc (R-FCN /
+    Deformable ConvNets): position-sensitive ROI pooling whose bin
+    sampling positions shift by learned offsets. data (N, out_dim*G*G,
+    H, W); rois (R, 5); trans (R, 2*num_classes, part, part). Returns
+    (out (R, out_dim, P, P), top_count). Sampling is clamped bilinear,
+    so gradients flow to data and trans via autodiff (the reference
+    hand-writes both backwards)."""
+    n, c_in, h, w = data.shape
+    od = int(output_dim)
+    g = int(group_size)
+    p = int(pooled_size)
+    s = int(sample_per_part)
+    part = int(part_size) or p
+    if trans is None:
+        # reference accepts 2 inputs when no_trans (in_expected check,
+        # deformable_psroi_pooling-inl.h:90)
+        assert no_trans, "trans input required unless no_trans=True"
+        trans = jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = od // num_classes
+
+    ph = jnp.arange(p, dtype=jnp.float32)[:, None]          # (P,1)
+    pw = jnp.arange(p, dtype=jnp.float32)[None, :]          # (1,P)
+    part_h = jnp.clip(jnp.floor(ph / p * part), 0, part - 1).astype(jnp.int32)
+    part_w = jnp.clip(jnp.floor(pw / p * part), 0, part - 1).astype(jnp.int32)
+    gh = jnp.clip(jnp.floor(ph * g / p), 0, g - 1).astype(jnp.int32)
+    gw = jnp.clip(jnp.floor(pw * g / p), 0, g - 1).astype(jnp.int32)
+    ctop = jnp.arange(od, dtype=jnp.int32)
+    class_id = ctop // ch_each                               # (O,)
+    chan = (ctop[:, None, None] * g + gh[None]) * g + gw[None]  # (O,P,P)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = _round_half_away(roi[1]) * spatial_scale - 0.5
+        y1 = _round_half_away(roi[2]) * spatial_scale - 0.5
+        x2 = (_round_half_away(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (_round_half_away(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        sub_w, sub_h = bin_w / s, bin_h / s
+        if no_trans:
+            tx = jnp.zeros((od, p, p), jnp.float32)
+            ty = jnp.zeros((od, p, p), jnp.float32)
+        else:
+            # trans (2*num_classes, part, part): channel class_id*2 is x
+            tx = tr[class_id * 2][:, part_h[:, 0]][:, :, part_w[0]] \
+                * trans_std                                  # (O,P,P)
+            ty = tr[class_id * 2 + 1][:, part_h[:, 0]][:, :, part_w[0]] \
+                * trans_std
+        wstart = pw * bin_w + x1 + tx * rw                   # (O,P,P)
+        hstart = ph * bin_h + y1 + ty * rh
+        iw = jnp.arange(s, dtype=jnp.float32)
+        xs = wstart[..., None, None] + iw[None, None, None, None, :] * sub_w
+        ys = hstart[..., None, None] + \
+            iw[None, None, None, :, None] * sub_h            # (O,P,P,S,S)
+        valid = (xs >= -0.5) & (xs <= w - 0.5) & \
+                (ys >= -0.5) & (ys <= h - 0.5)
+        xc = jnp.clip(xs, 0, w - 1)
+        yc = jnp.clip(ys, 0, h - 1)
+        img = data[bidx]                                      # (C,H,W)
+        x0 = jnp.floor(xc)
+        y0 = jnp.floor(yc)
+        fx = xc - x0
+        fy = yc - y0
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x1i = jnp.minimum(x0i + 1, w - 1)
+        y1i = jnp.minimum(y0i + 1, h - 1)
+        cb = chan[..., None, None]                            # (O,P,P,1,1)
+        v00 = img[cb, y0i, x0i]
+        v01 = img[cb, y0i, x1i]
+        v10 = img[cb, y1i, x0i]
+        v11 = img[cb, y1i, x1i]
+        val = (v00 * (1 - fy) * (1 - fx) + v01 * (1 - fy) * fx
+               + v10 * fy * (1 - fx) + v11 * fy * fx)
+        val = jnp.where(valid, val, 0.0)
+        count = valid.sum(axis=(-1, -2)).astype(data.dtype)   # (O,P,P)
+        out = val.sum(axis=(-1, -2)) / jnp.maximum(count, 1.0)
+        return out, count
+
+    dummy_trans = trans if not no_trans else \
+        jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+    out, cnt = jax.vmap(one_roi)(rois, dummy_trans)
+    return out, cnt

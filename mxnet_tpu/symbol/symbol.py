@@ -582,7 +582,8 @@ def _array_param_names(op):
         if p.kind in (p.VAR_POSITIONAL,):
             return names, True
         if p.default is p.empty or p.name in ("bias", "state_cell", "rng_key",
-                                              "sequence_length", "like"):
+                                              "sequence_length", "like",
+                                              "trans"):
             if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
                 names.append(p.name)
         else:
@@ -627,6 +628,8 @@ def make_symbol_creator(opname):
             s = slots[an]
             if s is None:
                 if an in ("bias",) and params.get("no_bias"):
+                    continue
+                if an == "trans" and params.get("no_trans"):
                     continue
                 if an == "rng_key":
                     s = Variable(f"{name}_rng_key")

@@ -703,7 +703,7 @@ EXPLICIT = {
     "BilinearSampler", "GridGenerator", "SpatialTransformer", "ROIPooling",
     "Correlation", "_contrib_DeformableConvolution", "_contrib_fft",
     "_contrib_ifft", "_contrib_count_sketch", "_contrib_quadratic",
-    "_contrib_hawkes_ll",
+    "_contrib_hawkes_ll", "_contrib_DeformablePSROIPooling",
 }
 
 

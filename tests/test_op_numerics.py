@@ -485,6 +485,7 @@ def test_coverage_fraction():
         "_contrib_DeformableConvolution", "_contrib_fft", "_contrib_ifft",
         "_contrib_count_sketch", "_contrib_quadratic",
         "_contrib_index_array", "_contrib_arange_like", "_contrib_hawkes_ll",
+        "_contrib_DeformablePSROIPooling",
         # test_image_ops.py
         "_image_to_tensor", "_image_normalize", "_image_flip_left_right",
         "_image_flip_top_bottom", "_image_random_flip_left_right",

@@ -496,3 +496,125 @@ def test_hawkes_ll_padded_gradients_finite():
     g = jax.grad(loss, argnums=(0, 1, 2))(mu, alpha, beta)
     for a in g:
         assert np.isfinite(np.asarray(a)).all(), g
+
+
+def _np_dpsroi(data, rois, trans, scale, od, g, p, part, s, trans_std,
+               no_trans):
+    n, c, h, w = data.shape
+    r_out = np.zeros((len(rois), od, p, p), np.float64)
+    cnt_out = np.zeros_like(r_out)
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = od // num_classes
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1 = round(roi[1]) * scale - 0.5
+        y1 = round(roi[2]) * scale - 0.5
+        x2 = (round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (round(roi[4]) + 1.0) * scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bin_w, bin_h = rw / p, rh / p
+        sub_w, sub_h = bin_w / s, bin_h / s
+        for o in range(od):
+            cls = o // ch_each
+            for ph in range(p):
+                for pw in range(p):
+                    part_h = min(max(int(np.floor(ph / p * part)), 0),
+                                 part - 1)
+                    part_w = min(max(int(np.floor(pw / p * part)), 0),
+                                 part - 1)
+                    tx = 0.0 if no_trans else \
+                        trans[r, cls * 2, part_h, part_w] * trans_std
+                    ty = 0.0 if no_trans else \
+                        trans[r, cls * 2 + 1, part_h, part_w] * trans_std
+                    wstart = pw * bin_w + x1 + tx * rw
+                    hstart = ph * bin_h + y1 + ty * rh
+                    gh = min(max(int(np.floor(ph * g / p)), 0), g - 1)
+                    gw = min(max(int(np.floor(pw * g / p)), 0), g - 1)
+                    ch = (o * g + gh) * g + gw
+                    tot, count = 0.0, 0
+                    for ih in range(s):
+                        for iw in range(s):
+                            x = wstart + iw * sub_w
+                            y = hstart + ih * sub_h
+                            if x < -0.5 or x > w - 0.5 or y < -0.5 \
+                                    or y > h - 0.5:
+                                continue
+                            x = min(max(x, 0), w - 1)
+                            y = min(max(y, 0), h - 1)
+                            x0, y0 = int(np.floor(x)), int(np.floor(y))
+                            x1i, y1i = min(x0 + 1, w - 1), min(y0 + 1, h - 1)
+                            fx, fy = x - x0, y - y0
+                            v = (data[b, ch, y0, x0] * (1 - fy) * (1 - fx)
+                                 + data[b, ch, y0, x1i] * (1 - fy) * fx
+                                 + data[b, ch, y1i, x0] * fy * (1 - fx)
+                                 + data[b, ch, y1i, x1i] * fy * fx)
+                            tot += v
+                            count += 1
+                    r_out[r, o, ph, pw] = tot / count if count else 0.0
+                    cnt_out[r, o, ph, pw] = count
+    return r_out, cnt_out
+
+
+def test_deformable_psroi_pooling_forward():
+    rng = np.random.RandomState(8)
+    G, OD, P, S = 2, 4, 3, 2
+    data = rng.rand(2, OD * G * G, 10, 10).astype(np.float32)
+    rois = np.array([[0, 1, 2, 8, 7], [1, 0, 0, 9, 9]], np.float32)
+    trans = (rng.rand(2, 4, P, P).astype(np.float32) - 0.5)  # 2 classes
+    out, cnt = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=0.8, output_dim=OD, group_size=G, pooled_size=P,
+        sample_per_part=S, trans_std=0.2)
+    ref, rcnt = _np_dpsroi(data, rois, trans, 0.8, OD, G, P, P, S, 0.2,
+                           False)
+    assert_almost_equal(out.asnumpy(), ref.astype(np.float32), rtol=1e-4,
+                        atol=1e-5)
+    np.testing.assert_array_equal(cnt.asnumpy(), rcnt)
+
+
+def test_deformable_psroi_pooling_no_trans_and_grad():
+    rng = np.random.RandomState(9)
+    G, OD, P = 2, 2, 2
+    data = rng.rand(1, OD * G * G, 8, 8).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    trans = np.zeros((1, 2, P, P), np.float32)
+    out, _ = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=OD, group_size=G, pooled_size=P,
+        sample_per_part=2, trans_std=0.1, no_trans=True)
+    ref, _ = _np_dpsroi(data, rois, trans, 1.0, OD, G, P, P, 2, 0.1, True)
+    assert_almost_equal(out.asnumpy(), ref.astype(np.float32), rtol=1e-4,
+                        atol=1e-5)
+
+    osym = sym.contrib.DeformablePSROIPooling(
+        sym.Variable("data"), sym.Variable("rois"), sym.Variable("trans"),
+        spatial_scale=1.0, output_dim=OD, group_size=G, pooled_size=P,
+        sample_per_part=2, trans_std=0.2)
+    t2 = (rng.rand(1, 2, P, P).astype(np.float32) - 0.5) * 0.4
+    check_numeric_gradient(osym[0], {"data": data, "rois": rois,
+                                     "trans": t2},
+                           grad_nodes=["data", "trans"], numeric_eps=1e-3,
+                           rtol=0.08, atol=0.03)
+
+
+def test_deformable_psroi_no_trans_two_inputs():
+    """Reference accepts 2 inputs when no_trans (in_expected=2)."""
+    rng = np.random.RandomState(10)
+    data = rng.rand(1, 2 * 4, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out, _ = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=2, group_size=2, pooled_size=2, sample_per_part=2,
+        no_trans=True)
+    assert out.shape == (1, 2, 2, 2)
+
+
+def test_roi_rounding_half_away_from_zero():
+    """C round() semantics: 2.5 rounds to 3, not banker's 2."""
+    data = np.zeros((1, 1, 8, 8), np.float32)
+    data[0, 0, 3, 3] = 5.0
+    rois = np.array([[0, 2.5, 2.5, 4.5, 4.5]], np.float32)
+    # x1 rounds to 3 under C round(): the 5.0 at (3,3) is the bin corner
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(1, 1), spatial_scale=1.0).asnumpy()
+    assert out[0, 0, 0, 0] == 5.0
