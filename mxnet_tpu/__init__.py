@@ -60,6 +60,7 @@ def __getattr__(name):
         "executor": ".executor", "monitor": ".monitor",
         "visualization": ".visualization", "contrib": ".contrib",
         "engine": ".engine", "operator": ".operator",
+        "npx": ".numpy_extension", "numpy_extension": ".numpy_extension",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

@@ -124,11 +124,14 @@ def _tuple_of(v, n=None):
 
 
 class _Ctx:
-    """Export state: extra initializers created by translators."""
+    """Export state: extra initializers created by translators, plus the
+    input shapes of the node currently being translated (``in_shapes``,
+    aligned with ``ins``; entries may be None when inference failed)."""
 
     def __init__(self):
         self.extra_init = []
         self._n = 0
+        self.in_shapes = []
 
     def const(self, arr, hint="const"):
         name = f"__{hint}_{self._n}"
@@ -229,20 +232,48 @@ def _t_pooling(ctx, name, ins, p):
     raise ValueError(f"pool_type {ptype} not expressible in ONNX")
 
 
+def _single_axis_softmax(ctx, op_type, name, inp, axis):
+    """Emit opset-11 ``Softmax``/``LogSoftmax`` with true single-axis
+    semantics. Opset 11 coerces to 2D — it normalizes over ALL dims from
+    ``axis`` onward — which only matches mxnet's single-axis softmax when
+    the axis is trailing (or the input is 2D with axis 1). For other cases
+    transpose the axis to the end, apply, and transpose back."""
+    shape = ctx.in_shapes[0] if ctx.in_shapes else None
+    if shape is None:
+        if axis in (-1,):
+            return [_node(op_type, [inp], [name], name, axis=-1)]
+        raise ValueError(
+            f"ONNX export: {op_type} over axis={axis} needs a known input "
+            f"rank to export conformantly at opset 11 (coerce-to-2D "
+            f"semantics); shape inference failed for '{name}'")
+    nd = len(shape)
+    ax = axis % nd
+    if ax == nd - 1:
+        return [_node(op_type, [inp], [name], name, axis=ax)]
+    perm = [i for i in range(nd) if i != ax] + [ax]
+    inv = [perm.index(i) for i in range(nd)]
+    t1, sm = f"{name}__pre", f"{name}__sm"
+    return [
+        _node("Transpose", [inp], [t1], t1, perm=perm),
+        _node(op_type, [t1], [sm], sm, axis=nd - 1),
+        _node("Transpose", [sm], [name], name, perm=inv),
+    ]
+
+
 def _t_softmax_output(ctx, name, ins, p):
     # reference _op_translations.py: SoftmaxOutput exports as plain Softmax
     # over the class axis (the loss head has no inference meaning)
-    return [_node("Softmax", [ins[0]], [name], name, axis=1)]
+    return _single_axis_softmax(ctx, "Softmax", name, ins[0], 1)
 
 
 def _t_softmax(ctx, name, ins, p):
-    return [_node("Softmax", [ins[0]], [name], name,
-                  axis=int(p.get("axis", -1)))]
+    return _single_axis_softmax(ctx, "Softmax", name, ins[0],
+                                int(p.get("axis", -1)))
 
 
 def _t_log_softmax(ctx, name, ins, p):
-    return [_node("LogSoftmax", [ins[0]], [name], name,
-                  axis=int(p.get("axis", -1)))]
+    return _single_axis_softmax(ctx, "LogSoftmax", name, ins[0],
+                                int(p.get("axis", -1)))
 
 
 def _t_flatten(ctx, name, ins, p):
@@ -443,6 +474,15 @@ def export_symbol(symbol, params, input_shapes, input_dtype=np.float32,
     if missing:
         raise ValueError(f"export: provide input_shapes for {missing}")
 
+    # per-node value shapes: opset-11 coerce-to-2D ops (Softmax/LogSoftmax)
+    # need input rank to stay spec-conformant on ndim>2 non-trailing axes
+    shape_seed = dict(input_shapes)
+    shape_seed.update({k: v.shape for k, v in params.items()})
+    try:
+        node_shapes = symbol._propagate_shapes(shape_seed)
+    except Exception:  # export still works for rank-agnostic graphs
+        node_shapes = {}
+
     for node in graph_nodes:
         if node.is_var:
             name_of[(id(node), 0)] = uniq(node.name)
@@ -458,6 +498,7 @@ def export_symbol(symbol, params, input_shapes, input_dtype=np.float32,
         p = get_op(op).normalize(node.params)
         ins = [name_of[(id(i), s)] for i, s in node.inputs]
         node_name = uniq(node.name)
+        ctx.in_shapes = [node_shapes.get((id(i), s)) for i, s in node.inputs]
         out_nodes = t(ctx, node_name, ins, p)
         nodes_b.extend(out_nodes)
         # register outputs: single-output default; Split declares its own

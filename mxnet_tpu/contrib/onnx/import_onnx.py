@@ -180,7 +180,8 @@ def _b_pool(op_type):
         if a.get("ceil_mode"):
             kw["pooling_convention"] = "full"
         if op_type == "AveragePool":
-            kw["count_include_pad"] = bool(a.get("count_include_pad", 1))
+            # ONNX spec default is 0 (exclude padding from the average)
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 0))
         return sym.Pooling(ins[0], **kw)
     return b
 

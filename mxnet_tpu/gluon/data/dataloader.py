@@ -112,9 +112,9 @@ def _mp_worker(dataset, batchify_fn, job_q, result_q):
         if job is None:
             return
         j, batch_idx = job
+        shms = []
         try:
             out = batchify_fn([dataset[i] for i in batch_idx])
-            shms = []
             desc = _shm_export(out, shms)
             result_q.put((j, "ok", desc))
             for shm in shms:
@@ -122,6 +122,14 @@ def _mp_worker(dataset, batchify_fn, job_q, result_q):
         except BaseException as e:  # noqa: BLE001 - propagate to parent
             import traceback
 
+            # a partial export (e.g. /dev/shm exhaustion mid-batch) must not
+            # leak the segments already created for this job
+            for shm in shms:
+                try:
+                    shm.close()
+                    shm.unlink()
+                except OSError:
+                    pass
             result_q.put((j, "error",
                           f"{type(e).__name__}: {e}\n"
                           f"{traceback.format_exc()}"))

@@ -161,6 +161,59 @@ def may_share_memory(a, b, max_work=None):
     return _unwrap(a) is _unwrap(b)
 
 
+def shares_memory(a, b, max_work=None):
+    """Parity: _npi_share_memory. Functional XLA buffers alias only when
+    they are literally the same committed buffer."""
+    return _unwrap(a) is _unwrap(b)
+
+
+def _boolean_mask_assign(data, mask, value, _raw=False):
+    """``data[mask] = value`` with NumPy semantics (parity:
+    src/operator/numpy/np_boolean_mask_assign.cc,
+    _npi_boolean_mask_assign_scalar/_tensor). The reference's CUDA kernel
+    compacts the mask with a prefix sum; the TPU design is the same trick
+    expressed functionally — cumsum(mask)-1 maps each selected position to
+    its slot in `value`, then a where() writes without any dynamic shape.
+    Backs mx.np.ndarray.__setitem__ with a boolean key.
+    """
+    jnp = _jnp()
+    d = _unwrap(data)
+    m = _unwrap(mask).astype(bool)
+    v = _unwrap(value)
+    if getattr(v, "ndim", 0) == 0 or not hasattr(v, "ndim"):
+        out = jnp.where(m, v, d)
+    else:
+        v = jnp.asarray(v)
+        import jax.core as _jcore
+
+        if not isinstance(m, _jcore.Tracer):  # eager: numpy's size check
+            n_true = int(m.sum())
+            n_vals = (int(v.shape[0]) if m.shape != d.shape
+                      else int(v.size))
+            if n_vals not in (1, n_true):
+                raise ValueError(
+                    f"boolean mask assignment: cannot assign {n_vals} "
+                    f"input values to {n_true} output values")
+        if m.shape == d.shape:
+            flat_m = m.ravel()
+            slots = jnp.cumsum(flat_m) - 1
+            if v.ndim == 1 and v.shape[0] == 1:
+                picked = jnp.broadcast_to(v[0], flat_m.shape)
+            else:
+                picked = v.reshape(-1)[jnp.clip(slots, 0, v.size - 1)]
+            out = jnp.where(flat_m, picked.astype(d.dtype),
+                            d.ravel()).reshape(d.shape)
+        else:
+            # leading-axes mask: rows of `value` go to masked rows
+            slots = jnp.cumsum(m.ravel()) - 1
+            picked = v.reshape((-1,) + d.shape[m.ndim:])[
+                jnp.clip(slots, 0, v.shape[0] - 1)].astype(d.dtype)
+            out = jnp.where(m.ravel().reshape(
+                m.shape + (1,) * (d.ndim - m.ndim)),
+                picked.reshape(m.shape + d.shape[m.ndim:]), d)
+    return out if _raw else _wrap(out)
+
+
 class random:
     """mx.np.random (numpy/random.py parity) — seeded by mx.random.seed
     through the shared global key cell."""
@@ -227,6 +280,66 @@ class random:
 
         _r.shuffle(x, out=x)
         return None
+
+    @staticmethod
+    def _split_key():
+        import jax
+
+        from .. import random as _r
+
+        cell = _r.generator_key()
+        key, sub = jax.random.split(cell._data)
+        cell._set_data(key)
+        return sub
+
+    @staticmethod
+    def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None):
+        """Parity: _npi_bernoulli (np_bernoulli_op.cc): exactly one of
+        prob/logit."""
+        import jax
+        import jax.numpy as jnp
+
+        if (prob is None) == (logit is None):
+            raise ValueError("bernoulli: pass exactly one of prob, logit")
+        p = _unwrap(prob) if prob is not None else \
+            jax.nn.sigmoid(_unwrap(logit))
+        shape = (size,) if isinstance(size, int) else \
+            (tuple(size) if size is not None else jnp.shape(p))
+        out = jax.random.bernoulli(random._split_key(), p, shape=shape)
+        return _wrap(out.astype(dtype or _onp.float32))
+
+    @staticmethod
+    def exponential(scale=1.0, size=None, ctx=None):
+        import jax
+
+        shape = (size,) if isinstance(size, int) else tuple(size or ())
+        out = jax.random.exponential(random._split_key(), shape=shape) * scale
+        return _wrap(out)
+
+    @staticmethod
+    def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None):
+        import jax
+
+        sz = (size,) if isinstance(size, int) else \
+            (tuple(size) if size is not None else _onp.shape(shape))
+        out = jax.random.gamma(random._split_key(), _unwrap(shape),
+                               shape=sz) * scale
+        return _wrap(out.astype(dtype or _onp.float32))
+
+    @staticmethod
+    def multinomial(n, pvals, size=None):
+        """Counts over len(pvals) categories from n draws (parity:
+        _npi_multinomial)."""
+        import jax
+        import jax.numpy as jnp
+
+        p = jnp.asarray(_unwrap(pvals))
+        k = p.shape[-1]
+        sz = (size,) if isinstance(size, int) else tuple(size or ())
+        draws = jax.random.categorical(
+            random._split_key(), jnp.log(p), shape=sz + (int(n),))
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.int64).sum(axis=-2)
+        return _wrap(counts)
 
 
 __all__ += ["pi", "e", "euler_gamma", "inf", "nan", "newaxis", "dtype",

@@ -78,6 +78,15 @@ class ndarray(NDArray):
     def __setitem__(self, key, value):
         key = _unwrap_key(key)
         val = _unwrap(value)
+        # boolean-mask assignment (parity: src/operator/numpy/
+        # np_boolean_mask_assign.cc _npi_boolean_mask_assign_{scalar,tensor})
+        if hasattr(key, "dtype") and key.dtype == bool and \
+                getattr(val, "ndim", 0) > 0:
+            from . import _boolean_mask_assign
+
+            self._set_data(_boolean_mask_assign(self._data, key, val,
+                                                _raw=True))
+            return
         self._set_data(self._data.at[key].set(val))
 
     def __iter__(self):

@@ -1,0 +1,125 @@
+"""mx.npx — NumPy-extension namespace.
+
+Parity: python/mxnet/numpy_extension/ + the `_npx_*` kernels under
+src/operator/numpy/. Holds operators that are deliberately OUTSIDE the
+NumPy standard: the reshape with structural codes, nonzero-as-array,
+constraint_check, and neural-net helpers. Anything else falls through to
+the operator registry, so every registered op is reachable as
+``npx.<name>`` on mx.np arrays (the reference generates these bindings
+from NNVM; here __getattr__ is the generator).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..numpy.multiarray import _unwrap, _wrap
+from ..util import (is_np_array, is_np_shape, reset_np, set_np,  # noqa: F401
+                    set_np_shape, use_np, use_np_array, use_np_shape)
+
+__all__ = ["reshape", "nonzero", "constraint_check", "set_np", "reset_np",
+           "use_np", "is_np_array", "is_np_shape"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """Reshape with the reference's structural codes
+    (src/operator/numpy/np_matrix_op-inl.h:88 NumpyXReshapeParam):
+
+    -1 infer; -2 copy this input dim; -3 skip the current input dim (it
+    must be 1); -4 copy ALL remaining input dims; -5 merge two consecutive
+    input dims; -6 split one input dim into the two sizes that follow.
+    ``reverse=True`` applies the codes right-to-left.
+    """
+    x = _unwrap(a)
+    if isinstance(newshape, int):
+        newshape = (newshape,)
+    in_shape = list(x.shape)
+    codes = list(newshape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        codes = codes[::-1]
+    out = []
+    i = 0  # input-dim cursor
+    j = 0
+    while j < len(codes):
+        c = codes[j]
+        if c == -2:
+            out.append(in_shape[i])
+            i += 1
+        elif c == -3:
+            if in_shape[i] != 1:
+                raise ValueError(
+                    f"npx.reshape -3: input dim {i} is {in_shape[i]}, not 1")
+            i += 1
+        elif c == -4:
+            out.extend(in_shape[i:])
+            i = len(in_shape)
+        elif c == -5:
+            out.append(in_shape[i] * in_shape[i + 1])
+            i += 2
+        elif c == -6:
+            d1, d2 = codes[j + 1], codes[j + 2]
+            if d1 == -1:
+                d1 = in_shape[i] // d2
+            elif d2 == -1:
+                d2 = in_shape[i] // d1
+            if d1 * d2 != in_shape[i]:
+                raise ValueError(
+                    f"npx.reshape -6: {d1}*{d2} != input dim {in_shape[i]}")
+            out.extend([d1, d2])
+            i += 1
+            j += 2
+        elif c == -1:
+            out.append(-1)
+            i += 1
+        else:
+            out.append(int(c))
+            i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return _wrap(x.reshape(tuple(out), order=order))
+
+
+def nonzero(a):
+    """Indices of nonzero elements as ONE int64 array of shape
+    (num_nonzero, ndim) — `_npx_nonzero`'s layout, unlike np.nonzero's
+    tuple-of-arrays."""
+    x = _unwrap(a)
+    idx = _onp.argwhere(_onp.asarray(x) != 0)
+    return _wrap(_jnp().asarray(idx.astype(_onp.int64)))
+
+
+def constraint_check(condition, msg="Constraint violated!"):
+    """Assert that every element of the boolean condition holds; returns
+    the scalar True on success (src/operator/numpy/np_constraint_check.cc).
+    Sync-on-read semantics: the check fires when the value is realized."""
+    x = _unwrap(condition)
+    if not bool(_jnp().all(x)):
+        raise ValueError(msg)
+    return _wrap(_jnp().asarray(True))
+
+
+def __getattr__(name):
+    # generated-binding fallback: resolve npx.<name> from the op registry
+    from ..ops.registry import get_op, invoke
+
+    try:
+        get_op(name)
+    except Exception:
+        raise AttributeError(
+            f"module 'mxnet_tpu.numpy_extension' has no attribute {name!r}")
+
+    def fn(*args, **kwargs):
+        arrays = tuple(_unwrap(a) for a in args)
+        out = invoke(name, *arrays, **kwargs)
+        return _wrap(out[0]) if len(out) == 1 else tuple(_wrap(o) for o in out)
+
+    fn.__name__ = name
+    globals()[name] = fn
+    return fn

@@ -16,5 +16,6 @@ from . import control_flow as _cf      # noqa: F401  foreach/while/cond
 from . import quantization as _quant   # noqa: F401  int8 quantize family
 from . import image_ops as _img        # noqa: F401  on-device augmentation
 from . import vision_extra as _vx      # noqa: F401  legacy vision/contrib tail
+from . import parity_aliases as _pa    # noqa: F401  internal-name tail (last)
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]

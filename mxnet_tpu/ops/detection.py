@@ -405,3 +405,174 @@ def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         return jnp.transpose(pooled, (2, 0, 1))
 
     return jax.vmap(one_roi)(rois)
+
+
+# ------------------------------------------------- bounding-box tail ops
+# Parity: src/operator/contrib/bounding_box.cc:120-250 (+ bounding_box-inl.h
+# kernels compute_overlap/bipartite_matching/box_encode/box_decode and
+# bounding_box-common.h Intersect/BoxArea). All four back-propagate zeros in
+# the reference (MakeZeroGradNodes), mirrored here with no_grad=True.
+
+
+def _iou_matrix(lhs, rhs, fmt):
+    """Full cartesian IoU between flattened box lists (L,4) x (R,4)."""
+    jnp = _jnp()
+
+    def line_intersect(a1, a2, b1, b2):
+        # corner already converted; interval overlap clamped at 0
+        left = jnp.maximum(a1, b1)
+        right = jnp.minimum(a2, b2)
+        return jnp.maximum(right - left, 0.0)
+
+    if fmt == "corner":
+        lx1, ly1, lx2, ly2 = (lhs[:, i] for i in range(4))
+        rx1, ry1, rx2, ry2 = (rhs[:, i] for i in range(4))
+        l_area = jnp.where((lx2 - lx1 < 0) | (ly2 - ly1 < 0), 0.0,
+                           (lx2 - lx1) * (ly2 - ly1))
+        r_area = jnp.where((rx2 - rx1 < 0) | (ry2 - ry1 < 0), 0.0,
+                           (rx2 - rx1) * (ry2 - ry1))
+    else:  # center: [x, y, w, h]
+        lx1, lx2 = lhs[:, 0] - lhs[:, 2] / 2, lhs[:, 0] + lhs[:, 2] / 2
+        ly1, ly2 = lhs[:, 1] - lhs[:, 3] / 2, lhs[:, 1] + lhs[:, 3] / 2
+        rx1, rx2 = rhs[:, 0] - rhs[:, 2] / 2, rhs[:, 0] + rhs[:, 2] / 2
+        ry1, ry2 = rhs[:, 1] - rhs[:, 3] / 2, rhs[:, 1] + rhs[:, 3] / 2
+        l_area = jnp.where((lhs[:, 2] < 0) | (lhs[:, 3] < 0), 0.0,
+                           lhs[:, 2] * lhs[:, 3])
+        r_area = jnp.where((rhs[:, 2] < 0) | (rhs[:, 3] < 0), 0.0,
+                           rhs[:, 2] * rhs[:, 3])
+    ix = line_intersect(lx1[:, None], lx2[:, None], rx1[None], rx2[None])
+    iy = line_intersect(ly1[:, None], ly2[:, None], ry1[None], ry2[None])
+    inter = ix * iy
+    union = l_area[:, None] + r_area[None] - inter
+    return jnp.where(inter > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", no_grad=True, aliases=("box_iou",))
+def _box_iou(lhs, rhs, format="corner"):
+    """IoU of every lhs box against every rhs box. lhs (..., 4), rhs
+    (..., 4) -> lhs.shape[:-1] + rhs.shape[:-1]. format 'corner'
+    [xmin,ymin,xmax,ymax] or 'center' [x,y,w,h].
+    Parity: bounding_box.cc:120 (BoxOverlapForward)."""
+    jnp = _jnp()
+    lshape, rshape = lhs.shape[:-1], rhs.shape[:-1]
+    dtype = lhs.dtype
+    out = _iou_matrix(lhs.reshape(-1, 4).astype(jnp.float32),
+                      rhs.reshape(-1, 4).astype(jnp.float32), format)
+    return out.reshape(lshape + rshape).astype(dtype)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2, no_grad=True,
+          aliases=("bipartite_matching",))
+def _bipartite_matching(data, threshold=None, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over score matrix (..., N, M). Returns
+    (row_match (..., N), col_match (..., M)); -1 marks unmatched.
+    Parity: bounding_box-inl.h:683 (struct bipartite_matching): visit
+    pairs in score order; stop at the first below-threshold score
+    (above-threshold for is_ascend) — including its replicated topk
+    convention, which breaks only AFTER the (topk+1)-th assignment.
+    Sequential greedy scan expressed as lax.fori_loop, vmapped over
+    batch; the N*M loop is tiny next to the sort XLA runs on device."""
+    import jax
+
+    jnp = _jnp()
+    lax = _lax()
+    if threshold is None:
+        raise ValueError("bipartite_matching requires threshold")
+    *batch, n, m = data.shape
+    s = data.reshape((-1, n * m)).astype(jnp.float32)
+
+    def one(sc):
+        order = jnp.argsort(-sc) if not is_ascend else jnp.argsort(sc)
+        sorted_sc = sc[order]
+
+        def body(j, state):
+            rmark, cmark, count, stopped = state
+            idx = order[j]
+            r, c = idx // m, idx % m
+            score_ok = (sorted_sc[j] > threshold) if not is_ascend \
+                else (sorted_sc[j] < threshold)
+            free = (rmark[r] == -1) & (cmark[c] == -1)
+            do = (~stopped) & free & score_ok
+            rmark = jnp.where(do, rmark.at[r].set(c), rmark)
+            cmark = jnp.where(do, cmark.at[c].set(r), cmark)
+            count = count + do.astype(jnp.int32)
+            # reference break conditions: bad score on a free pair, or
+            # count exceeding topk right after an assignment
+            stop_now = ((~stopped) & free & (~score_ok)) | \
+                (do & (topk > 0) & (count > topk))
+            return rmark, cmark, count, stopped | stop_now
+
+        rmark0 = jnp.full((n,), -1, jnp.int32)
+        cmark0 = jnp.full((m,), -1, jnp.int32)
+        rmark, cmark, _, _ = lax.fori_loop(
+            0, n * m, body, (rmark0, cmark0, jnp.int32(0), jnp.bool_(False)))
+        return rmark, cmark
+
+    rmark, cmark = jax.vmap(one)(s)
+    dt = data.dtype
+    return (rmark.reshape(tuple(batch) + (n,)).astype(dt),
+            cmark.reshape(tuple(batch) + (m,)).astype(dt))
+
+
+@register("_contrib_box_encode", num_outputs=2, no_grad=True,
+          aliases=("box_encode",))
+def _box_encode(samples, matches, anchors, refs, means, stds):
+    """SSD training-target encoding. samples (B,N) in {+1,-1,0}; matches
+    (B,N) indices into refs; anchors (B,N,4) corner; refs (B,M,4) corner;
+    means/stds (4,). Returns (targets (B,N,4), masks (B,N,4)).
+    Parity: bounding_box-inl.h:836 (struct box_encode)."""
+    jnp = _jnp()
+    f32 = jnp.float32
+    a = anchors.astype(f32)
+    r = refs.astype(f32)
+    match = matches.astype(jnp.int32)  # (B, N)
+    ref = jnp.take_along_axis(r, match[..., None], axis=1)  # (B,N,4)
+    ref_w = ref[..., 2] - ref[..., 0]
+    ref_h = ref[..., 3] - ref[..., 1]
+    ref_x = ref[..., 0] + ref_w * 0.5
+    ref_y = ref[..., 1] + ref_h * 0.5
+    a_w = a[..., 2] - a[..., 0]
+    a_h = a[..., 3] - a[..., 1]
+    a_x = a[..., 0] + a_w * 0.5
+    a_y = a[..., 1] + a_h * 0.5
+    valid = (samples.astype(f32) > 0.5)
+    means = means.astype(f32)
+    stds = stds.astype(f32)
+    t0 = ((ref_x - a_x) / a_w - means[0]) / stds[0]
+    t1 = ((ref_y - a_y) / a_h - means[1]) / stds[1]
+    t2 = (jnp.log(ref_w / a_w) - means[2]) / stds[2]
+    t3 = (jnp.log(ref_h / a_h) - means[3]) / stds[3]
+    targets = jnp.stack([t0, t1, t2, t3], axis=-1)
+    masks = jnp.broadcast_to(valid[..., None], targets.shape).astype(f32)
+    targets = jnp.where(valid[..., None], targets, 0.0)
+    return targets.astype(anchors.dtype), masks.astype(anchors.dtype)
+
+
+@register("_contrib_box_decode", no_grad=True, aliases=("box_decode",))
+def _box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+                clip=-1.0, format="center"):
+    """Decode predicted offsets (B,N,4) against anchors (1,N,4) back to
+    corner boxes. format names the ANCHOR encoding.
+    Parity: bounding_box-inl.h:981 (struct box_decode)."""
+    jnp = _jnp()
+    f32 = jnp.float32
+    x = data.astype(f32)
+    a = jnp.broadcast_to(anchors.astype(f32), x.shape)
+    if format == "corner":
+        a_w = a[..., 2] - a[..., 0]
+        a_h = a[..., 3] - a[..., 1]
+        a_x = a[..., 0] + a_w * 0.5
+        a_y = a[..., 1] + a_h * 0.5
+    else:
+        a_x, a_y, a_w, a_h = (a[..., i] for i in range(4))
+    ox = x[..., 0] * std0 * a_w + a_x
+    oy = x[..., 1] * std1 * a_h + a_y
+    dw = x[..., 2] * std2
+    dh = x[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    ow = jnp.exp(dw) * a_w * 0.5
+    oh = jnp.exp(dh) * a_h * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+    return out.astype(data.dtype)

@@ -827,3 +827,48 @@ def _index_copy(old, index, new):
 def _index_add(old, index, new):
     """Accumulate rows of `new` into `old` at `index` (contrib index_add)."""
     return old.at[index.astype(jnp.int32)].add(new)
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False):
+    """Mean and variance aggregated over ``axes`` (all axes when None).
+    Parity: src/operator/nn/moments.cc:34 — two outputs, differentiable
+    (the reference hand-writes _backward_moments; jax.vjp derives it)."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    mk = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mk), axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None):
+    """Reshape lhs to rhs's shape, optionally splicing only the axis range
+    [lhs_begin, lhs_end) of lhs with [rhs_begin, rhs_end) of rhs.
+    Parity: src/operator/tensor/elemwise_unary_op_basic.cc (reshape_like);
+    gradient reshapes back (jax.vjp of reshape)."""
+    lnd, rnd = lhs.ndim, rhs.ndim
+
+    def _norm(v, nd, default):
+        if v is None:
+            return default
+        v = int(v)
+        return v + nd if v < 0 else v
+
+    lb = _norm(lhs_begin, lnd, 0)
+    le = _norm(lhs_end, lnd, lnd)
+    rb = _norm(rhs_begin, rnd, 0)
+    re = _norm(rhs_end, rnd, rnd)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
+@register("_contrib_allclose", no_grad=True, aliases=("allclose",))
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """Scalar 1.0/0.0: |a - b| <= atol + rtol*|b| everywhere (NaNs equal
+    when equal_nan). Parity: src/operator/contrib/allclose_op.cc:32."""
+    close = jnp.abs(a - b) <= (atol + rtol * jnp.abs(b))
+    if equal_nan:
+        close = close | (jnp.isnan(a) & jnp.isnan(b))
+    return jnp.all(close).astype(jnp.float32)

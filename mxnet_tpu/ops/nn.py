@@ -586,6 +586,39 @@ def _interleaved_valatt(qkv, att, heads=1):
     return out.reshape(N, heads, L, d).transpose(2, 0, 1, 3).reshape(L, N, heads * d)
 
 
+@register("_contrib_interleaved_matmul_encdec_qk")
+def _interleaved_encdec_qk(queries, keys_values, heads=1):
+    """Encoder-decoder attention scores. queries (Lq, N, H*d); keys_values
+    (Lkv, N, H*2*d) interleaved [k_h, v_h] per head. Returns
+    (N*H, Lq, Lkv), scaled by 1/sqrt(d).
+    Parity: src/operator/contrib/transformer.cc:736-778
+    (InterleavedMatMulEncDecQKCPU strided-gemm layout)."""
+    lq, n, p = queries.shape
+    d = p // heads
+    lkv = keys_values.shape[0]
+    q = queries.reshape(lq, n, heads, d).transpose(1, 2, 0, 3) \
+        .reshape(n * heads, lq, d)
+    kv = keys_values.reshape(lkv, n, heads, 2, d)
+    k = kv[..., 0, :].transpose(1, 2, 0, 3).reshape(n * heads, lkv, d)
+    scale = jnp.asarray(1.0, queries.dtype) / jnp.sqrt(d).astype(queries.dtype)
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def _interleaved_encdec_valatt(keys_values, attention, heads=1):
+    """Attention-weighted values for encoder-decoder attention.
+    keys_values (Lkv, N, H*2*d); attention (N*H, Lq, Lkv). Returns
+    (Lq, N, H*d). Parity: transformer.cc:780-819."""
+    lkv, n, p2 = keys_values.shape
+    d = p2 // (2 * heads)
+    kv = keys_values.reshape(lkv, n, heads, 2, d)
+    v = kv[..., 1, :].transpose(1, 2, 0, 3).reshape(n * heads, lkv, d)
+    out = jnp.matmul(attention, v)  # (N*H, Lq, d)
+    lq = out.shape[1]
+    return out.reshape(n, heads, lq, d).transpose(2, 0, 1, 3) \
+        .reshape(lq, n, heads * d)
+
+
 @register("scaled_dot_product_attention")
 def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
     """TPU-native fused attention (new capability; long-context story lives

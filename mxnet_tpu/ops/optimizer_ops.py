@@ -316,3 +316,145 @@ def _preloaded_multi_sgd_mom_update(*tensors, num_weights=1, momentum=0.0,
         new_ws.append(new_w)
         mutated.extend([new_w, new_mom.astype(mom.dtype)])
     return tuple(new_ws) + tuple(mutated)
+
+
+@register("ftml_update", mutate=(0, 2, 3, 4), no_grad=True)
+def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """FTML (Follow the Moving Leader). Parity: optimizer_op.cc:626 /
+    optimizer_op-inl.h:1205 (FTMLKernel) — note the reference applies wd
+    INSIDE the clipped gradient, unlike the other updaters."""
+    g = rescale_grad * grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * g * g
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    new_z = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * weight
+    new_w = -new_z / d_t
+    return new_w, new_w, d_t, new_v, new_z
+
+
+@register("mp_nag_mom_update", mutate=(0, 2, 3), no_grad=True)
+def _mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    """Multi-precision NAG: fp32 master weights + fp32 momentum with a
+    low-precision weight copy. Parity: optimizer_op.cc:743
+    (MP_NAGMomUpdate); same state convention as nag_mom_update above."""
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient) + wd * weight32
+    new_mom = momentum * mom + g
+    new_w32 = weight32 - lr * (g + momentum * new_mom)
+    return (new_w32.astype(weight.dtype), new_w32.astype(weight.dtype),
+            new_mom, new_w32)
+
+
+def _multi_tuple(v, n):
+    if isinstance(v, (int, float)):
+        return (float(v),) * n
+    return tuple(float(x) for x in v)
+
+
+@register("multi_sgd_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(2 * i for i in range(p.get("num_weights", 1))))
+def _multi_sgd_update(*tensors, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    """Grouped SGD with static per-weight lrs/wds. Inputs [w0, g0, w1, g1,
+    ...]. Parity: optimizer_op.cc:322 (multi_sgd_update)."""
+    lrs = _multi_tuple(lrs, num_weights)
+    wds = _multi_tuple(wds, num_weights)
+    outs = []
+    for i in range(num_weights):
+        w, g = tensors[2 * i], tensors[2 * i + 1]
+        g = _rescale_clip(g, rescale_grad,
+                          clip_gradient if clip_gradient > 0 else None)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs) + tuple(outs)
+
+
+@register("multi_sgd_mom_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(
+              s for i in range(p.get("num_weights", 1))
+              for s in (3 * i, 3 * i + 2)))
+def _multi_sgd_mom_update(*tensors, lrs=(0.01,), wds=(0.0,), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    """Inputs [w0, g0, mom0, ...]. Parity: optimizer_op.cc:355."""
+    lrs = _multi_tuple(lrs, num_weights)
+    wds = _multi_tuple(wds, num_weights)
+    new_ws, mutated = [], []
+    for i in range(num_weights):
+        w, g, mom = tensors[3 * i:3 * i + 3]
+        g = _rescale_clip(g, rescale_grad,
+                          clip_gradient if clip_gradient > 0 else None)
+        new_mom = momentum * mom - lrs[i] * (g + wds[i] * w)
+        new_w = w + new_mom
+        new_ws.append(new_w)
+        mutated.extend([new_w, new_mom])
+    return tuple(new_ws) + tuple(mutated)
+
+
+@register("multi_mp_sgd_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(
+              s for i in range(p.get("num_weights", 1))
+              for s in (3 * i, 3 * i + 2)))
+def _multi_mp_sgd_update(*tensors, lrs=(0.01,), wds=(0.0,), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    """Inputs [w0, g0, w32_0, ...]; fp32 master copy carries the update.
+    Parity: optimizer_op.cc:410."""
+    lrs = _multi_tuple(lrs, num_weights)
+    wds = _multi_tuple(wds, num_weights)
+    new_ws, mutated = [], []
+    for i in range(num_weights):
+        w, g, w32 = tensors[3 * i:3 * i + 3]
+        g = _rescale_clip(g.astype(jnp.float32), rescale_grad,
+                          clip_gradient if clip_gradient > 0 else None)
+        new_w32 = w32 - lrs[i] * (g + wds[i] * w32)
+        new_w = new_w32.astype(w.dtype)
+        new_ws.append(new_w)
+        mutated.extend([new_w, new_w32])
+    return tuple(new_ws) + tuple(mutated)
+
+
+@register("multi_mp_sgd_mom_update", no_grad=True,
+          num_outputs=lambda p: p.get("num_weights", 1),
+          mutate=lambda p: tuple(
+              s for i in range(p.get("num_weights", 1))
+              for s in (4 * i, 4 * i + 2, 4 * i + 3)))
+def _multi_mp_sgd_mom_update(*tensors, lrs=(0.01,), wds=(0.0,),
+                             momentum=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0, num_weights=1):
+    """Inputs [w0, g0, mom0, w32_0, ...]. Parity: optimizer_op.cc:453."""
+    lrs = _multi_tuple(lrs, num_weights)
+    wds = _multi_tuple(wds, num_weights)
+    new_ws, mutated = [], []
+    for i in range(num_weights):
+        w, g, mom, w32 = tensors[4 * i:4 * i + 4]
+        g = _rescale_clip(g.astype(jnp.float32), rescale_grad,
+                          clip_gradient if clip_gradient > 0 else None)
+        new_mom = momentum * mom - lrs[i] * (g + wds[i] * w32)
+        new_w32 = w32 + new_mom
+        new_w = new_w32.astype(w.dtype)
+        new_ws.append(new_w)
+        mutated.extend([new_w, new_mom, new_w32])
+    return tuple(new_ws) + tuple(mutated)
+
+
+@register("_contrib_group_adagrad_update", mutate=(0, 2), no_grad=True,
+          aliases=("group_adagrad_update",))
+def _group_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-5,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """Group AdaGrad: one accumulated statistic PER ROW (first axis) —
+    history[i] += mean_j(g[i,j]^2); w -= lr*g/sqrt(history+eps).
+    Parity: src/operator/contrib/optimizer_op.cc:53 + optimizer_op-inl.h
+    GroupAdagradKernel. history has shape (weight.shape[0],)."""
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient > 0 else None)
+    row_axes = tuple(range(1, g.ndim))
+    new_hist = history + (g * g).mean(axis=row_axes)
+    denom = jnp.sqrt(new_hist + epsilon)
+    new_w = weight - lr * g / denom.reshape((-1,) + (1,) * (g.ndim - 1))
+    return new_w, new_w, new_hist

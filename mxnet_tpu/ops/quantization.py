@@ -185,3 +185,194 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         else:
             out = out + bias_i32.reshape((1, -1) + (1,) * sdims)
     return out, lo, hi
+
+
+# ---------------------------------------------------------------------------
+# quantized op tail: keeps whole subgraphs on the int8 grid so residual
+# blocks don't bounce through dequantize at every pool/add boundary
+# (src/operator/quantization/quantized_{pooling,concat,elemwise_add,
+# activation,flatten,batch_norm}.cc + quantized_embedding.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quantized_pooling", num_outputs=3, no_grad=True,
+          aliases=("quantized_pooling",))
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2), stride=None,
+                       pad=None, pool_type="max", global_pool=False,
+                       pooling_convention="valid", count_include_pad=True,
+                       layout=None):
+    """Pooling directly on int8 (quantized_pooling.cc): max pool is exact
+    on the integer grid; avg pool accumulates in int32 and rounds back.
+    Range passes through unchanged. NCHW/NCW/NCDHW only (like the
+    reference's quantized path); pooling_convention='full' pads the right
+    edge so the window count uses ceil like the float Pooling op."""
+    import jax
+
+    if layout is not None and (len(layout) < 2 or layout[1] != "C"):
+        raise ValueError(
+            f"quantized_pooling: channels-first layouts only, got {layout!r}")
+    if global_pool:
+        k = data.shape[2:]
+    else:
+        k = tuple(int(x) for x in kernel)
+    sdims = len(k)
+    if global_pool:
+        stride = (1,) * sdims
+        pad = (0,) * sdims
+    s = tuple(int(x) for x in (stride or (1,) * sdims))
+    p = tuple(int(x) for x in (pad or (0,) * sdims))
+    pads_lo_hi = [(x, x) for x in p]
+    if pooling_convention == "full" and not global_pool:
+        # ceil convention: extend the right pad until the last window fits
+        for i in range(sdims):
+            span = data.shape[2 + i] + 2 * p[i]
+            n_out = -(-(span - k[i]) // s[i]) + 1  # ceil
+            need = (n_out - 1) * s[i] + k[i] - span
+            pads_lo_hi[i] = (p[i], p[i] + max(need, 0))
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple(pads_lo_hi)
+    if pool_type == "max":
+        out = jax.lax.reduce_window(
+            data.astype(jnp.int32), jnp.int32(-128), jax.lax.max,
+            window, strides, pads).astype(data.dtype)
+    elif pool_type == "avg":
+        ssum = jax.lax.reduce_window(
+            data.astype(jnp.int32), jnp.int32(0), jax.lax.add,
+            window, strides, pads)
+        if count_include_pad:
+            cnt = int(_np_prod(k))
+            out = jnp.round(ssum.astype(jnp.float32) / cnt)
+        else:
+            ones = jnp.ones(data.shape, jnp.int32)
+            cnt = jax.lax.reduce_window(ones, jnp.int32(0), jax.lax.add,
+                                        window, strides, pads)
+            out = jnp.round(ssum.astype(jnp.float32) /
+                            jnp.maximum(cnt, 1).astype(jnp.float32))
+        out = jnp.clip(out, -127, 127).astype(data.dtype)
+    else:
+        raise ValueError(f"quantized_pooling: pool_type {pool_type!r}")
+    return out, min_data.reshape(()), max_data.reshape(())
+
+
+def _np_prod(t):
+    r = 1
+    for x in t:
+        r *= int(x)
+    return r
+
+
+@register("_contrib_quantized_act", num_outputs=3, no_grad=True,
+          aliases=("quantized_act",))
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    """ReLU on the int8 grid (quantized_activation.cc — the reference
+    supports relu only too). Range passes through: the positive half of
+    the symmetric grid is unchanged."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports act_type='relu' only "
+                         "(like quantized_activation.cc)")
+    zero = jnp.zeros((), data.dtype)
+    return (jnp.maximum(data, zero), min_data.reshape(()),
+            max_data.reshape(()))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, no_grad=True,
+          aliases=("quantized_flatten",))
+def _quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), min_data.reshape(()),
+            max_data.reshape(()))
+
+
+@register("_contrib_quantized_concat", num_outputs=3, no_grad=True,
+          aliases=("quantized_concat",),
+          param_normalizer=lambda p: p)
+def _quantized_concat(*arrays, num_args=None, dim=1):
+    """Concat int8 inputs after rescaling each onto the widest input's
+    grid (quantized_concat.cc). Inputs [d0..dn, min0, max0, min1, max1,
+    ...]; output range is the max |range| over inputs."""
+    n = int(num_args) if num_args else (len(arrays) // 3)
+    datas = arrays[:n]
+    ranges = arrays[n:]
+    reals = [_int8_range(ranges[2 * i].reshape(()),
+                         ranges[2 * i + 1].reshape(()))
+             for i in range(n)]
+    real_out = reals[0]
+    for r in reals[1:]:
+        real_out = jnp.maximum(real_out, r)
+    scaled = [
+        jnp.clip(jnp.round(d.astype(jnp.float32) * (r / real_out)),
+                 -127, 127).astype(datas[0].dtype)
+        for d, r in zip(datas, reals)]
+    return (jnp.concatenate(scaled, axis=int(dim)), -real_out, real_out)
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3, no_grad=True,
+          aliases=("quantized_elemwise_add",))
+def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 + int8 -> int32 on the widened grid
+    (quantized_elemwise_add.cc): output range = rA + rB; each operand is
+    rescaled onto the shared int32 grid before an exact integer add."""
+    ra = _int8_range(lhs_min.reshape(()), lhs_max.reshape(()))
+    rb = _int8_range(rhs_min.reshape(()), rhs_max.reshape(()))
+    r_out = ra + rb
+    # int32 grid spans the full int32 range for r_out (quantization_utils.h)
+    sa = (ra / 127.0) / (r_out / 2147483647.0)
+    sb = (rb / 127.0) / (r_out / 2147483647.0)
+    out = (jnp.round(lhs.astype(jnp.float32) * sa) +
+           jnp.round(rhs.astype(jnp.float32) * sb))
+    return out.astype(jnp.int32), -r_out, r_out
+
+
+@register("_contrib_quantized_elemwise_mul", num_outputs=3, no_grad=True,
+          aliases=("quantized_elemwise_mul",))
+def _quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 * int8 -> int32 products (quantized_elemwise_mul.cc); the
+    product grid is (ra/127)*(rb/127) per int32 step like s8s8 matmul."""
+    ra = _int8_range(lhs_min.reshape(()), lhs_max.reshape(()))
+    rb = _int8_range(rhs_min.reshape(()), rhs_max.reshape(()))
+    out = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    # one int32 step = (ra/127)*(rb/127), so the raw products already sit
+    # on the full-int32-span grid for range level*INT32_MAX — same
+    # convention as the s8s8 matmul accumulator (_s8s8_out_range)
+    level = (ra / 127.0) * (rb / 127.0)
+    hi = level * 2147483647.0
+    return out, -hi, hi
+
+
+@register("_contrib_quantized_embedding", num_outputs=3, no_grad=True,
+          aliases=("quantized_embedding",))
+def _quantized_embedding(data, weight, min_weight, max_weight,
+                         input_dim=None, output_dim=None, dtype=None):
+    """int8 weight-table gather (quantized_embedding.cc); range of the
+    rows is the table's range."""
+    idx = data.astype(jnp.int32)
+    return (weight[idx], min_weight.reshape(()), max_weight.reshape(()))
+
+
+@register("_contrib_quantized_batch_norm", num_outputs=3, no_grad=True,
+          aliases=("quantized_batch_norm",))
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data, max_data, eps=1e-3,
+                          min_calib_range=None, max_calib_range=None,
+                          momentum=0.9, fix_gamma=False, use_global_stats=True,
+                          axis=1):
+    """Inference BN folded to a per-channel affine applied on the int8
+    grid (quantized_batch_norm.cc): x_q -> round(x_q * s + b_q) where the
+    fold absorbs data scale in and calibrated output scale out."""
+    if min_calib_range is None or max_calib_range is None:
+        raise ValueError("quantized_batch_norm needs calibrated output "
+                         "range (min_calib_range/max_calib_range)")
+    real_in = _int8_range(min_data.reshape(()), max_data.reshape(()))
+    real_out = _int8_range(jnp.asarray(min_calib_range, jnp.float32),
+                           jnp.asarray(max_calib_range, jnp.float32))
+    g = jnp.ones_like(moving_var) if fix_gamma else gamma
+    inv = g / jnp.sqrt(moving_var + eps)
+    # float BN: y = (x - mean) * inv + beta; on the grid:
+    # y_q = x_q * (in_scale*inv/out_scale) + (beta - mean*inv)/out_scale_q
+    in_scale = real_in / 127.0
+    out_scale = real_out / 127.0
+    ch_shape = [1] * data.ndim
+    ch_shape[int(axis)] = -1
+    a = (in_scale * inv / out_scale).reshape(ch_shape)
+    b = ((beta - moving_mean * inv) / out_scale).reshape(ch_shape)
+    out = jnp.clip(jnp.round(data.astype(jnp.float32) * a + b), -127, 127)
+    return out.astype(jnp.int8), -real_out, real_out

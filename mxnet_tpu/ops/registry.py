@@ -96,6 +96,16 @@ def register(name, *, num_outputs=1, mutate=(), aliases=(), no_grad=False,
     return _reg
 
 
+def add_alias(alias, canonical):
+    """Register an additional resolvable name for an existing op — the
+    analogue of NNVM's .add_alias(), used for reference-internal names
+    (``_zeros``, ``_linalg_gemm``, ...) that map onto already-registered
+    TPU ops."""
+    if canonical not in _OPS:
+        raise MXNetError(f"add_alias: canonical op '{canonical}' not registered")
+    _ALIASES[alias] = canonical
+
+
 def get_op(name) -> OpDef:
     op = _OPS.get(name)
     if op is None:

