@@ -35,6 +35,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.002)
     ap.add_argument("--use-resnet", action="store_true")
+    ap.add_argument("--batches-per-epoch", type=int, default=0,
+                    help="cap batches per epoch (0 = full epoch); used by "
+                         "the acceptance harness smoke mode")
     ap.add_argument("--data", default=None,
                     help="CIFAR-10 batches dir (default: synthetic fallback)")
     ap.add_argument("--out-dir", default="output",
@@ -63,7 +66,9 @@ def main():
 
     for epoch in range(args.epochs):
         metric.reset()
-        for x, y in loader:
+        for i, (x, y) in enumerate(loader):
+            if args.batches_per_epoch and i >= args.batches_per_epoch:
+                break
             with mx.autograd.record():
                 out = net(x)
                 loss = loss_fn(out, y)

@@ -56,6 +56,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=90)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--batches-per-epoch", type=int, default=0,
+                    help="cap batches per epoch (0 = full epoch); used by "
+                         "the acceptance harness smoke mode")
     ap.add_argument("--layout", default="NHWC", choices=["NCHW", "NHWC"])
     ap.add_argument("--stem", default="s2d", choices=["conv7", "s2d"])
     args = ap.parse_args()
@@ -81,8 +84,13 @@ def main():
         for _ in range(10):
             loss = trainer.step(x, y)
         float(np.asarray(loss))
+        # report a train top1 so the acceptance harness's metric-regex
+        # plumbing is exercised end to end in smoke mode
+        trainer.sync_to_net()
+        out = net(mx.nd.array(x))
+        acc = float((out.asnumpy().argmax(1) == y).mean())
         print(f"synthetic: {10 * args.batch_size / (time.time() - t0):.0f} "
-              f"img/s, loss {float(np.asarray(loss)):.3f}")
+              f"img/s, loss {float(np.asarray(loss)):.3f} top1={acc:.4f}")
         return
 
     def lr_at(epoch):
@@ -97,7 +105,9 @@ def main():
             trainer.set_learning_rate(lr_at(epoch))
         train.reset()
         t0, n = time.time(), 0
-        for batch in train:
+        for i, batch in enumerate(train):
+            if args.batches_per_epoch and i >= args.batches_per_epoch:
+                break
             loss = trainer.step(batch.data[0], batch.label[0])
             n += batch.data[0].shape[0]
         trainer.sync_to_net()

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["quantize_model", "quantize_graph"]
+__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm"]
 
 _QUANTIZABLE = ("FullyConnected", "Convolution")
 
@@ -402,6 +402,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                               ranges, quantize_mode=quantize_mode,
                               offline_params=offline_in,
                               offline_out=offline_out)
+        # integer-grid propagation: pool/relu/residual-add boundaries stay
+        # int8; requantize replaces quantize(dequantize(int32)) chains
+        qsym = _int8_grid_propagate(qsym)
         new_args = {k: _nd.array(v, dtype=v.dtype)
                     for k, v in offline_out.items()}
         live = set(qsym.list_arguments())
@@ -412,3 +415,228 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     qsym = quantize_graph(sym, excluded_sym_names, quantized_dtype, ranges,
                           quantize_mode=quantize_mode)
     return qsym, arg_params, aux_params
+
+
+# ---------------------------------------------------------------------------
+# round 5: whole-graph int8 — BN folding + integer-grid propagation, so a
+# quantized ResNet stays on the int8 grid through pool / relu / residual-add
+# instead of bouncing through dequantize at every boundary
+# (reference: src/operator/quantization/quantized_{pooling,activation,
+# elemwise_add}.cc + the BN-fold every deployed int8 CNN applies)
+# ---------------------------------------------------------------------------
+
+def fold_batch_norm(sym, arg_params, aux_params, eps_default=1e-3):
+    """Fold inference-mode BatchNorm into the preceding Convolution.
+
+    conv -> BN(gamma, beta, mean, var) becomes conv' with
+      w' = w * gamma / sqrt(var + eps)   (per output channel)
+      b' = (b - mean) * gamma / sqrt(var + eps) + beta
+    Returns (new_sym, new_arg_params, new_aux_params). Only BN nodes whose
+    sole input is a Convolution output are folded; others stay (their
+    moving stats remain in aux). The fold is exact for inference
+    (use_global_stats semantics)."""
+    from ..ndarray import ndarray as _nd
+    from ..symbol.symbol import Symbol, _Node
+
+    args = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+            for k, v in arg_params.items()}
+    auxs = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+            for k, v in aux_params.items()}
+    mapping = {}
+    consumed_aux = set()
+
+    def var_of(node_inputs, idx):
+        n, _ = node_inputs[idx]
+        return n.name if n.is_var else None
+
+    def cloned(node):
+        if id(node) in mapping:
+            return mapping[id(node)]
+        new = _Node(node.op, node.name, params=dict(node.params),
+                    attrs=dict(node.attrs))
+        new.aux_mark = node.aux_mark
+        mapping[id(node)] = new
+        new.inputs = [(cloned(n), s) for n, s in node.inputs]
+        if node.op != "BatchNorm":
+            return new
+        src, src_slot = node.inputs[0]
+        if src.is_var or src.op != "Convolution" or src_slot != 0:
+            return new
+        gamma_n = var_of(node.inputs, 1)
+        beta_n = var_of(node.inputs, 2)
+        mean_n = var_of(node.inputs, 3)
+        var_n = var_of(node.inputs, 4)
+        w_n = var_of(src.inputs, 1)
+        if None in (gamma_n, beta_n, mean_n, var_n, w_n) or \
+                w_n not in args or mean_n not in auxs:
+            return new
+        eps = float(node.params.get("eps", eps_default))
+        fix_gamma = bool(node.params.get("fix_gamma", True))
+        gamma = (np.ones_like(auxs[mean_n]) if fix_gamma
+                 else args[gamma_n])
+        beta = args[beta_n]
+        mean, var = auxs[mean_n], auxs[var_n]
+        scale = gamma / np.sqrt(var + eps)
+        w = args[w_n]
+        layout = src.params.get("layout")
+        # weight layouts: OIHW (channels-first) and OHWI (channels-last)
+        # both keep O on axis 0
+        args[w_n + "_bnfold"] = (
+            w * scale.reshape((-1,) + (1,) * (w.ndim - 1))).astype(w.dtype)
+        b_prev = 0.0
+        bias_n = var_of(src.inputs, 2) if len(src.inputs) > 2 else None
+        if bias_n is not None and bias_n in args:
+            b_prev = args[bias_n]
+        args[w_n + "_bnfold_bias"] = (
+            (b_prev - mean) * scale + beta).astype(beta.dtype)
+        conv_clone = cloned(src)  # already cloned as new.inputs[0]
+        from ..symbol.symbol import Variable as _Var
+
+        wv = _Var(w_n + "_bnfold")._outputs[0][0]
+        bv = _Var(w_n + "_bnfold_bias")._outputs[0][0]
+        folded = _Node("Convolution", src.name + "_bnfold",
+                       params={**src.params, "no_bias": False},
+                       inputs=[conv_clone.inputs[0], (wv, 0), (bv, 0)])
+        consumed_aux.update({mean_n, var_n})
+        mapping[id(node)] = folded
+        return folded
+
+    out_sym = Symbol([(cloned(n), s) for n, s in sym._outputs])
+    live_args = set(out_sym.list_arguments())
+    new_args = {k: _nd.array(v) for k, v in args.items() if k in live_args}
+    live_aux = set(out_sym.list_auxiliary_states())
+    new_aux = {k: _nd.array(v) for k, v in auxs.items() if k in live_aux}
+    return out_sym, new_args, new_aux
+
+
+_I32_PRODUCERS = ("_contrib_quantized_conv",
+                  "_contrib_quantized_fully_connected",
+                  "_contrib_quantized_elemwise_add",
+                  "_contrib_quantized_elemwise_mul")
+_I8_PRODUCERS = ("_contrib_quantize_v2", "_contrib_requantize")
+_GRID_PASSTHROUGH = ("_contrib_quantized_pooling", "_contrib_quantized_act",
+                     "_contrib_quantized_flatten")
+
+
+def _grid_of(node):
+    """'int8' / 'int32' / None — which integer grid a node's output rides."""
+    seen = set()
+    while True:
+        if node.is_var or id(node) in seen:
+            return None
+        seen.add(id(node))
+        if node.op in _I32_PRODUCERS:
+            return "int32"
+        if node.op in _I8_PRODUCERS:
+            return "int8"
+        if node.op in _GRID_PASSTHROUGH:
+            node = node.inputs[0][0]
+            continue
+        return None
+
+
+def _int8_grid_propagate(sym):
+    """Peephole pass over a full-mode quantized graph: ops that can run on
+    the integer grid consume their producer's int8/int32 triple directly.
+
+    - quantize_v2(dequantize(int32 triple))  -> requantize(triple)
+    - Pooling(dequantize(int8 triple))       -> quantized_pooling
+    - Activation-relu(dequantize(int8))      -> quantized_act
+    - elemwise_add(deq(int8), deq(int8))     -> quantized_elemwise_add
+    Every rewritten node keeps its original identity as the boundary
+    dequantize, so fp32 consumers are untouched; chained int8 consumers
+    then fold through THEIR dequantize, and XLA DCEs the dead boundaries.
+    """
+    from ..symbol.symbol import _Node
+
+    def deq_src(inp):
+        n, slot = inp
+        if not n.is_var and n.op == "_contrib_dequantize" and slot == 0:
+            q, qs = n.inputs[0]
+            return n, q
+        return None, None
+
+    changed = True
+    while changed:
+        changed = False
+        # one reverse index per pass: producer (node, slot) -> its
+        # quantize/requantize consumer (reused by the residual-add fold)
+        quant_of = {}
+        for n2 in sym._topo_nodes():
+            if not n2.is_var and n2.op in _I8_PRODUCERS and n2.inputs:
+                quant_of[(id(n2.inputs[0][0]), n2.inputs[0][1])] = n2
+        for node in sym._topo_nodes():
+            if node.is_var:
+                continue
+            if node.op == "_contrib_quantize_v2":
+                dq, q = deq_src(node.inputs[0])
+                if dq is not None and _grid_of(q) == "int32":
+                    node.op = "_contrib_requantize"
+                    node.inputs = list(dq.inputs)
+                    node.params = {k: node.params[k] for k in
+                                   ("min_calib_range", "max_calib_range")
+                                   if k in node.params}
+                    changed = True
+            elif node.op == "Pooling":
+                dq, q = deq_src(node.inputs[0])
+                if dq is not None and _grid_of(q) is not None:
+                    qp_params = {k: v for k, v in node.params.items()
+                                 if k in ("kernel", "stride", "pad",
+                                          "pool_type", "global_pool",
+                                          "pooling_convention",
+                                          "count_include_pad", "layout")}
+                    qp = _Node("_contrib_quantized_pooling",
+                               node.name + "_int8",
+                               params=qp_params,
+                               inputs=list(dq.inputs))
+                    node.op = "_contrib_dequantize"
+                    node.params = {}
+                    node.inputs = [(qp, 0), (qp, 1), (qp, 2)]
+                    changed = True
+            elif node.op == "Activation" and \
+                    node.params.get("act_type", "relu") == "relu":
+                dq, q = deq_src(node.inputs[0])
+                if dq is not None and _grid_of(q) is not None:
+                    qa = _Node("_contrib_quantized_act",
+                               node.name + "_int8",
+                               params={"act_type": "relu"},
+                               inputs=list(dq.inputs))
+                    node.op = "_contrib_dequantize"
+                    node.params = {}
+                    node.inputs = [(qa, 0), (qa, 1), (qa, 2)]
+                    changed = True
+            elif node.op in ("elemwise_add", "broadcast_add", "_plus"):
+                # an operand joins the int8-grid add if it is (a) a
+                # dequantize of an int8 triple, (b) a dequantize of an
+                # int32 triple (requantized first), or (c) an fp32 value
+                # some OTHER consumer already quantizes (the residual-skip
+                # case: the next conv's quantize_v2 holds its triple —
+                # reuse it instead of quantizing twice)
+                def int8_triple(inp):
+                    dq, q = deq_src(inp)
+                    if dq is not None:
+                        g = _grid_of(q)
+                        if g == "int8":
+                            return list(dq.inputs)
+                        if g == "int32":
+                            rq = _Node("_contrib_requantize",
+                                       q.name + "_rq",
+                                       inputs=list(dq.inputs))
+                            return [(rq, 0), (rq, 1), (rq, 2)]
+                    qn = quant_of.get((id(inp[0]), inp[1]))
+                    if qn is not None:
+                        return [(qn, 0), (qn, 1), (qn, 2)]
+                    return None
+
+                ta = int8_triple(node.inputs[0])
+                tb = int8_triple(node.inputs[1])
+                if ta is not None and tb is not None:
+                    qadd = _Node(
+                        "_contrib_quantized_elemwise_add",
+                        node.name + "_int8",
+                        inputs=[ta[0], tb[0], ta[1], ta[2], tb[1], tb[2]])
+                    node.op = "_contrib_dequantize"
+                    node.params = {}
+                    node.inputs = [(qadd, 0), (qadd, 1), (qadd, 2)]
+                    changed = True
+    return sym

@@ -3,4 +3,4 @@ from . import estimator
 from . import nn
 from . import rnn
 from .estimator import Estimator
-from .nn import Remat
+from .nn import MultiHeadAttention, Remat

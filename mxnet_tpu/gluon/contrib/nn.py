@@ -167,3 +167,86 @@ class Remat(HybridBlock):
 
     def hybrid_forward(self, F, *args):  # pragma: no cover - forward() used
         return self.block(*args)
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention block with a selectable attention kernel —
+    the Block-API door to the framework's best attention paths (round-5:
+    previously the Pallas kernel was reachable only through
+    parallel.attention, invisible to gluon models).
+
+    impl:
+      - 'dense': fused XLA composition (differentiable, any backend)
+      - 'flash': Pallas streaming kernel, O(T) HBM, trainable via
+        custom_vjp (ops/pallas_kernels.flash_attention_with_grad)
+      - 'ring':  sequence-parallel ring attention over `mesh`'s
+        `sp_axis` (parallel/ring_attention.py) — for T beyond one chip
+      - 'auto':  picks per shape/backend (parallel.attention)
+
+    Self-attention: ``block(x)`` with x (B, L, units). Cross-attention:
+    ``block(x, key_value)`` with key_value (B, S, units) — q projects
+    from x, k/v from key_value (the reference's encdec interleaved
+    layout, contrib/transformer.cc:736-819). Output (B, L, units).
+    """
+
+    def __init__(self, units, num_heads, impl="dense", causal=False,
+                 use_bias=True, mesh=None, sp_axis="sp", dtype=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by num_heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._impl = impl
+        self._causal = causal
+        self._mesh = mesh
+        self._sp_axis = sp_axis
+        with self.name_scope():
+            self.qkv_proj = _nn.Dense(3 * units, use_bias=use_bias,
+                                      flatten=False, prefix="qkv_")
+            # cross-attention path: q from the query stream, interleaved
+            # k/v from the key_value stream (weights shared with qkv_proj
+            # would change self-attention checkpoints; separate layers)
+            self.q_proj = _nn.Dense(units, use_bias=use_bias,
+                                    flatten=False, in_units=units,
+                                    prefix="q_")
+            self.kv_proj = _nn.Dense(2 * units, use_bias=use_bias,
+                                     flatten=False, in_units=units,
+                                     prefix="kv_")
+            self.out_proj = _nn.Dense(units, use_bias=use_bias,
+                                      flatten=False, prefix="out_")
+
+    def _split_heads(self, F, x, n):
+        # (B, L, n*units) -> n tensors (B, H, L, d)
+        b_l_u = x.shape
+        h, d = self._heads, self._units // self._heads
+        x = F.reshape(x, shape=(b_l_u[0], b_l_u[1], n * h, d))
+        x = F.transpose(x, axes=(0, 2, 1, 3))  # (B, n*H, L, d)
+        return [F.slice_axis(x, axis=1, begin=i * h, end=(i + 1) * h)
+                for i in range(n)]
+
+    def hybrid_forward(self, F, x, key_value=None):
+        if key_value is None:
+            q, k, v = self._split_heads(F, self.qkv_proj(x), 3)
+        else:
+            (q,) = self._split_heads(F, self.q_proj(x), 1)
+            k, v = self._split_heads(F, self.kv_proj(key_value), 2)
+        if self._impl in ("dense", "flash"):
+            out = F.scaled_dot_product_attention(
+                q, k, v, causal=self._causal, impl=(
+                    "flash" if self._impl == "flash" else "xla"))
+        elif self._impl in ("ring", "auto"):
+            from ... import parallel
+
+            # per-hop kernel: 'auto' picks the Pallas flash kernel on TPU
+            # and the dense composition on CPU meshes (virtual-device CI)
+            out = parallel.attention(q, k, v, causal=self._causal,
+                                     mesh=self._mesh,
+                                     axis_name=self._sp_axis, impl="auto")
+        else:
+            raise ValueError(f"unknown impl {self._impl!r}")
+        b, h, l, d = out.shape
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(b, l, h * d))
+        return self.out_proj(out)

@@ -625,14 +625,17 @@ def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
     in parallel/ring_attention.py). q,k,v: (B, H, L, D).
 
     impl='flash' opts into the Pallas streaming kernel
-    (ops/pallas_kernels.py): O(T) HBM instead of the O(T^2) score matrix —
-    the inference path for sequences dense attention can't hold. Forward
-    only (no VJP registered); the default XLA composition is
-    differentiable and is what training uses."""
+    (ops/pallas_kernels.py): O(T) HBM instead of the O(T^2) score matrix.
+    Trainable: the op routes through flash_attention_with_grad
+    (custom_vjp, blockwise backward from the saved log-sum-exp), so
+    nd/sym/gluon models using impl='flash' get the kernel in BOTH passes
+    — round-5 fix; previously the op was forward-only and training
+    silently fell back to the dense path."""
     if impl == "flash":
         import warnings
 
-        from .pallas_kernels import flash_attention, pallas_available
+        from .pallas_kernels import flash_attention_with_grad, \
+            pallas_available
 
         if mask is not None:
             raise ValueError(
@@ -645,7 +648,8 @@ def _sdpa(q, k, v, mask=None, causal=False, scale=None, impl="xla"):
                 # a program compiled for a CPU device cannot lower the TPU
                 # kernel — eager NDArray callers get automatic placement
                 # via pallas_kernels.flash_attention instead.
-                return flash_attention(q, k, v, causal=causal, scale=scale)
+                return flash_attention_with_grad(q, k, v, causal=causal,
+                                                 scale=scale)
             except ValueError as e:  # shape gate (trace-time)
                 warnings.warn(f"impl='flash': {e}; falling back to XLA")
         else:

@@ -340,3 +340,70 @@ def flash_attention_with_grad(q, k, v, causal=False, scale=None,
 
     f.defvjp(f_fwd, f_bwd)
     return f(q, k, v)
+
+
+def conv3x3_bn_stats(x, w, interpret=False):
+    """Fused 3x3 stride-1 SAME conv + BatchNorm statistics (round-5
+    PERF experiment, VERDICT r4 next #1b).
+
+    x (N, H, W, C_in) NHWC; w (3, 3, C_in, C_out). Returns
+    (y (N, H, W, C_out), sum_c (C_out,), sumsq_c (C_out,)) where the
+    per-channel sums are accumulated INSIDE the conv epilogue while the
+    output tile is still in VMEM — the one fusion XLA structurally cannot
+    do (a full-reduction consumer inside a conv producer), saving the
+    separate stats read pass over y that makes BN training HBM-bound
+    (PERF.md roofline). Grid over N; per-step compute is 9 shifted
+    (H*W, C_in) @ (C_in, C_out) MXU matmuls.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n, h, wd, cin = x.shape
+    cout = w.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+    def kernel(xr, wr, yr, sr, qr):
+        i = pl.program_id(0)
+        acc = jnp.zeros((h * wd, cout), jnp.float32)
+        for kh in range(3):
+            for kw in range(3):
+                tap = xr[0, kh:kh + h, kw:kw + wd, :].reshape(h * wd, cin)
+                acc += jax.lax.dot(
+                    tap, wr[kh, kw],
+                    preferred_element_type=jnp.float32)
+        yr[0] = acc.reshape(h, wd, cout).astype(yr.dtype)
+        psum = jnp.sum(acc, axis=0)
+        psq = jnp.sum(acc * acc, axis=0)
+
+        @pl.when(i == 0)
+        def _init():
+            sr[...] = psum
+            qr[...] = psq
+
+        @pl.when(i != 0)
+        def _acc():
+            sr[...] += psum
+            qr[...] += psq
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h + 2, wd + 2, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, wd, cout), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((cout,), lambda i: (0,)),
+            pl.BlockSpec((cout,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((cout,), jnp.float32),
+            jax.ShapeDtypeStruct((cout,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, w)

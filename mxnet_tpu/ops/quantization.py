@@ -231,24 +231,31 @@ def _quantized_pooling(data, min_data, max_data, kernel=(2, 2), stride=None,
     window = (1, 1) + k
     strides = (1, 1) + s
     pads = ((0, 0), (0, 0)) + tuple(pads_lo_hi)
+    is_i32 = data.dtype == jnp.int32  # int32-accumulator grid passes too
+    lo_init = jnp.iinfo(jnp.int32).min if is_i32 else -128
     if pool_type == "max":
         out = jax.lax.reduce_window(
-            data.astype(jnp.int32), jnp.int32(-128), jax.lax.max,
+            data.astype(jnp.int32), jnp.int32(lo_init), jax.lax.max,
             window, strides, pads).astype(data.dtype)
     elif pool_type == "avg":
+        # float32 accumulation: int32 window sums can overflow int32; the
+        # f32 mantissa costs <=1e-7 relative on the int32 grid (harmless —
+        # the grid itself is a 1/2^31 quantization)
+        acc = jnp.float32
         ssum = jax.lax.reduce_window(
-            data.astype(jnp.int32), jnp.int32(0), jax.lax.add,
+            data.astype(acc), jnp.asarray(0, acc), jax.lax.add,
             window, strides, pads)
         if count_include_pad:
-            cnt = int(_np_prod(k))
-            out = jnp.round(ssum.astype(jnp.float32) / cnt)
+            cnt = float(_np_prod(k))
         else:
-            ones = jnp.ones(data.shape, jnp.int32)
-            cnt = jax.lax.reduce_window(ones, jnp.int32(0), jax.lax.add,
-                                        window, strides, pads)
-            out = jnp.round(ssum.astype(jnp.float32) /
-                            jnp.maximum(cnt, 1).astype(jnp.float32))
-        out = jnp.clip(out, -127, 127).astype(data.dtype)
+            ones = jnp.ones(data.shape, acc)
+            cnt = jnp.maximum(jax.lax.reduce_window(
+                ones, jnp.asarray(0, acc), jax.lax.add,
+                window, strides, pads), 1.0)
+        out = jnp.round(ssum / cnt)
+        if not is_i32:
+            out = jnp.clip(out, -127, 127)
+        out = out.astype(data.dtype)
     else:
         raise ValueError(f"quantized_pooling: pool_type {pool_type!r}")
     return out, min_data.reshape(()), max_data.reshape(())

@@ -146,6 +146,36 @@ def _eager_fn(op: OpDef, params: dict, device):
     return fn
 
 
+# op-call recording (tools/parity_sweep.py --full): first concrete call
+# per op name is captured so the chip-parity sweep can replay the exact
+# inputs the test suite certified on CPU. Enabled by the
+# MXNET_TPU_RECORD_OPS=<dir> env var (set by the sweep's record phase).
+import os as _os
+
+_RECORD_DIR = None
+_RECORDED: set = set()
+if _os.environ.get("MXNET_TPU_RECORD_OPS"):
+    _RECORD_DIR = _os.environ["MXNET_TPU_RECORD_OPS"]
+    _os.makedirs(_RECORD_DIR, exist_ok=True)
+
+
+def _record_call(op, arrays, params):
+    import pickle
+    import numpy as _rnp
+
+    try:
+        arrs = [None if a is None else _rnp.asarray(a) for a in arrays]
+        if any(a is not None and a.dtype == object for a in arrs):
+            raise TypeError("non-numeric array")
+        fname = f"{_RECORD_DIR}/{op.name.replace('/', '_')}.pkl"
+        with open(fname, "wb") as f:
+            pickle.dump({"name": op.name, "arrays": arrs,
+                         "params": params}, f)
+        _RECORDED.add(op.name)
+    except Exception:  # unpicklable param / lazy array: skip silently
+        _RECORDED.add(op.name)
+
+
 def apply_op(name, *arrays, device=None, **params):
     """Run an op on raw jax arrays. Inside a trace, call the function
     directly so everything fuses into the surrounding jit; eagerly, go
@@ -154,7 +184,11 @@ def apply_op(name, *arrays, device=None, **params):
     params = op.normalize(params)
     import jax.core as jcore
 
-    if device is None or any(isinstance(a, jcore.Tracer) for a in arrays):
+    is_traced = any(isinstance(a, jcore.Tracer) for a in arrays)
+    if _RECORD_DIR is not None and op.name not in _RECORDED and \
+            not is_traced:
+        _record_call(op, arrays, params)
+    if device is None or is_traced:
         return op.closed(params)(*arrays)
     # make ctx placement real: move inputs to the requested device (no-op
     # when already there) so the executable and its outputs land on ctx —

@@ -98,8 +98,18 @@ class ShardedTrainer:
             if multiproc:
                 # every process holds the full host value; build each local
                 # shard from it directly — device_put would attempt a
-                # cross-host transfer
-                arr = np.asarray(v)
+                # cross-host transfer. The value must first be made
+                # CONSISTENT across processes: each worker initializes from
+                # its own random stream, and divergent "replicated" buffers
+                # silently train divergent models (losses still agree —
+                # each rank's contribution enters the same psum — but the
+                # weights drift apart; caught by the dryrun's bitwise
+                # cross-rank check). The reference's dist kvstore init
+                # broadcasts rank-0 values (kvstore_dist.h Init); same here.
+                from jax.experimental import multihost_utils
+
+                arr = np.asarray(
+                    multihost_utils.broadcast_one_to_all(np.asarray(v)))
                 return jax.make_array_from_callback(
                     arr.shape, sharding, lambda idx: arr[idx])
             # device_put may alias the input buffer when placement already

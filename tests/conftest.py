@@ -32,3 +32,13 @@ def _fixed_seed():
 
     mx.random.seed(42)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (skip with -m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "exhaustive: full-coverage sweep; the fast tier is "
+        "-m 'not exhaustive and not slow' (~<8 min), the FULL default run "
+        "remains the merge gate")

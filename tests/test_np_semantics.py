@@ -1,0 +1,214 @@
+"""mx.np NumPy-semantics gate (VERDICT r4 missing #4 / next #8).
+
+Parametrized battery comparing mx.np against REAL numpy on the semantics
+the reference implements in 23k LoC of C++ (src/operator/numpy/): dtype
+promotion, true scalars / zero-dim results, bool arrays and bool
+reductions, boolean-mask read and ASSIGNMENT, and numpy indexing rules.
+
+Documented deltas (jax substrate, justified):
+- x64: jax defaults to 32-bit; float64/int64 promotion collapses to
+  32-bit unless JAX_ENABLE_X64. The gate compares KINDS (f/i/u/b) and
+  exact dtypes only within the 32-bit lattice.
+- NumPy 2.0 scalar promotion: jnp follows NEP 50 (value-independent);
+  so does numpy>=2 — they agree here.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+mnp = mx.np
+
+
+def _mk(np_arr):
+    return mnp.array(np_arr)
+
+
+# ------------------------------------------------------- dtype promotion
+
+PROMO_PAIRS = [
+    (np.float32, np.float32),
+    (np.float32, np.int32),
+    (np.int8, np.int32),
+    (np.uint8, np.int8),
+    (np.uint8, np.int32),
+    (np.bool_, np.int8),
+    (np.bool_, np.bool_),
+    (np.int16, np.uint16),
+    (np.float16, np.float32),
+    (np.float16, np.int32),
+]
+
+
+@pytest.mark.parametrize("dt_a,dt_b", PROMO_PAIRS)
+def test_binary_promotion_matches_numpy(dt_a, dt_b):
+    a_np = np.ones((3,), dt_a)
+    b_np = np.ones((3,), dt_b)
+    want = (a_np + b_np).dtype
+    got = (_mk(a_np) + _mk(b_np)).dtype
+    assert np.dtype(got).kind == want.kind, (dt_a, dt_b, got, want)
+    if want.itemsize <= 4:
+        assert np.dtype(got) == want, (dt_a, dt_b, got, want)
+
+
+@pytest.mark.parametrize("dt", [np.float32, np.int32, np.int8, np.uint8])
+def test_python_scalar_does_not_upcast(dt):
+    """NEP-50 rule (numpy>=2 and jnp agree): a Python int scalar adopts
+    the array's dtype."""
+    a_np = np.ones((3,), dt)
+    got = (_mk(a_np) + 2).dtype
+    assert np.dtype(got) == (a_np + 2).dtype
+
+
+def test_true_divide_promotes_to_float():
+    a = np.arange(6, dtype=np.int32)
+    got = _mk(a) / 2
+    assert np.dtype(got.dtype).kind == "f"
+    np.testing.assert_allclose(got.asnumpy(), a / 2)
+
+
+# -------------------------------------------------- true-scalar semantics
+
+def test_reductions_return_zero_dim():
+    a = _mk(np.arange(6, dtype=np.float32).reshape(2, 3))
+    s = a.sum()
+    assert s.shape == ()
+    assert float(s.asnumpy()) == 15.0
+    m = mnp.mean(a)
+    assert m.shape == ()
+
+
+def test_integer_indexing_returns_zero_dim():
+    a = _mk(np.arange(6, dtype=np.float32))
+    x = a[2]
+    assert x.shape == ()
+    assert float(x.asnumpy()) == 2.0
+    # item() gives the true Python scalar
+    assert a[2].item() == 2.0
+
+
+def test_zero_dim_participates_in_arithmetic():
+    a = _mk(np.float32(3.0))
+    b = _mk(np.arange(3, dtype=np.float32))
+    out = (a * b).asnumpy()
+    np.testing.assert_allclose(out, [0, 3, 6])
+
+
+# ----------------------------------------------------------- bool arrays
+
+def test_comparison_yields_bool_dtype():
+    a = _mk(np.arange(5, dtype=np.float32))
+    m = a > 2
+    assert np.dtype(m.dtype) == np.bool_
+    assert m.asnumpy().tolist() == [False, False, False, True, True]
+
+
+def test_bool_reductions():
+    a = _mk(np.array([True, False, True]))
+    assert bool(mnp.any(a).asnumpy()) is True
+    assert bool(mnp.all(a).asnumpy()) is False
+    assert int(a.sum().asnumpy()) == 2  # bool sums as integer
+
+
+def test_logical_ops_on_bool():
+    a = _mk(np.array([True, False]))
+    b = _mk(np.array([True, True]))
+    assert mnp.logical_and(a, b).asnumpy().tolist() == [True, False]
+    assert np.dtype(mnp.logical_and(a, b).dtype) == np.bool_
+
+
+# ------------------------------------------------------ boolean indexing
+
+def test_boolean_mask_read():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = _mk(a_np)
+    m = a > 5
+    np.testing.assert_allclose(a[m].asnumpy(), a_np[a_np > 5])
+
+
+@pytest.mark.parametrize("case", ["scalar", "matching_tensor", "single"])
+def test_boolean_mask_assign(case):
+    a_np = np.arange(8, dtype=np.float32)
+    a = _mk(a_np.copy())
+    mask_np = a_np % 3 == 0
+    if case == "scalar":
+        a_np[mask_np] = -5.0
+        a[_mk(mask_np)] = -5.0
+    elif case == "matching_tensor":
+        vals = np.array([10.0, 20, 30], np.float32)
+        a_np[mask_np] = vals
+        a[_mk(mask_np)] = _mk(vals)
+    else:
+        vals = np.array([7.0], np.float32)
+        a_np[mask_np] = vals
+        a[_mk(mask_np)] = _mk(vals)
+    np.testing.assert_allclose(a.asnumpy(), a_np)
+
+
+def test_boolean_mask_assign_2d_leading_axis():
+    a_np = np.arange(12, dtype=np.float32).reshape(4, 3)
+    a = _mk(a_np.copy())
+    mask_np = np.array([True, False, True, False])
+    vals = np.full((2, 3), -1.0, np.float32)
+    a_np[mask_np] = vals
+    a[_mk(mask_np)] = _mk(vals)
+    np.testing.assert_allclose(a.asnumpy(), a_np)
+
+
+def test_boolean_mask_assign_size_mismatch_raises():
+    a = _mk(np.arange(5, dtype=np.float32))
+    mask = _mk(np.array([True, True, True, False, False]))
+    with pytest.raises(ValueError):
+        a[mask] = _mk(np.array([1.0, 2.0], np.float32))
+
+
+def test_boolean_mask_assign_preserves_dtype():
+    a = _mk(np.arange(4, dtype=np.float16))
+    mask = _mk(np.array([True, False, True, False]))
+    a[mask] = _mk(np.array([1.5, 2.5], np.float32))
+    assert np.dtype(a.dtype) == np.float16
+
+
+# ------------------------------------------------------- indexing rules
+
+def test_negative_and_slice_indexing():
+    a_np = np.arange(10, dtype=np.float32)
+    a = _mk(a_np)
+    np.testing.assert_allclose(a[-1].asnumpy(), a_np[-1])
+    np.testing.assert_allclose(a[2:8:2].asnumpy(), a_np[2:8:2])
+    np.testing.assert_allclose(a[::-1].asnumpy(), a_np[::-1])
+
+
+def test_fancy_indexing():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = _mk(a_np)
+    idx = np.array([2, 0])
+    np.testing.assert_allclose(a[_mk(idx)].asnumpy(), a_np[idx])
+    np.testing.assert_allclose(a[_mk(idx), 1].asnumpy(), a_np[idx, 1])
+
+
+def test_newaxis_and_ellipsis():
+    a_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a = _mk(a_np)
+    assert a[None].shape == (1, 2, 3)
+    assert a[..., 0].shape == (2,)
+    np.testing.assert_allclose(a[..., 0].asnumpy(), a_np[..., 0])
+
+
+# ------------------------------------------------------ broadcast rules
+
+@pytest.mark.parametrize("sa,sb", [((3, 1), (1, 4)), ((1,), (2, 3)),
+                                   ((2, 1, 3), (4, 1)), ((), (2, 2))])
+def test_broadcasting_shapes(sa, sb):
+    a_np = np.ones(sa, np.float32)
+    b_np = np.ones(sb, np.float32)
+    want = (a_np + b_np).shape
+    assert (_mk(a_np) + _mk(b_np)).shape == want
+
+
+def test_out_of_bounds_semantics_documented():
+    """DELTA (documented): jax clamps out-of-bounds gather indices instead
+    of raising like numpy. The gate pins the substrate behavior so a
+    future change is noticed."""
+    a = _mk(np.arange(4, dtype=np.float32))
+    assert float(a[_mk(np.array([10]))].asnumpy()[0]) == 3.0

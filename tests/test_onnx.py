@@ -194,6 +194,7 @@ def test_unsupported_op_raises(tmp_path):
 @pytest.mark.parametrize("ctor", ["squeezenet1_0", "mobilenet_v1_025",
                                   "alexnet", "vgg11", "densenet121",
                                   "inception_v3"])
+@pytest.mark.exhaustive
 def test_model_zoo_roundtrip(ctor, tmp_path):
     """Model-zoo export→import forward equivalence (224² input)."""
     from mxnet_tpu.gluon.model_zoo import vision

@@ -15,6 +15,8 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+
+pytestmark = pytest.mark.exhaustive  # registry-wide sweep: the heavy tier
 import mxnet_tpu.symbol as sym
 from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
 
@@ -703,18 +705,32 @@ EXPLICIT = {
     "BilinearSampler", "GridGenerator", "SpatialTransformer", "ROIPooling",
     "Correlation", "_contrib_DeformableConvolution", "_contrib_fft",
     "_contrib_ifft", "_contrib_count_sketch", "_contrib_quadratic",
-    "_contrib_hawkes_ll", "_contrib_DeformablePSROIPooling",
+    "_contrib_hawkesll", "_contrib_DeformablePSROIPooling",
+    # tests/test_op_tail_r5.py finite-difference checks (round 5)
+    "moments", "reshape_like", "_contrib_AdaptiveAvgPooling2D", "im2col",
+    "col2im", "linalg_extracttrian", "linalg_maketrian", "_slice_assign",
+    "_slice_assign_scalar", "_scatter_set_nd", "_identity_with_attr_like_rhs",
+    "_rnn_param_concat", "_sparse_retain", "_contrib_SyncBatchNorm",
+    "IdentityAttachKLSparseReg", "cast_storage",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
 }
 
 
 def test_gradient_coverage_gate():
     from mxnet_tpu.ops.registry import list_ops
 
+    from mxnet_tpu.ops.registry import get_op
+
     covered = ({c[0] for c in UNARY_GRAD} | {c[0] for c in BINARY_GRAD}
                | EXPLICIT)
     all_ops = set(list_ops())
-    diff_ops = all_ops - NONDIFF
+    # ops registered no_grad (optimizer updates, int8 kernels, box ops,
+    # creation ops...) have no gradient by design — the registry flag is
+    # the source of truth, NONDIFF covers the remaining special cases
+    registry_nondiff = {n for n in all_ops if get_op(n).no_grad}
+    diff_ops = all_ops - NONDIFF - registry_nondiff
     frac = len(covered & diff_ops) / len(diff_ops)
     missing = sorted(diff_ops - covered)
-    assert frac >= 0.8, (
-        f"gradient coverage {frac:.0%} below 80%; missing: {missing}")
+    assert frac >= 0.95, (
+        f"gradient coverage {frac:.0%} below 95%; missing: {missing}")

@@ -467,8 +467,20 @@ def test_calibrate_entropy_op():
     ("reshape_like", {}, [(6,), (2, 3)]),
     ("_contrib_AdaptiveAvgPooling2D", {"output_size": (2, 2)}, [(1, 2, 4, 4)]),
     ("im2col", {"kernel": (2, 2), "stride": (1, 1)}, [(1, 2, 4, 4)]),
+    ("col2im", {"output_size": (4, 4), "kernel": (2, 2), "stride": (2, 2)},
+     [(1, 8, 4)]),
     ("linalg_extracttrian", {}, [(3, 3)]),
     ("linalg_maketrian", {}, [(6,)]),
+    ("_slice_assign", {"begin": (1,), "end": (3,)}, [(4,), (2,)]),
+    ("_slice_assign_scalar", {"scalar": 2.0, "begin": (0,), "end": (2,)},
+     [(4,)]),
+    ("_identity_with_attr_like_rhs", {}, [(3,), (5,)]),
+    ("_rnn_param_concat", {"dim": 0}, [(3,), (4,)]),
+    ("cast_storage", {}, [(3, 2)]),
+    ("_contrib_interleaved_matmul_encdec_qk", {"heads": 2}, [(3, 1, 8),
+                                                            (4, 1, 16)]),
+    ("_contrib_interleaved_matmul_encdec_valatt", {"heads": 2},
+     [(4, 1, 16), (2, 3, 4)]),
 ])
 def test_tail_gradients_via_jax(op, kwargs, shapes):
     """Finite-difference check of the jax.vjp-derived gradients."""
@@ -539,3 +551,46 @@ def test_np_random_tail():
     assert counts.sum() == 100 and counts[1] > counts[0]
     assert mx.np.shares_memory(b, b)
     assert not mx.np.shares_memory(b, e)
+
+
+def test_stateful_tail_gradients():
+    """Gradient checks for the tail ops with aux/mutate outputs or integer
+    side inputs (excluded from the generic parametrization above)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    # _scatter_set_nd: d/d(lhs) keeps non-indexed, d/d(rhs) scatters back
+    lhs = RNG.rand(2, 2).astype(np.float32)
+    rhs = RNG.rand(3).astype(np.float32)
+    idx = np.array([[1, 1, 0], [0, 1, 0]], np.float32)
+    fn = get_op("_scatter_set_nd").fn
+    g_lhs = jax.grad(lambda a: jnp.sum(fn(a, rhs, idx) ** 2))(lhs)
+    assert np.isfinite(np.asarray(g_lhs)).all()
+    g_rhs = jax.grad(lambda r: jnp.sum(fn(lhs, r, idx) ** 2))(rhs)
+    assert np.abs(np.asarray(g_rhs)).sum() > 0
+
+    # _sparse_retain: gradient flows only through kept rows
+    d = RNG.rand(4, 3).astype(np.float32)
+    keep = np.array([0, 2], np.float32)
+    fn = get_op("_sparse_retain").fn
+    g = np.asarray(jax.grad(lambda a: jnp.sum(fn(a, keep)))(d))
+    assert g[0].sum() == 3 and g[1].sum() == 0
+
+    # SyncBatchNorm: differentiable through data/gamma/beta
+    x = RNG.rand(4, 3, 2, 2).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    fn = get_op("_contrib_SyncBatchNorm").closed({"fix_gamma": False})
+    g = jax.grad(lambda a: jnp.sum(fn(a, gamma, beta, mm, mv)[0] ** 2))(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+    # IdentityAttachKLSparseReg: identity gradient on data
+    a = RNG.rand(5).astype(np.float32)
+    fn = get_op("IdentityAttachKLSparseReg").fn
+    g = np.asarray(jax.grad(
+        lambda v: jnp.sum(fn(v, jnp.zeros(()))[0] * a))(a))
+    np.testing.assert_allclose(g, a, rtol=1e-6)

@@ -1,0 +1,104 @@
+"""Quantized-MODEL throughput on the chip (VERDICT r4 next #3).
+
+Builds ResNet-18 (224² NCHW), folds BatchNorm, quantizes the whole graph
+onto the int8 grid (quantize_mode='full' + integer-grid propagation:
+conv/relu/residual-add/global-pool all integer), and measures inference
+img/s against the bf16 and fp32 fp graphs — a model-level number, not a
+matmul-loop microbenchmark. Also reports the int8-vs-fp32 top-1
+agreement on the synthetic batch (accuracy-delta proxy; real-data mAP
+belongs to tools/validate_baselines.py on a data-equipped host).
+
+Usage: python tools/bench_int8.py [--batch 128] [--iters 20]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+
+    import mxnet_tpu as mx
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.contrib.quantization import (fold_batch_norm,
+                                                quantize_model)
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    dev = mx.tpu() if on_tpu else mx.cpu()
+    rng = np.random.RandomState(0)
+
+    net = vision.resnet18_v1(classes=1000)
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 3, 224, 224)))
+    s = net(sym.Variable("data"))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    fargs = {k: v for k, v in params.items() if k in s.list_arguments()}
+    fauxs = {k: v for k, v in params.items()
+             if k in s.list_auxiliary_states()}
+    fs, fargs, fauxs = fold_batch_norm(s, fargs, fauxs)
+
+    calib_x = rng.rand(32, 3, 224, 224).astype(np.float32)
+    calib = mx.io.NDArrayIter(data=calib_x, batch_size=16)
+    qsym, qargs, qaux = quantize_model(
+        fs, fargs, fauxs, calib_mode="naive", calib_data=calib,
+        quantize_mode="full")
+
+    x = rng.rand(args.batch, 3, 224, 224).astype(np.float32)
+
+    def bench(symbol, sargs, saux, dtype=None):
+        a = dict(sargs)
+        xs = x
+        if dtype is not None:
+            a = {k: v.astype(dtype) if v.dtype == np.float32 else v
+                 for k, v in a.items()}
+            xs = x.astype(dtype)
+        ex = symbol.bind(dev, {**a, "data": mx.nd.array(xs, ctx=dev)},
+                         aux_states={k: v.as_in_context(dev)
+                                     for k, v in saux.items()},
+                         grad_req="null")
+        out = ex.forward(is_train=False)[0]
+        out.wait_to_read()
+        # dependency-chained loop: feed a scalar of the output back into
+        # the input so the tunnel can't overlap timing (PERF.md caveat)
+        t0 = time.perf_counter()
+        chain = 0.0
+        for _ in range(args.iters):
+            ex.arg_dict["data"][0, 0, 0, 0] = float(chain)
+            o = ex.forward(is_train=False)[0]
+            chain = float(o.asnumpy()[0, 0]) * 1e-9
+        dt = time.perf_counter() - t0
+        return args.batch * args.iters / dt, out.asnumpy()
+
+    res = {}
+    res["fp32"], out_fp = bench(fs, fargs, fauxs)
+    res["bf16"], _ = bench(fs, fargs, fauxs, dtype="bfloat16")
+    res["int8"], out_q = bench(qsym, qargs, qaux)
+    agree = float((out_fp.argmax(1) == out_q.argmax(1)).mean())
+    for k, v in res.items():
+        print(f"{k}: {v:.1f} img/s", file=sys.stderr)
+    print(f"int8/bf16: {res['int8'] / res['bf16']:.2f}x, "
+          f"int8/fp32: {res['int8'] / res['fp32']:.2f}x, "
+          f"top1 agreement vs fp32: {agree:.3f}", file=sys.stderr)
+    import json
+
+    print(json.dumps({"metric": "resnet18_int8_infer",
+                      "img_s": {k: round(v, 1) for k, v in res.items()},
+                      "int8_vs_bf16": round(res["int8"] / res["bf16"], 3),
+                      "top1_agreement": agree}))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,7 +121,17 @@ def battery():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--report", default="PARITY_TPU.json")
+    ap.add_argument("--full", action="store_true",
+                    help="registry-wide record/replay sweep (record on "
+                         "CPU via the test suite, replay cpu-vs-tpu)")
+    ap.add_argument("--catalog", default="/tmp/mxnet_tpu_opcatalog",
+                    help="recorded-call dir for --full (reused if present)")
     args = ap.parse_args()
+
+    if args.full:
+        if not os.path.isdir(args.catalog) or not os.listdir(args.catalog):
+            record_catalog(args.catalog)
+        return replay_catalog(args.catalog, args.report)
 
     import jax
 
@@ -181,6 +191,181 @@ def main():
         print(f"{m}: {d['passed']}/{d['total']} parity checks passed")
     print(f"report -> {args.report}")
     return 0 if ok_all else 1
+
+
+
+
+# ---------------------------------------------------------------------------
+# registry-wide sweep (round 5): record/replay. Phase A runs the per-op
+# test files on CPU with MXNET_TPU_RECORD_OPS=<dir>, capturing the first
+# concrete call of every op (the exact inputs the suite certified
+# against numpy). Phase B replays each call cpu-vs-tpu in both precision
+# modes, comparing outputs (and input-gradients for differentiable ops).
+# ---------------------------------------------------------------------------
+
+RECORD_TEST_FILES = [
+    "tests/test_op_numerics.py", "tests/test_op_tail_r5.py",
+    "tests/test_quantized_tail.py", "tests/test_detection.py",
+    "tests/test_vision_extra.py", "tests/test_image_ops.py",
+    "tests/test_gluon_rnn.py", "tests/test_quantization_pdf.py",
+    "tests/test_compression_group_ops.py",
+    "tests/test_control_flow_bucketing.py",
+]
+
+# stochastic ops: outputs are draws from the seeded key stream — the key
+# advances identically but jax PRNG bit-streams are hash-based and
+# identical across backends, so values ARE comparable; listed ones with
+# device-dependent behavior compare shape/dtype only
+SHAPE_ONLY = {"_shuffle"}
+# host-side calibration ops cannot run under jit; replay them eagerly
+HOST_ONLY = {"_contrib_calibrate_entropy"}
+# eigendecomposition: eigenvector columns are sign-ambiguous across
+# backends; compare |values| (eigenvalues compare exactly)
+ABS_COMPARE = {"linalg_syevd"}
+# documented default-mode exemptions (strict mode must still pass):
+# bilinear sampling computes gather COORDINATES through the bf16 MXU, so
+# sub-ulp coordinate shifts move whole samples — the bf16 envelope does
+# not bound data-dependent gather positions (triage: PERF.md round 5)
+DEFAULT_EXEMPT = {"SpatialTransformer"}
+
+
+GRAD_SKIP = {"linalg_syevd"}  # eigenvector sign ambiguity taints grads
+
+
+def _grad_args(op, arrays, params):
+    import numpy as np
+
+    if op.no_grad or op.name in GRAD_SKIP:
+        return ()
+    return tuple(i for i, a in enumerate(arrays)
+                 if a is not None
+                 and np.issubdtype(np.asarray(a).dtype, np.floating))
+
+
+def replay_catalog(catalog_dir, report_path):
+    import glob
+    import pickle
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu  # registers ops  # noqa: F401
+    import mxnet_tpu.operator  # Custom  # noqa: F401
+    from mxnet_tpu.ops.registry import get_op
+
+    cpu = jax.devices("cpu")[0]
+    tpus = [d for d in jax.devices() if d.platform != "cpu"]
+    if not tpus:
+        print("no TPU visible; --full replay needs a chip", file=sys.stderr)
+        return 2
+    tpu = tpus[0]
+
+    modes = [("strict", "highest", 1e-3, 5e-4),
+             ("default", None, 3e-2, 1.2e-1)]
+    entries = sorted(glob.glob(f"{catalog_dir}/*.pkl"))
+    print(f"replaying {len(entries)} recorded ops", flush=True)
+    report = {"device": str(tpu), "modes": {}}
+    ok_all = True
+    for mode_name, precision, rtol, atol in modes:
+        jax.config.update("jax_default_matmul_precision", precision)
+        results = []
+        for path in entries:
+            with open(path, "rb") as f:
+                rec = pickle.load(f)
+            name = rec["name"]
+            op = get_op(name)
+            t0 = time.time()
+            if mode_name == "default" and name in DEFAULT_EXEMPT:
+                results.append({"op": name, "status": "exempt",
+                                "seconds": 0.0})
+                continue
+            try:
+                fn = op.closed(dict(rec["params"]))
+                gargs = _grad_args(op, rec["arrays"], rec["params"])
+
+                def combined(*arrs):
+                    import jax.numpy as jnp
+
+                    out = fn(*arrs)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    grads = ()
+                    if gargs:
+                        def loss(*fa):
+                            full = list(arrs)
+                            for i, ix in enumerate(gargs):
+                                full[ix] = fa[i]
+                            o = fn(*full)
+                            os_ = o if isinstance(o, tuple) else (o,)
+                            return sum(
+                                jnp.sum(x.astype(jnp.float32)) for x in os_
+                                if jnp.issubdtype(x.dtype, jnp.floating))
+                        try:
+                            grads = jax.grad(loss, argnums=tuple(
+                                range(len(gargs))))(
+                                *[arrs[i] for i in gargs])
+                        except Exception:
+                            grads = ()  # non-differentiable: fwd-only
+                    return tuple(outs) + tuple(grads)
+
+                # ONE compiled executable per device — eager replay would
+                # round-trip the tunnel per primitive and take hours
+                jfn = combined if name in HOST_ONLY else jax.jit(combined)
+
+                def run(dev):
+                    arrs = [a if a is None else jax.device_put(a, dev)
+                            for a in rec["arrays"]]
+                    return [np.asarray(o) for o in jfn(*arrs)]
+
+                ref = run(cpu)
+                got = run(tpu)
+                assert len(ref) == len(got)
+                if name in SHAPE_ONLY:
+                    for r, g_ in zip(ref, got):
+                        assert r.shape == g_.shape and r.dtype == g_.dtype
+                else:
+                    for r, g_ in zip(ref, got):
+                        if name in ABS_COMPARE:
+                            r, g_ = np.abs(r), np.abs(g_)
+                        if np.issubdtype(r.dtype, np.floating):
+                            np.testing.assert_allclose(
+                                g_.astype(np.float64),
+                                r.astype(np.float64), rtol=rtol, atol=atol)
+                        else:
+                            assert (r == g_).all(), "integer outputs differ"
+                status, err = "ok", None
+            except Exception as e:  # noqa: BLE001
+                status, err = "FAIL", f"{type(e).__name__}: {e}"
+                ok_all = False
+            results.append({"op": name, "status": status,
+                            "seconds": round(time.time() - t0, 2),
+                            **({"error": err[:300]} if err else {})})
+            if status != "ok":
+                print(f"[{mode_name}] {name}: {status}", flush=True)
+        passed = sum(r["status"] == "ok" for r in results)
+        report["modes"][mode_name] = {
+            "matmul_precision": precision or "tpu default (bf16 MXU)",
+            "rtol": rtol, "atol": atol, "passed": passed,
+            "total": len(results), "results": results}
+        print(f"[{mode_name}] {passed}/{len(results)} ops pass", flush=True)
+
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"report -> {report_path}")
+    return 0 if ok_all else 1
+
+
+def record_catalog(catalog_dir):
+    import subprocess
+
+    env = dict(os.environ)
+    env["MXNET_TPU_RECORD_OPS"] = catalog_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", *RECORD_TEST_FILES],
+        env=env, cwd=repo)
+    if r.returncode != 0:
+        raise RuntimeError("record phase: test run failed")
 
 
 if __name__ == "__main__":

@@ -55,64 +55,71 @@ def _parse(pattern, text):
     return float(hits[-1]) if hits else None
 
 
-def config_mnist(args):
+def config_mnist(args, smoke=False):
+    cmd = [sys.executable, "examples/train_mnist.py"]
+    cmd += (["--epochs", "1"] if smoke
+            else ["--data", args.mnist, "--epochs", "10"])
     return {
-        "name": "mnist_mlp",
-        "cmd": [sys.executable, "examples/train_mnist.py",
-                "--data", args.mnist, "--epochs", "10"],
+        "name": "mnist_mlp", "cmd": cmd,
         "pattern": r"accuracy'?,\s*([0-9.]+)",
         "threshold": 0.97, "direction": ">=",
         "reference": "tests/python/train/test_mlp.py acceptance",
     }
 
 
-def config_cifar10(args):
+def config_cifar10(args, smoke=False):
+    cmd = [sys.executable,
+           "examples/image_classification/train_cifar10.py"]
+    cmd += (["--epochs", "1", "--batches-per-epoch", "2"] if smoke
+            else ["--data", args.cifar10, "--use-resnet",
+                  "--epochs", "30", "--lr", "0.05"])
     return {
-        "name": "cifar10_resnet",
-        "cmd": [sys.executable, "examples/image_classification/"
-                "train_cifar10.py", "--data", args.cifar10, "--use-resnet",
-                "--epochs", "30", "--lr", "0.05"],
+        "name": "cifar10_resnet", "cmd": cmd,
         "pattern": r"accuracy'?,\s*([0-9.]+)",
         "threshold": 0.80, "direction": ">=",
         "reference": "tests/python/train/test_conv.py-style acceptance",
     }
 
 
-def config_imagenet(args):
-    if args.imagenet_rec and not args.imagenet_val_rec:
+def config_imagenet(args, smoke=False):
+    if not smoke and args.imagenet_rec and not args.imagenet_val_rec:
         # never measure the acceptance bar on training data
         raise SystemExit(
             "--imagenet-rec requires --imagenet-val-rec (held-out top-1)")
+    cmd = [sys.executable,
+           "examples/image_classification/train_imagenet.py"]
+    cmd += (["--epochs", "1", "--batches-per-epoch", "2",
+             "--batch-size", "8"] if smoke
+            else ["--rec", args.imagenet_rec,
+                  "--val-rec", args.imagenet_val_rec, "--epochs", "90"])
     return {
-        "name": "imagenet_resnet50",
-        "cmd": [sys.executable, "examples/image_classification/"
-                "train_imagenet.py", "--rec", args.imagenet_rec,
-                "--val-rec", args.imagenet_val_rec,
-                "--epochs", "90"],
+        "name": "imagenet_resnet50", "cmd": cmd,
         "pattern": r"top1[=:\s]+([0-9.]+)",
         "threshold": 0.7527, "direction": ">=",
         "reference": "example/image-classification/README.md:126",
     }
 
 
-def config_word_lm(args):
+def config_word_lm(args, smoke=False):
+    cmd = [sys.executable, "examples/rnn/word_lm.py"]
+    cmd += (["--epochs", "1"] if smoke
+            else ["--data", args.wikitext2, "--epochs", "40",
+                  "--embed", "650", "--hidden", "650"])
     return {
-        "name": "word_lm_wikitext2",
-        "cmd": [sys.executable, "examples/rnn/word_lm.py",
-                "--data", args.wikitext2, "--epochs", "40",
-                "--embed", "650", "--hidden", "650"],
+        "name": "word_lm_wikitext2", "cmd": cmd,
         "pattern": r"ppl\s+([0-9.]+)",
         "threshold": 91.51, "direction": "<=",
         "reference": "example/gluon/word_language_model/README.md:43",
     }
 
 
-def config_ssd(args):
+def config_ssd(args, smoke=False):
+    cmd = [sys.executable, "examples/ssd/train_ssd.py"]
+    cmd += (["--epochs", "1"] if smoke
+            else ["--imglist", args.voc_imglist, "--root", args.voc_root,
+                  "--epochs", "240"])
     return {
-        "name": "ssd_voc07",
-        "cmd": [sys.executable, "examples/ssd/train_ssd.py",
-                "--imglist", args.voc_imglist, "--root", args.voc_root,
-                "--epochs", "240"],
+        "name": "ssd_voc07", "cmd": cmd,
         "pattern": r"mAP[=:\s]+([0-9.]+)",
         "threshold": 0.778, "direction": ">=",
         "reference": "example/ssd/README.md:66 (VGG16-reduced 300x300)",
@@ -131,6 +138,12 @@ def main():
     ap.add_argument("--voc-root", help="VOC image root dir")
     ap.add_argument("--report", default="parity_report.json")
     ap.add_argument("--only", help="comma-separated config names")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run every config 1 short epoch on synthetic data "
+                         "through the real subprocess + regex plumbing; "
+                         "pass = metric parsed, not the accuracy bar")
+    ap.add_argument("--timeout", type=int, default=24 * 3600,
+                    help="per-config subprocess timeout (seconds)")
     args = ap.parse_args()
 
     candidates = [
@@ -142,28 +155,37 @@ def main():
     ]
     only = set(args.only.split(",")) if args.only else None
 
-    report = {"results": [], "all_passed": True}
+    report = {"results": [], "all_passed": True,
+              "mode": "smoke" if args.smoke else "acceptance"}
     for path, build in candidates:
-        cfg = build(args)
+        cfg = build(args, smoke=args.smoke)
         if only and cfg["name"] not in only:
             continue
-        if not path:
+        if not path and not args.smoke:
             report["results"].append(
                 {"name": cfg["name"], "status": "skipped",
                  "reason": "dataset path not provided"})
             continue
         print(f"== {cfg['name']}: {' '.join(cfg['cmd'])}", flush=True)
         try:
-            r, dt = _run(cfg["cmd"])
+            r, dt = _run(cfg["cmd"],
+                         env_extra=({"MXNET_TPU_SYNTH_DATA": "1"}
+                                    if args.smoke else None),
+                         timeout=args.timeout)
         except subprocess.TimeoutExpired:
             report["results"].append(
                 {"name": cfg["name"], "status": "timeout"})
             report["all_passed"] = False
             continue
         metric = _parse(cfg["pattern"], r.stdout + r.stderr)
-        ok = (r.returncode == 0 and metric is not None and
-              (metric >= cfg["threshold"] if cfg["direction"] == ">="
-               else metric <= cfg["threshold"]))
+        if args.smoke:
+            # smoke: the plumbing worked end to end — subprocess ran, the
+            # metric regex extracted a number; the bar is NOT applied
+            ok = r.returncode == 0 and metric is not None
+        else:
+            ok = (r.returncode == 0 and metric is not None and
+                  (metric >= cfg["threshold"] if cfg["direction"] == ">="
+                   else metric <= cfg["threshold"]))
         report["results"].append({
             "name": cfg["name"], "status": "passed" if ok else "failed",
             "metric": metric, "threshold": cfg["threshold"],
