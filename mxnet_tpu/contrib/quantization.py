@@ -443,7 +443,6 @@ def fold_batch_norm(sym, arg_params, aux_params, eps_default=1e-3):
     auxs = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
             for k, v in aux_params.items()}
     mapping = {}
-    consumed_aux = set()
 
     def var_of(node_inputs, idx):
         n, _ = node_inputs[idx]
@@ -497,7 +496,6 @@ def fold_batch_norm(sym, arg_params, aux_params, eps_default=1e-3):
         folded = _Node("Convolution", src.name + "_bnfold",
                        params={**src.params, "no_bias": False},
                        inputs=[conv_clone.inputs[0], (wv, 0), (bv, 0)])
-        consumed_aux.update({mean_n, var_n})
         mapping[id(node)] = folded
         return folded
 
@@ -579,7 +577,9 @@ def _int8_grid_propagate(sym):
                     changed = True
             elif node.op == "Pooling":
                 dq, q = deq_src(node.inputs[0])
-                if dq is not None and _grid_of(q) is not None:
+                layout_ok = (node.params.get("layout") or "NCHW")[1] == "C"
+                if dq is not None and layout_ok and \
+                        _grid_of(q) is not None:
                     qp_params = {k: v for k, v in node.params.items()
                                  if k in ("kernel", "stride", "pad",
                                           "pool_type", "global_pool",

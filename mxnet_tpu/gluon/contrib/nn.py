@@ -191,7 +191,7 @@ class MultiHeadAttention(HybridBlock):
 
     def __init__(self, units, num_heads, impl="dense", causal=False,
                  use_bias=True, mesh=None, sp_axis="sp", dtype=None,
-                 **kwargs):
+                 cross_attention=False, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise ValueError(f"units {units} not divisible by num_heads "
@@ -203,17 +203,22 @@ class MultiHeadAttention(HybridBlock):
         self._mesh = mesh
         self._sp_axis = sp_axis
         with self.name_scope():
-            self.qkv_proj = _nn.Dense(3 * units, use_bias=use_bias,
-                                      flatten=False, prefix="qkv_")
-            # cross-attention path: q from the query stream, interleaved
-            # k/v from the key_value stream (weights shared with qkv_proj
-            # would change self-attention checkpoints; separate layers)
-            self.q_proj = _nn.Dense(units, use_bias=use_bias,
-                                    flatten=False, in_units=units,
-                                    prefix="q_")
-            self.kv_proj = _nn.Dense(2 * units, use_bias=use_bias,
-                                     flatten=False, in_units=units,
-                                     prefix="kv_")
+            if cross_attention:
+                # q from the query stream, interleaved k/v from the
+                # key_value stream (the encdec layout); created only on
+                # request so self-attention blocks don't carry ~3·units²
+                # dead parameters
+                self.q_proj = _nn.Dense(units, use_bias=use_bias,
+                                        flatten=False, in_units=units,
+                                        prefix="q_")
+                self.kv_proj = _nn.Dense(2 * units, use_bias=use_bias,
+                                         flatten=False, in_units=units,
+                                         prefix="kv_")
+                self.qkv_proj = None
+            else:
+                self.qkv_proj = _nn.Dense(3 * units, use_bias=use_bias,
+                                          flatten=False, prefix="qkv_")
+                self.q_proj = self.kv_proj = None
             self.out_proj = _nn.Dense(units, use_bias=use_bias,
                                       flatten=False, prefix="out_")
 
@@ -228,8 +233,14 @@ class MultiHeadAttention(HybridBlock):
 
     def hybrid_forward(self, F, x, key_value=None):
         if key_value is None:
+            if self.qkv_proj is None:
+                raise ValueError("this block was built with "
+                                 "cross_attention=True; pass key_value")
             q, k, v = self._split_heads(F, self.qkv_proj(x), 3)
         else:
+            if self.q_proj is None:
+                raise ValueError("pass cross_attention=True at construction "
+                                 "for the cross-attention path")
             (q,) = self._split_heads(F, self.q_proj(x), 1)
             k, v = self._split_heads(F, self.kv_proj(key_value), 2)
         if self._impl in ("dense", "flash"):

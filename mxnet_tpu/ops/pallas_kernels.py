@@ -407,3 +407,84 @@ def conv3x3_bn_stats(x, w, interpret=False):
         ],
         interpret=interpret,
     )(xp, w)
+
+
+def conv3x3_bn_relu_train(x, w, gamma, beta, eps=1e-3, interpret=False):
+    """Trainable fused conv3x3(s1, SAME) + batch-stats BN + relu.
+
+    Forward: the Pallas conv3x3_bn_stats kernel — conv output AND the BN
+    statistics in ONE HBM pass (the separate stats read is the pass that
+    makes BN training HBM-bound, PERF.md roofline). Backward:
+    jax.custom_vjp with the standard conv/BN backward in XLA ops —
+    identical structure to what autodiff emits for the unfused forward,
+    so only the forward's traffic changes.
+
+    Returns (out (N,H,W,Cout), mean (Cout,) f32, var (Cout,) f32); mean/
+    var feed the moving-average update (no gradient flows through them).
+    """
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+
+    n, h, wd, cin = x.shape
+    cnt = n * h * wd
+
+    def _fwd_core(x, w, gamma, beta):
+        y_raw, s, q = conv3x3_bn_stats(x, w, interpret=interpret)
+        mean = s / cnt
+        var = jnp.maximum(q / cnt - jnp.square(mean), 0.0)
+        inv32 = jax.lax.rsqrt(var + eps) * gamma.astype(jnp.float32)
+        shift = beta.astype(jnp.float32) - mean * inv32
+        pre = y_raw * inv32.astype(y_raw.dtype) + shift.astype(y_raw.dtype)
+        return jnp.maximum(pre, 0), mean, var, y_raw
+
+    @_ft.partial(jax.custom_vjp)
+    def f(x, w, gamma, beta):
+        out, mean, var, _ = _fwd_core(x, w, gamma, beta)
+        return out, mean, var
+
+    def f_fwd(x, w, gamma, beta):
+        out, mean, var, y_raw = _fwd_core(x, w, gamma, beta)
+        return (out, mean, var), (x, w, gamma, y_raw, mean, var, out)
+
+    def f_bwd(res, cots):
+        x, w, gamma, y_raw, mean, var, out = res
+        dout, dmean, dvar = cots
+        inv = jax.lax.rsqrt(var + eps)
+        g32 = gamma.astype(jnp.float32)
+        dy = jnp.where(out > 0, dout, 0).astype(jnp.float32)
+        y32 = y_raw.astype(jnp.float32)
+        xhat = (y32 - mean) * inv
+        red = (0, 1, 2)
+        dbeta = jnp.sum(dy, axis=red)
+        dgamma = jnp.sum(dy * xhat, axis=red)
+        dxhat = dy * g32
+        # batch-stats BN backward (mean/var are functions of y_raw)
+        dy_raw = (inv / cnt) * (
+            cnt * dxhat - jnp.sum(dxhat, axis=red)
+            - xhat * jnp.sum(dxhat * xhat, axis=red))
+        # cotangents of the exposed stats outputs (e.g. a
+        # stats-regularization term): mean = Σy/cnt,
+        # var = Σy²/cnt − mean² ⇒ ∂var/∂y = 2(y − mean)/cnt
+        dy_raw = dy_raw + dmean.astype(jnp.float32) / cnt \
+            + dvar.astype(jnp.float32) * 2.0 * (y32 - mean) / cnt
+        dy_raw = dy_raw.astype(y_raw.dtype)
+        # conv backward: dgrad via transposed kernel, wgrad via x*dy conv
+        dx = jax.lax.conv_general_dilated(
+            dy_raw, jnp.flip(jnp.asarray(w), (0, 1)).swapaxes(2, 3),
+            (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # wgrad: x^T (Cin,H,W,N) conv dy^T (H,W,N,Cout) with pad 1 ->
+        # (Cin, 3, 3, Cout)
+        dw = jax.lax.conv_general_dilated(
+            jnp.transpose(jnp.asarray(x), (3, 1, 2, 0)),
+            jnp.transpose(dy_raw, (1, 2, 0, 3)), (1, 1),
+            ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dw = jnp.transpose(dw, (1, 2, 0, 3)).astype(w.dtype)
+        return (dx.astype(x.dtype), dw, dgamma.astype(gamma.dtype),
+                dbeta.astype(gamma.dtype))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x, w, gamma, beta)

@@ -93,23 +93,29 @@ class ShardedTrainer:
         import jax.numpy as jnp
 
         multiproc = self._multiproc
+        if multiproc:
+            # Host values must first be made CONSISTENT across processes:
+            # each worker initializes from its own random stream, and
+            # divergent "replicated" buffers silently train divergent
+            # models (losses still agree — each rank's contribution enters
+            # the same psum — but the weights drift apart; caught by the
+            # dryrun's bitwise cross-rank check). The reference's dist
+            # kvstore init broadcasts rank-0 values (kvstore_dist.h Init);
+            # ONE pytree-level broadcast covers params+aux+opt_state
+            # instead of one collective per leaf.
+            from jax.experimental import multihost_utils
+
+            host_tree = jax.tree.map(
+                np.asarray, (self.params, self.aux, self.opt_state))
+            self.params, self.aux, self.opt_state = \
+                multihost_utils.broadcast_one_to_all(host_tree)
 
         def put(v, sharding):
             if multiproc:
-                # every process holds the full host value; build each local
-                # shard from it directly — device_put would attempt a
-                # cross-host transfer. The value must first be made
-                # CONSISTENT across processes: each worker initializes from
-                # its own random stream, and divergent "replicated" buffers
-                # silently train divergent models (losses still agree —
-                # each rank's contribution enters the same psum — but the
-                # weights drift apart; caught by the dryrun's bitwise
-                # cross-rank check). The reference's dist kvstore init
-                # broadcasts rank-0 values (kvstore_dist.h Init); same here.
-                from jax.experimental import multihost_utils
-
-                arr = np.asarray(
-                    multihost_utils.broadcast_one_to_all(np.asarray(v)))
+                # every process now holds identical full host values; build
+                # each local shard directly — device_put would attempt a
+                # cross-host transfer
+                arr = np.asarray(v)
                 return jax.make_array_from_callback(
                     arr.shape, sharding, lambda idx: arr[idx])
             # device_put may alias the input buffer when placement already
@@ -265,8 +271,17 @@ class ShardedTrainer:
             x = assemble(x)
             y = assemble(y)
         else:
-            x = jax.device_put(x, self._batch_sharding)
-            y = jax.device_put(y, self._batch_sharding)
+            # skip the put when the batch already sits on the mesh with
+            # the right sharding (the steady-state training loop) — the
+            # redundant device_put costs ~0.5% of step time (PERF.md
+            # round-5 wrapper A/B)
+            bs = self._batch_sharding
+            if not (isinstance(x, jax.Array) and
+                    x.sharding.is_equivalent_to(bs, x.ndim)):
+                x = jax.device_put(x, bs)
+            if not (isinstance(y, jax.Array) and
+                    y.sharding.is_equivalent_to(bs, y.ndim)):
+                y = jax.device_put(y, bs)
         self.params, self.aux, self.opt_state, loss = self._step(
             self.params, self.aux, self.opt_state, x, y)
         return loss

@@ -29,15 +29,15 @@ def test_block_forward_and_grad_dense():
         loss = (out * out).sum()
     loss.backward()
     assert out.shape == (2, 12, 32)
-    for name, p in blk.collect_params().items():
-        if "_q_" in name or "_kv_" in name:
-            continue  # cross-attention projections: unused in self-attn
+    for p in blk.collect_params().values():
         g = p.grad().asnumpy()
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
 
 def test_block_cross_attention():
-    blk = _mha("dense", causal=False)
+    blk = contrib.MultiHeadAttention(32, 4, impl="dense", causal=False,
+                                     cross_attention=True)
+    blk.initialize()
     x = mx.nd.array(RNG.randn(2, 6, 32).astype(np.float32))
     kv = mx.nd.array(RNG.randn(2, 9, 32).astype(np.float32))
     with autograd.record():

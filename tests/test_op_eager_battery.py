@@ -62,6 +62,9 @@ CASES = [
     ("zeros_like", (_f(3, 3),), {}),
     ("boolean_mask", (_f(4, 3), np.array([1, 0, 1, 1], np.float32)), {}),
     ("amp_cast", (_f(3, 3),), {"dtype": "bfloat16"}),
+    ("amp_multicast", (_f(3, 3), _f(3, 3).astype(np.float16)),
+     {"num_outputs": 2}),
+    ("_ones", (), {"shape": (2, 3)}),
     ("all_finite", (_f(3, 3),), {}),
     ("scaled_dot_product_attention",
      (_f(1, 2, 8, 4), _f(1, 2, 8, 4), _f(1, 2, 8, 4)), {"causal": True}),

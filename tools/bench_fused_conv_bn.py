@@ -73,15 +73,19 @@ def main():
         return y * inv.astype(y.dtype) + shift.astype(y.dtype)
 
     def timed(fn):
-        out = fn(x, w)
-        out.block_until_ready()
-        # dependency chain through the input so tunnel timing is honest
-        xi = x
+        # the data-dependency chain lives INSIDE one jitted fori_loop:
+        # per-iteration eager chain ops would round-trip the tunnel
+        # (~100 ms/dispatch) and bury the kernel time
+        @jax.jit
+        def many(x, w):
+            def body(_, xi):
+                out = fn(xi, w)  # nested jit inlines into the loop body
+                return xi + out[0, 0, 0, 0].astype(xi.dtype) * 1e-12
+            return jax.lax.fori_loop(0, args.iters, body, x)
+
+        many(x, w).block_until_ready()  # compile + warm
         t0 = time.perf_counter()
-        for _ in range(args.iters):
-            out = fn(xi, w)
-            xi = xi + out[0, 0, 0, 0].astype(xi.dtype) * 1e-12
-        out.block_until_ready()
+        many(x, w).block_until_ready()
         return (time.perf_counter() - t0) / args.iters * 1e3
 
     # numeric check first

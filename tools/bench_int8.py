@@ -65,6 +65,7 @@ def main():
             a = {k: v.astype(dtype) if v.dtype == np.float32 else v
                  for k, v in a.items()}
             xs = x.astype(dtype)
+        a = {k: v.as_in_context(dev) for k, v in a.items()}
         ex = symbol.bind(dev, {**a, "data": mx.nd.array(xs, ctx=dev)},
                          aux_states={k: v.as_in_context(dev)
                                      for k, v in saux.items()},

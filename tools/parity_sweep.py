@@ -217,8 +217,11 @@ RECORD_TEST_FILES = [
 # identical across backends, so values ARE comparable; listed ones with
 # device-dependent behavior compare shape/dtype only
 SHAPE_ONLY = {"_shuffle"}
-# host-side calibration ops cannot run under jit; replay them eagerly
-HOST_ONLY = {"_contrib_calibrate_entropy"}
+# ops that cannot run under jit (host-side calibration; data-dependent
+# output shapes) replay eagerly — the deferred-shape boundary the
+# reference handles with dynamic-shape NDArrays (SURVEY "excl" rows)
+HOST_ONLY = {"_contrib_calibrate_entropy", "boolean_mask",
+             "_sample_multinomial"}
 # eigendecomposition: eigenvector columns are sign-ambiguous across
 # backends; compare |values| (eigenvalues compare exactly)
 ABS_COMPARE = {"linalg_syevd"}

@@ -51,7 +51,7 @@ def replica_init(rng, dtype=np.float32):
     channels = [64, 256, 512, 1024, 2048]
     layers = [3, 4, 6, 3]
     for st, (n, cout) in enumerate(zip(layers, channels[1:])):
-        cin = channels[st] if st == 0 else channels[st]
+        cin = channels[st]
         for b in range(n):
             p = f"s{st}b{b}"
             c_in = cin if b == 0 else cout
