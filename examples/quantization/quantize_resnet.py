@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Whole-graph INT8 quantization of a ResNet (reference
+example/quantization/imagenet_gen_qsym.py capability).
+
+Pipeline: train/initialize fp32 -> fold BatchNorm into convs ->
+calibrate (naive min/max or entropy/KL) -> quantize_mode='full' with
+integer-grid propagation -> the resulting graph holds ONE quantize at
+the input and ONE dequantize at the output; conv / relu / residual-add /
+global-pool all run on the int8/int32 integer grid (real MXU int8
+matmuls, PERF.md: 1.45x bf16 model-level on chip).
+
+    python examples/quantization/quantize_resnet.py [--calib entropy]
+"""
+import argparse
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.contrib.quantization import fold_batch_norm, quantize_model
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calib", default="naive", choices=["naive", "entropy"])
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 3, 32, 32)))
+
+    s = net(sym.Variable("data"))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    fargs = {k: v for k, v in params.items() if k in s.list_arguments()}
+    fauxs = {k: v for k, v in params.items()
+             if k in s.list_auxiliary_states()}
+
+    print("folding BatchNorm into convolutions...")
+    fs, fargs, fauxs = fold_batch_norm(s, fargs, fauxs)
+
+    calib_x = rng.rand(4 * args.batch, 3, 32, 32).astype(np.float32)
+    calib = mx.io.NDArrayIter(data=calib_x, batch_size=args.batch)
+    print(f"calibrating ({args.calib}) + quantizing...")
+    qsym, qargs, qaux = quantize_model(
+        fs, fargs, fauxs, calib_mode=args.calib, calib_data=calib,
+        quantize_mode="full")
+
+    ops = Counter(n.op for n in qsym._topo_nodes() if not n.is_var)
+    print("quantized graph:", dict(ops))
+    assert ops["_contrib_quantize_v2"] == 1, "input quantize only"
+    assert ops["_contrib_dequantize"] == 1, "output dequantize only"
+
+    x = rng.rand(args.batch, 3, 32, 32).astype(np.float32)
+
+    def run(symbol, a, aux):
+        ex = symbol.bind(mx.cpu(), {**a, "data": mx.nd.array(x)},
+                         aux_states=aux, grad_req="null")
+        return ex.forward(is_train=False)[0].asnumpy()
+
+    fp = run(fs, fargs, fauxs)
+    q = run(qsym, qargs, qaux)
+    agree = float((fp.argmax(1) == q.argmax(1)).mean())
+    print(f"top-1 agreement int8 vs fp32: {agree:.3f}")
+    print(f"max |logit delta| / scale: "
+          f"{np.abs(fp - q).max() / np.abs(fp).max():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,7 @@ from ..util import (is_np_array, is_np_shape, reset_np, set_np,  # noqa: F401
                     set_np_shape, use_np, use_np_array, use_np_shape)
 
 __all__ = ["reshape", "nonzero", "constraint_check", "set_np", "reset_np",
-           "use_np", "is_np_array", "is_np_shape"]
+           "use_np", "is_np_array", "is_np_shape", "save", "load"]
 
 
 def _jnp():
@@ -103,6 +103,32 @@ def constraint_check(condition, msg="Constraint violated!"):
     if not bool(_jnp().all(x)):
         raise ValueError(msg)
     return _wrap(_jnp().asarray(True))
+
+
+def save(file, arr):
+    """Save an mx.np array / list / dict (numpy_extension/utils.py:save);
+    byte-compatible with mx.nd.save, values reload as mx.np arrays."""
+    from ..ndarray import ndarray as _nd
+
+    def to_nd(a):
+        return _nd.NDArray(_unwrap(a))
+
+    if isinstance(arr, dict):
+        _nd.save(file, {k: to_nd(v) for k, v in arr.items()})
+    elif isinstance(arr, (list, tuple)):
+        _nd.save(file, [to_nd(v) for v in arr])
+    else:
+        _nd.save(file, [to_nd(arr)])
+
+
+def load(file):
+    """Load arrays saved by npx.save / nd.save as mx.np ndarrays."""
+    from ..ndarray import ndarray as _nd
+
+    out = _nd.load(file)
+    if isinstance(out, dict):
+        return {k: _wrap(v._data) for k, v in out.items()}
+    return [_wrap(v._data) for v in out]
 
 
 def __getattr__(name):

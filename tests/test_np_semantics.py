@@ -212,3 +212,19 @@ def test_out_of_bounds_semantics_documented():
     future change is noticed."""
     a = _mk(np.arange(4, dtype=np.float32))
     assert float(a[_mk(np.array([10]))].asnumpy()[0]) == 3.0
+
+
+def test_npx_save_load_roundtrip(tmp_path):
+    """npx.save/load (numpy_extension/utils.py parity): dict and list
+    forms, values come back as mx.np ndarrays."""
+    p = str(tmp_path / "arrs.params")
+    d = {"a": mnp.array(np.arange(4, dtype=np.float32)),
+         "b": mnp.array(np.ones((2, 2), np.float32))}
+    mx.npx.save(p, d)
+    back = mx.npx.load(p)
+    assert set(back) == {"a", "b"}
+    assert isinstance(back["a"], mnp.ndarray)
+    np.testing.assert_allclose(back["a"].asnumpy(), np.arange(4))
+    mx.npx.save(p, [mnp.array(np.zeros(3, np.float32))])
+    lst = mx.npx.load(p)
+    assert isinstance(lst, list) and lst[0].shape == (3,)

@@ -247,3 +247,30 @@ def test_import_accepts_packed_repeated_fields():
          + P.emit_bytes(9, np.arange(6, dtype=np.float32).tobytes()))
     tname, arr = _parse_tensor(t)
     assert tname == "w" and arr.shape == (2, 3)
+
+
+def test_softmax_non_trailing_axis_transpose_wrapped(tmp_path):
+    """Opset-11 Softmax coerces to 2D (normalizes over ALL dims from axis
+    on); exporting a 4D softmax(axis=1) must transpose-wrap to stay
+    single-axis (round-5 fix). The round-trip must reproduce mxnet
+    semantics, and the graph must contain the Transpose pair."""
+    data = sym.Variable("data")
+    out = sym.softmax(data, axis=1)
+    path = str(tmp_path / "sm4d.onnx")
+    onnx_mxnet.export_model(out, {}, [(2, 3, 4, 5)], onnx_file_path=path)
+    blob = open(path, "rb").read()
+    assert b"Transpose" in blob
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    x = RNG.rand(2, 3, 4, 5).astype(np.float32)
+    ref = _eval_symbol(out, {"data": x})
+    got = _eval_symbol(sym2, {"data": x})
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-5, atol=1e-6)
+    # trailing axis stays a bare Softmax (no wrap)
+    out2 = sym.softmax(sym.Variable("data"), axis=-1)
+    path2 = str(tmp_path / "smtrail.onnx")
+    onnx_mxnet.export_model(out2, {}, [(2, 3, 4, 5)],
+                            onnx_file_path=path2)
+    sym3, _, _ = onnx_mxnet.import_model(path2)
+    got2 = _eval_symbol(sym3, {"data": x})
+    np.testing.assert_allclose(got2[0], _eval_symbol(out2, {"data": x})[0],
+                               rtol=1e-5, atol=1e-6)
