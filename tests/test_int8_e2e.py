@@ -92,3 +92,63 @@ def test_grid_propagate_requantize_fuses_quantize_of_dequantize():
     out = _int8_grid_propagate(q2)
     ops = [n.op for n in out._topo_nodes() if not n.is_var]
     assert "_contrib_requantize" in ops
+
+
+def test_int8_ssd_detection_agreement():
+    """SSD through the full-int8 flow (the reference publishes SSD
+    int8-vs-fp32 mAP, example/ssd/README.md:45-46; no dataset lives in
+    this environment, so the evidence is detection agreement on
+    synthetic input): quantize the detector's convolutions, keep the
+    multibox ops fp32, and demand that post-NMS detections match."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ssd_example", os.path.join(os.path.dirname(__file__), "..",
+                                    "examples", "ssd", "train_ssd.py"))
+    ssd_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ssd_mod)
+
+    net = ssd_mod.SSD(ssd_mod.N_CLASSES)
+    net.initialize(mx.initializer.Xavier())
+    x_nd = mx.nd.array(RNG.rand(2, 3, 64, 64).astype(np.float32))
+    net(x_nd)
+
+    outs = net(sym.Variable("data"))
+    s = sym.Group(list(outs))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    args = {k: v for k, v in params.items() if k in s.list_arguments()}
+    auxs = {k: v for k, v in params.items()
+            if k in s.list_auxiliary_states()}
+
+    x = RNG.rand(4, 3, 64, 64).astype(np.float32)
+    calib = mx.io.NDArrayIter(data=x, batch_size=2)
+    qsym, qargs, qaux = quantize_model(
+        s, args, auxs, calib_mode="naive", calib_data=calib,
+        quantize_mode="full")
+    ops = [n.op for n in qsym._topo_nodes() if not n.is_var]
+    assert ops.count("_contrib_quantized_conv") == 7  # all convs int8
+    assert "_contrib_MultiBoxPrior" in ops            # multibox stays fp32
+
+    def detections(symbol, a, aux):
+        ex = symbol.bind(mx.cpu(), {**a, "data": mx.nd.array(x)},
+                         aux_states=aux, grad_req="null")
+        anchors, cls_pred, loc_pred = ex.forward(is_train=False)
+        cls_prob = mx.nd.softmax(cls_pred, axis=1)
+        det = mx.nd.contrib.MultiBoxDetection(
+            cls_prob, loc_pred, anchors, nms_threshold=0.45)
+        return det.asnumpy()
+
+    det_fp = detections(s, args, auxs)
+    det_q = detections(qsym, qargs, qaux)
+    # per-image top detection: same class, overlapping box
+    for i in range(det_fp.shape[0]):
+        top_fp = det_fp[i][det_fp[i][:, 0] >= 0]
+        top_q = det_q[i][det_q[i][:, 0] >= 0]
+        if len(top_fp) == 0:
+            continue
+        assert len(top_q) > 0, "int8 lost all detections"
+        assert top_fp[0, 0] == top_q[0, 0], "top-detection class changed"
+        # box corners within a few int8 steps
+        np.testing.assert_allclose(top_q[0, 2:6], top_fp[0, 2:6],
+                                   atol=0.08)
