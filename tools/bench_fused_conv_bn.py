@@ -83,9 +83,11 @@ def main():
                 return xi + out[0, 0, 0, 0].astype(xi.dtype) * 1e-12
             return jax.lax.fori_loop(0, args.iters, body, x)
 
-        many(x, w).block_until_ready()  # compile + warm
+        # host-read timing: block_until_ready through the tunnel returns
+        # early even for sub-second programs (PERF.md caveat)
+        float(many(x, w)[0, 0, 0, 0].astype(jnp.float32))  # compile+warm
         t0 = time.perf_counter()
-        many(x, w).block_until_ready()
+        float(many(x, w)[0, 0, 0, 0].astype(jnp.float32))
         return (time.perf_counter() - t0) / args.iters * 1e3
 
     # numeric check first
