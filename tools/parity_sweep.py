@@ -210,6 +210,7 @@ RECORD_TEST_FILES = [
     "tests/test_gluon_rnn.py", "tests/test_quantization_pdf.py",
     "tests/test_compression_group_ops.py",
     "tests/test_control_flow_bucketing.py",
+    "tests/test_op_eager_battery.py",  # trace-only-path ops, eagerly
 ]
 
 # stochastic ops: outputs are draws from the seeded key stream — the key
