@@ -363,14 +363,18 @@ def conv3x3_bn_stats(x, w, interpret=False):
 
     n, h, wd, cin = x.shape
     cout = w.shape[-1]
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
 
     def kernel(xr, wr, yr, sr, qr):
         i = pl.program_id(0)
+        # SAME-pad halo built IN VMEM: the block already holds the whole
+        # image, so padding here is register/VMEM work — doing it outside
+        # the kernel (jnp.pad) materializes a padded copy in HBM and was
+        # measured to cost the C=128 case the win (PERF.md round 5)
+        xpad = jnp.pad(xr[0], ((1, 1), (1, 1), (0, 0)))
         acc = jnp.zeros((h * wd, cout), jnp.float32)
         for kh in range(3):
             for kw in range(3):
-                tap = xr[0, kh:kh + h, kw:kw + wd, :].reshape(h * wd, cin)
+                tap = xpad[kh:kh + h, kw:kw + wd, :].reshape(h * wd, cin)
                 acc += jax.lax.dot(
                     tap, wr[kh, kw],
                     preferred_element_type=jnp.float32)
@@ -392,7 +396,7 @@ def conv3x3_bn_stats(x, w, interpret=False):
         kernel,
         grid=(n,),
         in_specs=[
-            pl.BlockSpec((1, h + 2, wd + 2, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, cin), lambda i: (i, 0, 0, 0)),
             pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
         ],
         out_specs=[
@@ -406,7 +410,7 @@ def conv3x3_bn_stats(x, w, interpret=False):
             jax.ShapeDtypeStruct((cout,), jnp.float32),
         ],
         interpret=interpret,
-    )(xp, w)
+    )(x, w)
 
 
 def conv3x3_bn_relu_train(x, w, gamma, beta, eps=1e-3, interpret=False):
