@@ -10,6 +10,7 @@ recomputes forward activations per node — a rematerialization-first design
 from __future__ import annotations
 
 import threading
+import weakref
 
 from .base import MXNetError
 
@@ -84,17 +85,32 @@ def predict_mode():
 class _Node:
     """One recorded op application."""
 
-    __slots__ = ("op", "params", "inputs", "input_data", "n_primary", "out_refs")
+    __slots__ = ("op", "params", "inputs", "input_data", "n_primary",
+                 "out_refs", "__weakref__")
 
     def __init__(self, op, params, inputs, outputs):
         self.op = op
         self.params = dict(params)
         self.inputs = inputs                       # list[NDArray]
-        self.input_data = [x._data for x in inputs]  # values at record time
+        # values at record time; cells left lazy by an earlier bulk segment
+        # are forced so the tape holds concrete buffers for vjp replay
+        self.input_data = [x._force() for x in inputs]
         self.n_primary = len(outputs)
         import weakref
 
         self.out_refs = [weakref.ref(o) for o in outputs]
+
+
+# Live tape nodes. Nodes capture input buffers for vjp replay; while any
+# node is alive (recording scope still open, backward(retain_graph=True),
+# pending grad() replay), eager dispatch must not donate buffers — a
+# donated mutate op could delete an input a later replay still reads.
+# Nodes die as soon as backward clears the tape, re-enabling donation.
+_LIVE_NODES = weakref.WeakSet()
+
+
+def tape_alive():
+    return len(_LIVE_NODES) > 0
 
 
 def record_op(op, params, inputs, outputs):
@@ -104,6 +120,7 @@ def record_op(op, params, inputs, outputs):
     if not any(x.grad_req != "null" or x._tape_entry is not None for x in inputs):
         return
     node = _Node(op, params, inputs, outputs)
+    _LIVE_NODES.add(node)
     for i, o in enumerate(outputs):
         o._tape_entry = (node, i)
 

@@ -1,35 +1,404 @@
-"""Engine control shim.
+"""Dependency-engine control: real op bulking over lazy segments.
 
 Parity: python/mxnet/engine.py (bulk/set_bulk_size over the dependency
-engine, include/mxnet/engine.h:311). TPU-native: PJRT's async dispatch is the
-dependency engine — ops return immediately and sequence on buffer futures —
-and XLA fusion inside jitted executables is the op-bulking analogue. The
-bulk-size knobs are therefore accepted for API compatibility and recorded,
-but the actual batching decision belongs to jit tracing (mx.jit.trace /
-hybridize), which compiles whole steps into one executable.
+engine, include/mxnet/engine.h:311) and the bulk mode of
+src/engine/threaded_engine.cc, where consecutive engine pushes are fused
+into one kernel-launch burst. TPU-native mechanics:
+
+- With a nonzero bulk size, eager op dispatch stops executing one cached
+  XLA executable per op. Instead each call is *recorded* into the current
+  thread's lazy segment and returns a `_Placeholder` — a symbolic cell value
+  carrying only shape/dtype (inferred through `jax.eval_shape`, with an aval
+  cache so steady-state recording is pure dict work).
+- A segment is *forced* when it reaches the bulk size, when the `bulk`
+  scope exits, or when any placeholder is read (`wait_to_read`, `asnumpy`,
+  `__array__`, or any jax op consuming it via the `__jax_array__`
+  protocol). Forcing traces the whole recorded segment and jit-compiles it
+  as ONE executable, cached on the recorded (op, params, shape, dtype)
+  sequence — so a steady-state training loop replays a compiled segment per
+  `bulk_size` ops instead of dispatching each one.
+- Bulking is bypassed (dispatch falls back to per-op eager) while autograd
+  is recording or a jit.trace discovery pass is live: both capture concrete
+  buffers per op and would observe placeholders otherwise.
+
+When bulking helps: eager host-bound loops (optimizer updates over many
+small parameters, metric/update chains) where per-op dispatch overhead
+dominates. Inside `mx.jit.trace`/hybridize the whole step is already one
+executable and bulking is a no-op by design. See docs/engine.md.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
+import weakref
 
-__all__ = ["set_bulk_size", "bulk"]
+__all__ = ["set_bulk_size", "bulk", "flush", "bulk_stats"]
 
-_BULK_SIZE = 0
+_TLS = threading.local()
+
+# Flat counters, merged into profiler.dumps() / profiler.dispatch_stats().
+_STATS = {
+    "bulk_segments": 0,
+    "bulk_ops": 0,
+    "bulk_cache_hit": 0,
+    "bulk_cache_miss": 0,
+    "bulk_max_segment": 0,
+    "bulk_fallback_eager": 0,
+}
+
+# (device, recorded sequence) -> jitted segment executable
+_SEG_CACHE: dict = {}
+# (param key, input avals) -> (output is tuple?, flat output ShapeDtypeStructs)
+_AVAL_CACHE: dict = {}
+# np.dtype -> str; numpy's dtype.__str__ costs ~10us and sits on the
+# per-record path
+_DTYPE_STR: dict = {}
+
+
+def _dtype_str(dt):
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR[dt] = str(dt)
+    return s
+
+
+def bulk_stats():
+    return dict(_STATS)
+
+
+def _state():
+    st = _TLS
+    if not hasattr(st, "size"):
+        st.size = 0
+        st.seg = None
+    return st
+
+
+class _Placeholder:
+    """Symbolic value of an NDArray cell inside an unforced bulk segment.
+
+    Reads force the owning segment: `__jax_array__` (any jax op consuming
+    it), `__array__` (numpy / `asnumpy`), `block_until_ready`
+    (`wait_to_read`). Unknown attribute access falls back to the concrete
+    array, so stray direct-jnp paths degrade to a force instead of an error.
+    """
+
+    __slots__ = ("_seg", "_slot", "_aval", "__weakref__")
+
+    def __init__(self, seg, slot, aval):
+        self._seg = seg
+        self._slot = slot
+        self._aval = aval
+
+    @property
+    def shape(self):
+        return self._aval.shape
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self._aval.shape:
+            n *= d
+        return n
+
+    def _mxtpu_force(self):
+        return self._seg.force()[self._slot]
+
+    def __jax_array__(self):
+        return self._mxtpu_force()
+
+    def __array__(self, dtype=None):
+        import numpy as np
+
+        a = np.asarray(self._mxtpu_force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def block_until_ready(self):
+        v = self._mxtpu_force()
+        v.block_until_ready()
+        return v
+
+    def __getitem__(self, idx):
+        return self._mxtpu_force()[idx]
+
+    def __getattr__(self, name):
+        if name.startswith("__"):  # no dunder protocol via concrete fallback
+            raise AttributeError(name)
+        return getattr(self._mxtpu_force(), name)
+
+    def __repr__(self):
+        state = "resolved" if self._seg.results is not None else "lazy"
+        return (f"<bulk placeholder {self._aval.shape} {self._aval.dtype} "
+                f"[{state}]>")
+
+
+class _Segment:
+    """One recorded sequence of eager op calls, compiled and run as a unit."""
+
+    def __init__(self, device):
+        self.device = device
+        self.entries = []      # (op, params, dyn_keys, descs, base, n_out)
+        self.ext = []          # concrete external input arrays, in first use order
+        self._ext_pos = {}     # id(array) -> position in ext
+        self.avals = []        # flat output avals across all entries
+        self.key_parts = []    # per-entry cache-key parts, built incrementally
+        self.ph_refs = []      # weakref per output placeholder (liveness)
+        self.results = None    # flat concrete outputs once forced
+
+    def record(self, op, params, arrays):
+        """Append one op call; returns placeholders shaped like fn's output
+        (or raises, in which case nothing was appended — all segment state
+        is committed atomically at the end)."""
+        # dynamic scalar params become runtime operands here too: baking a
+        # per-step lr into the segment key would recompile the segment
+        # every step (the exact churn dynamic_params exists to prevent)
+        dyn_keys, dyn_vals, params = op.split_dynamic(params)
+        pkey = _ENV.param_key(op, params)
+        descs, in_avals = [], []
+        new_ext = []   # (id-or-None, value) staged; committed on success
+        staged_pos = {}
+
+        def ext_slot(val, ident):
+            pos = self._ext_pos.get(ident) if ident is not None else None
+            if pos is None and ident is not None:
+                pos = staged_pos.get(ident)
+            if pos is None:
+                pos = len(self.ext) + len(new_ext)
+                new_ext.append((ident, val))
+                if ident is not None:
+                    staged_pos[ident] = pos
+            return pos
+
+        for a in arrays:
+            if type(a) is _Placeholder and a._seg is self \
+                    and self.results is None:
+                descs.append(("s", a._slot))
+                in_avals.append((a._aval.shape, _dtype_str(a._aval.dtype)))
+                continue
+            if type(a) is _Placeholder:
+                a = a._mxtpu_force()
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is None or dtype is None:
+                raise TypeError(f"cannot bulk non-array input {type(a)}")
+            aval = (tuple(shape), _dtype_str(dtype))
+            descs.append(("e", ext_slot(a, id(a))) + aval)
+            in_avals.append(aval)
+        for v in dyn_vals:  # scalars: tiny, no dedup needed
+            descs.append(("d", ext_slot(v, None)))
+        is_tuple, out_avals = _infer_out(op, params, dyn_keys, dyn_vals,
+                                         pkey, tuple(in_avals))
+        # ---- commit (nothing above mutated segment state)
+        for ident, val in new_ext:
+            self.ext.append(val)
+            if ident is not None:
+                self._ext_pos[ident] = len(self.ext) - 1
+        base = len(self.avals)
+        self.avals.extend(out_avals)
+        descs = tuple(descs)
+        self.entries.append((op, params, dyn_keys, descs, base,
+                             len(out_avals)))
+        self.key_parts.append((pkey, dyn_keys, descs))
+        _STATS["bulk_ops"] += 1
+        phs = tuple(_Placeholder(self, base + i, av)
+                    for i, av in enumerate(out_avals))
+        self.ph_refs.extend(weakref.ref(p) for p in phs)
+        return phs if is_tuple else phs[0]
+
+    def force(self):
+        """Compile (or fetch) and run the segment; returns flat results."""
+        if self.results is None:
+            self._flush()
+        return self.results
+
+    def _flush(self):
+        import jax
+
+        st = _state()
+        if st.seg is self:
+            st.seg = None  # close: later ops start a fresh segment
+        n = len(self.entries)
+        _STATS["bulk_segments"] += 1
+        if n > _STATS["bulk_max_segment"]:
+            _STATS["bulk_max_segment"] = n
+        # dead-output elimination: outputs whose placeholder has already
+        # been dropped (chained intermediates) can never be read — keeping
+        # them as executable outputs would force XLA to materialize every
+        # intermediate and defeat fusion across the segment
+        live = tuple(i for i, r in enumerate(self.ph_refs)
+                     if r() is not None)
+        key = (self.device, live, tuple(self.key_parts))
+        fn = _SEG_CACHE.get(key)
+        if fn is None:
+            _STATS["bulk_cache_miss"] += 1
+            fn = jax.jit(_build_segment_fn(self.entries, len(self.avals),
+                                           live))
+            _SEG_CACHE[key] = fn
+        else:
+            _STATS["bulk_cache_hit"] += 1
+        results = [None] * len(self.avals)
+        try:
+            outs = fn(*self.ext)
+        except Exception:
+            # semantics over speed: replay the recorded ops eagerly so the
+            # cells still resolve even if segment compilation fails
+            _STATS["bulk_fallback_eager"] += 1
+            outs = _build_segment_fn(self.entries, len(self.avals),
+                                     live)(*self.ext)
+        for i, v in zip(live, outs):
+            results[i] = v
+        self.results = results
+        # release the recording state: surviving placeholders only need
+        # `results`; keeping `ext` would pin every external input buffer
+        # (pre-update weights, grads) for the placeholders' lifetime
+        self.entries = self.key_parts = self.ph_refs = ()
+        self.ext = ()
+        self._ext_pos = {}
+
+
+def _build_segment_fn(entries, total, live):
+    def seg_fn(*ext):
+        flat = [None] * total
+        for op, params, dyn_keys, descs, base, n in entries:
+            ins, dynkw, di = [], {}, 0
+            for d in descs:
+                tag = d[0]
+                if tag == "s":
+                    ins.append(flat[d[1]])
+                elif tag == "e":
+                    ins.append(ext[d[1]])
+                else:  # "d": dynamic scalar, by dyn_keys order
+                    dynkw[dyn_keys[di]] = ext[d[1]]
+                    di += 1
+            fn = op.closed(params)
+            r = fn(*ins, **dynkw) if dynkw else fn(*ins)
+            rs = r if isinstance(r, tuple) else (r,)
+            for i, v in enumerate(rs):
+                flat[base + i] = v
+        return tuple(flat[i] for i in live)
+
+    return seg_fn
+
+
+def _infer_out(op, params, dyn_keys, dyn_vals, pkey, in_avals):
+    """FInferShape/Type for one bulked op: jax.eval_shape with a cache so
+    steady-state recording never re-traces. Dynamic scalars are bound as
+    constants for inference — output avals don't depend on their values."""
+    k = (pkey, dyn_keys, in_avals)
+    r = _AVAL_CACHE.get(k)
+    if r is None:
+        import jax
+
+        structs = [jax.ShapeDtypeStruct(s, d) for s, d in in_avals]
+        full = dict(params, **dict(zip(dyn_keys, dyn_vals))) \
+            if dyn_keys else params
+        out = jax.eval_shape(op.closed(full), *structs)
+        is_tuple = isinstance(out, tuple)
+        flat = list(out) if is_tuple else [out]
+        r = (is_tuple, tuple(flat))
+        _AVAL_CACHE[k] = r
+    return r
+
+
+class _Env:
+    """Cross-module handles resolved once when bulking is first enabled."""
+
+    param_key = None
+    is_recording = None
+    trace_active = None
+
+    @classmethod
+    def resolve(cls):
+        from . import autograd
+        from .jit import _active
+        from .ops.registry import _param_key
+
+        cls.param_key = staticmethod(_param_key)
+        cls.is_recording = staticmethod(autograd.is_recording)
+        cls.trace_active = staticmethod(_active)
+
+
+_ENV = _Env
+
+
+def _bulk_record(op, params, arrays, device):
+    """Dispatch hook called from ops.registry on every eager op while
+    bulking has ever been enabled. Returns NotImplemented to decline (the
+    caller then dispatches eagerly)."""
+    st = _state()
+    if st.size <= 0:
+        return NotImplemented
+    if _ENV.is_recording() or _ENV.trace_active() is not None:
+        return NotImplemented
+    seg = st.seg
+    if seg is None or seg.results is not None or seg.device is not device:
+        if seg is not None and seg.results is None:
+            seg.force()  # device switch: preserve program order
+        seg = st.seg = _Segment(device)
+    try:
+        out = seg.record(op, params, arrays)
+    except Exception:
+        _STATS["bulk_fallback_eager"] += 1
+        if not seg.entries:
+            st.seg = None
+        return NotImplemented
+    if len(seg.entries) >= st.size:
+        seg.force()
+    return out
+
+
+_HOOK_INSTALLED = False
+
+
+def _install_hook():
+    global _HOOK_INSTALLED
+    if _HOOK_INSTALLED:
+        return
+    from .ops import registry
+
+    _Env.resolve()
+    registry._set_bulk_hook(_bulk_record, _Placeholder)
+    _HOOK_INSTALLED = True
 
 
 def set_bulk_size(size):
-    """Set maximum number of ops to bulk (engine.py:26). Returns the
-    previous value. On TPU this is advisory — jit tracing supersedes it."""
-    global _BULK_SIZE
-    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    """Set maximum number of ops to bulk per lazy segment (engine.py:26).
+    Returns the previous value. 0 disables bulking (and forces any open
+    segment so no lazy cells leak out of the bulked region)."""
+    st = _state()
+    prev, st.size = st.size, int(size)
+    if st.size > 0:
+        _install_hook()
+    elif st.seg is not None and st.seg.results is None:
+        st.seg.force()
     return prev
+
+
+def flush():
+    """Force the current thread's open segment, if any (used by
+    mx.nd.waitall and the bulk scope exit)."""
+    st = _state()
+    if st.seg is not None and st.seg.results is None:
+        st.seg.force()
+    st.seg = None
 
 
 @contextlib.contextmanager
 def bulk(size):
-    """Scope bulking hint (engine.py:45)."""
+    """Scope bulking (engine.py:45): ops inside accumulate into lazy
+    segments of up to `size` ops. Exception-safe and nestable; the open
+    segment is forced on exit either way."""
     prev = set_bulk_size(size)
     try:
         yield
     finally:
+        flush()
         set_bulk_size(prev)

@@ -9,6 +9,7 @@ jitted executable.
 """
 from __future__ import annotations
 
+import os
 import warnings
 
 from .. import optimizer as opt
@@ -17,6 +18,7 @@ from .parameter import Parameter
 from ..ndarray import NDArray
 
 __all__ = ["Trainer"]
+
 
 
 class Trainer:
@@ -128,6 +130,23 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
+    def _bulk_size(self):
+        """Ops to bulk per lazy segment during _update (0 = eager).
+        MXNET_TPU_BULK_OPT_UPDATES=<n> (read per step, so it can be set
+        after import) forces bulking for every Trainer; otherwise it
+        engages only when the optimizer sets aggregate_num > 1
+        (docs/engine.md)."""
+        env = os.environ.get("MXNET_TPU_BULK_OPT_UPDATES")
+        if env:
+            try:
+                n = int(env)
+            except ValueError:
+                n = None
+            if n is not None:
+                return n if n > 0 else 0  # explicit 0/-1 = kill switch
+        agg = getattr(self._optimizer, "aggregate_num", 0)
+        return agg if agg and agg > 1 else 0
+
     def _update(self, ignore_stale_grad=False):
         updates = [[] for _ in self._updaters]
         for i, param in enumerate(self._params):
@@ -140,9 +159,20 @@ class Trainer:
                                       param.list_grad()):
                 upd.append((i, grad, arr))
         if not self._update_on_kvstore:
-            for updater, upd in zip(self._updaters, updates):
-                for i, g, w in upd:
-                    updater(i, g, w)
+            bulk_n = self._bulk_size()
+            if bulk_n:
+                # record the whole update sweep into lazy segments so the
+                # per-parameter update ops compile/launch as fused bundles
+                from .. import engine
+
+                with engine.bulk(bulk_n):
+                    for updater, upd in zip(self._updaters, updates):
+                        for i, g, w in upd:
+                            updater(i, g, w)
+            else:
+                for updater, upd in zip(self._updaters, updates):
+                    for i, g, w in upd:
+                        updater(i, g, w)
 
     def save_states(self, fname):
         """Saves trainer states (optimizer + scheduler) to a file

@@ -148,8 +148,10 @@ class TracedFunction:
         if entry is None:
             entry = self._build(args, key)
         jitted, pure, state_cells, n_out, single = entry
-        state_vals = [c._data for c in state_cells]
-        outs, new_state = jitted([a._data for a in dyn], state_vals)
+        # _force(): cells left lazy by an engine.bulk segment must resolve
+        # to concrete buffers before they cross into the jitted call
+        state_vals = [c._force() for c in state_cells]
+        outs, new_state = jitted([a._force() for a in dyn], state_vals)
         ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
         out_nds = [NDArray(o, ctx) for o in outs]
         if autograd.is_recording():

@@ -105,11 +105,21 @@ class KVStore:
                 self._data[k]._set_data(merged._data)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from ..ops import registry as _registry
+
         keys, outs = _pairs(key, out)
         for k, o in zip(keys, outs):
             if k not in self._data:
                 raise MXNetError(f"key {k} was not initialized")
             targets = o if isinstance(o, (list, tuple)) else [o]
+            # the store buffer is now shared with the pull targets: a
+            # donated in-place update (update_on_kvstore optimizer) on the
+            # store cell must not delete the targets' buffer. _force()
+            # (dense cells only) resolves any lazy value so the CONCRETE
+            # buffer gets marked.
+            store = self._data[k]
+            if hasattr(store, "_force"):
+                _registry.mark_shared(store._force())
             for t in targets:
                 t._set_data(self._data[k]._data)
 

@@ -133,15 +133,25 @@ class NDArray:
         return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
 
     # ----------------------------------------------------------- engine sync
+    def _force(self):
+        """Resolve a lazy (bulk-segment) cell value to a concrete buffer.
+        Cheap no-op for ordinary jax arrays."""
+        d = self._data
+        force = getattr(type(d), "_mxtpu_force", None)
+        if force is not None:
+            self._data = d = force(d)
+        return d
+
     def wait_to_read(self):
-        """Block until the value is computed (ndarray.h:368 WaitToRead)."""
-        _jax().block_until_ready(self._data)
+        """Block until the value is computed (ndarray.h:368 WaitToRead).
+        Forces the enclosing bulk segment first if the value is lazy."""
+        _jax().block_until_ready(self._force())
         return self
 
     wait_to_write = wait_to_read
 
     def asnumpy(self):
-        return _np.asarray(self._data)
+        return _np.asarray(self._force())
 
     def asscalar(self):
         if self.size != 1:
@@ -190,7 +200,8 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def copy(self):
-        return NDArray(self._data + 0 if self.dtype != _np.dtype(bool) else self._data.copy(), self._ctx)
+        d = self._force()
+        return NDArray(d + 0 if self.dtype != _np.dtype(bool) else d.copy(), self._ctx)
 
     def astype(self, dtype, copy=True):
         d = _jnp().asarray(self._data, dtype=np_dtype(dtype))
@@ -217,6 +228,11 @@ class NDArray:
         return self._grad
 
     def detach(self):
+        # the detached cell shares this buffer: exempt it from donation so
+        # a later in-place (mutate) op can't delete it out from under us.
+        # _force() first — marking a lazy placeholder would register the
+        # placeholder object, not the concrete buffer both cells resolve to
+        _reg.mark_shared(self._force())
         out = NDArray(self._data, self._ctx)
         return out
 
@@ -560,7 +576,13 @@ class _CastedOp:
         return fn
 
 
+# Per-call handles resolved once at the first imperative invoke: the
+# previous design re-imported jax.core / autograd / jit inside every call,
+# which cost several sys.modules lookups per eager op.
 _AMP_MOD = None
+_AUTOGRAD = None
+_TRACER_CLS = None
+_NOTIFY_IO = None
 
 
 def _amp_mod():
@@ -573,16 +595,28 @@ def _amp_mod():
     return _AMP_MOD
 
 
-def imperative_invoke(opname, *inputs, out=None, **params):
-    from .. import autograd
+def _resolve_invoke_env():
+    global _AUTOGRAD, _TRACER_CLS, _NOTIFY_IO
+    from .. import autograd as _ag
+    from ..jit import _notify_io as _nio
 
+    _AUTOGRAD = _ag
+    _NOTIFY_IO = _nio
+    _TRACER_CLS = _reg.tracer_class()
+    _amp_mod()
+
+
+def imperative_invoke(opname, *inputs, out=None, **params):
+    if _TRACER_CLS is None:
+        _resolve_invoke_env()
     op = _reg.get_op(opname)
     params = op.normalize(params)
     in_arrays = [x._data for x in inputs]
     amp_cast_spec = None
-    if _amp_mod() is not None and _amp_mod().amp_active():
+    amp_on = _AMP_MOD.amp_active()
+    if amp_on:
         orig_arrays = in_arrays
-        in_arrays = _amp_mod().cast_inputs_for(op.name, in_arrays)
+        in_arrays = _AMP_MOD.cast_inputs_for(op.name, in_arrays)
         if in_arrays is not orig_arrays:
             spec = [None if new is old else str(new.dtype)
                     for new, old in zip(in_arrays, orig_arrays)]
@@ -597,18 +631,22 @@ def imperative_invoke(opname, *inputs, out=None, **params):
         ctx = inputs[0].context
     else:
         ctx = current_context()
-    import jax.core as jcore
-
-    traced = any(isinstance(a, jcore.Tracer) for a in in_arrays)
+    tracer = _TRACER_CLS
+    traced = False
+    for a in in_arrays:
+        if isinstance(a, tracer):
+            traced = True
+            break
     device = None if traced else ctx.jax_device()
-    raw = _reg.invoke(opname, *in_arrays, device=device, **params)
+    raw = _reg.dispatch(op, params, in_arrays, device, is_traced=traced)
+    if not isinstance(raw, tuple):
+        raw = (raw,)
     n_primary = op.n_out(params)
     outputs = [NDArray(r, ctx) for r in raw[:n_primary]]
     # write mutated aux slots (e.g. BatchNorm running stats, optimizer weights)
     mutate_slots = op.mutate_slots(params) if hasattr(op, "mutate_slots") \
         else op.mutate
     if mutate_slots:
-        amp_on = _amp_mod() is not None and _amp_mod().amp_active()
         for slot_name, val in zip(mutate_slots, raw[n_primary:]):
             idx = slot_name if isinstance(slot_name, int) else None
             if idx is None:
@@ -621,15 +659,16 @@ def imperative_invoke(opname, *inputs, out=None, **params):
                         and val.dtype != cur.dtype):
                     val = val.astype(cur.dtype)
             inputs[idx]._set_data(val)
-    from ..jit import _notify_io
-
-    _notify_io(inputs, outputs)
-    if autograd.is_recording() and not op.no_grad:
+    _NOTIFY_IO(inputs, outputs)
+    if _AUTOGRAD.is_recording() and not op.no_grad:
         rec_op = op if amp_cast_spec is None else _CastedOp(op, amp_cast_spec)
-        autograd.record_op(rec_op, params, list(inputs), outputs)
+        _AUTOGRAD.record_op(rec_op, params, list(inputs), outputs)
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o, r in zip(outs, outputs):
+            # out= aliases the result buffer into a second cell: exempt it
+            # from donation like any other shared buffer
+            _reg.mark_shared(r._data)
             o._set_data(r._data)
         return list(outs)
     return outputs
@@ -807,9 +846,14 @@ def topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
 
 
 def waitall():
-    """Parity: mx.nd.waitall() (Engine WaitForAll)."""
+    """Parity: mx.nd.waitall() (Engine WaitForAll). Forces any open bulk
+    segment first, then drains the PJRT stream."""
     import jax
 
+    if _reg._BULK_HOOK is not None:
+        from .. import engine
+
+        engine.flush()
     (jax.device_put(0.0) + 0).block_until_ready()
 
 

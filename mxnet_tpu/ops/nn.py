@@ -454,11 +454,14 @@ def _regression_fwd(data, label, kind, grad_scale):
 
 def _regression_bwd(kind, grad_scale, res, g):
     out, label = res
-    label = label.reshape(out.shape)
+    # broadcast label up to out's shape for the residual, but keep the
+    # ORIGINAL label shape for its (zero) cotangent — custom_vjp requires
+    # bwd outputs to match the primal argument shapes exactly
+    lbl = label.reshape(out.shape)
     if kind == 2:  # MAE
-        grad = jnp.sign(out - label)
+        grad = jnp.sign(out - lbl)
     else:  # linear / logistic both use (pred - label)
-        grad = out - label
+        grad = out - lbl
     num = out.shape[1] if out.ndim > 1 else 1
     return grad * grad_scale / num, jnp.zeros_like(label)
 

@@ -21,7 +21,8 @@ def _rescale_clip(grad, rescale_grad, clip_gradient):
     return g
 
 
-@register("sgd_update", mutate=(0,), no_grad=True)
+@register("sgd_update", mutate=(0,), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                 clip_gradient=None, lazy_update=True):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -29,7 +30,8 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return new_w, new_w
 
 
-@register("sgd_mom_update", mutate=(0, 2), no_grad=True)
+@register("sgd_mom_update", mutate=(0, 2), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=None, lazy_update=True):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -38,7 +40,8 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return new_w, new_w, new_mom
 
 
-@register("nag_mom_update", mutate=(0, 2), no_grad=True)
+@register("nag_mom_update", mutate=(0, 2), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=None):
     g = _rescale_clip(grad, rescale_grad, clip_gradient) + wd * weight
@@ -47,7 +50,8 @@ def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return new_w, new_w, new_mom
 
 
-@register("mp_sgd_update", mutate=(0, 2), no_grad=True)
+@register("mp_sgd_update", mutate=(0, 2), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=None, lazy_update=True):
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient)
@@ -55,7 +59,8 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
     return new_w32.astype(weight.dtype), new_w32.astype(weight.dtype), new_w32
 
 
-@register("mp_sgd_mom_update", mutate=(0, 2, 3), no_grad=True)
+@register("mp_sgd_mom_update", mutate=(0, 2, 3), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        wd=0.0, rescale_grad=1.0, clip_gradient=None,
                        lazy_update=True):
@@ -65,7 +70,8 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
     return new_w32.astype(weight.dtype), new_w32.astype(weight.dtype), new_mom, new_w32
 
 
-@register("adam_update", mutate=(0, 2, 3), no_grad=True)
+@register("adam_update", mutate=(0, 2, 3), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
                  lazy_update=True):
@@ -76,7 +82,8 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return new_w, new_w, new_mean, new_var
 
 
-@register("adamw_update", mutate=(0, 2, 3), no_grad=True)
+@register("adamw_update", mutate=(0, 2, 3), no_grad=True,
+          dynamic_params=("lr", "wd", "eta", "rescale_grad"))
 def _adamw_update(weight, grad, mean, var, rescale_grad_arr=None, lr=0.001,
                   beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                   rescale_grad=1.0, clip_gradient=None):
@@ -90,7 +97,8 @@ def _adamw_update(weight, grad, mean, var, rescale_grad_arr=None, lr=0.001,
     return new_w, new_w, new_mean, new_var
 
 
-@register("ftrl_update", mutate=(0, 2, 3), no_grad=True)
+@register("ftrl_update", mutate=(0, 2, 3), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=None):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -104,7 +112,8 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
     return new_w, new_w, new_z, new_n
 
 
-@register("rmsprop_update", mutate=(0, 2), no_grad=True)
+@register("rmsprop_update", mutate=(0, 2), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
                     wd=0.0, rescale_grad=1.0, clip_gradient=None,
                     clip_weights=None):
@@ -114,7 +123,8 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
     return new_w, new_w, new_n
 
 
-@register("rmspropalex_update", mutate=(0, 2, 3, 4), no_grad=True)
+@register("rmspropalex_update", mutate=(0, 2, 3, 4), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.001, gamma1=0.95,
                         gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                         clip_gradient=None, clip_weights=None):
@@ -126,7 +136,8 @@ def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.001, gamma1=0.95,
     return new_w, new_w, new_n, new_gavg, new_delta
 
 
-@register("signsgd_update", mutate=(0,), no_grad=True)
+@register("signsgd_update", mutate=(0,), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                     clip_gradient=None):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -134,7 +145,8 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return new_w, new_w
 
 
-@register("signum_update", mutate=(0, 2), no_grad=True)
+@register("signum_update", mutate=(0, 2), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=None, wd_lh=0.0):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -157,7 +169,8 @@ def _lamb_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
     return m / (jnp.sqrt(v) + epsilon) + wd * weight
 
 
-@register("lamb_update_phase2", mutate=(0,), no_grad=True)
+@register("lamb_update_phase2", mutate=(0,), no_grad=True,
+          dynamic_params=("lr",))
 def _lamb_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
     r1v = r1.reshape(())
     r2v = r2.reshape(())
@@ -318,7 +331,8 @@ def _preloaded_multi_sgd_mom_update(*tensors, num_weights=1, momentum=0.0,
     return tuple(new_ws) + tuple(mutated)
 
 
-@register("ftml_update", mutate=(0, 2, 3, 4), no_grad=True)
+@register("ftml_update", mutate=(0, 2, 3, 4), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
                  epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
     """FTML (Follow the Moving Leader). Parity: optimizer_op.cc:626 /
@@ -335,7 +349,8 @@ def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
     return new_w, new_w, d_t, new_v, new_z
 
 
-@register("mp_nag_mom_update", mutate=(0, 2, 3), no_grad=True)
+@register("mp_nag_mom_update", mutate=(0, 2, 3), no_grad=True,
+          dynamic_params=("lr", "wd", "rescale_grad"))
 def _mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
                        wd=0.0, rescale_grad=1.0, clip_gradient=None):
     """Multi-precision NAG: fp32 master weights + fp32 momentum with a

@@ -11,18 +11,27 @@ subsumes most of the reference's attribute surface:
 - FMutateInputs/aux  = declared `mutate` slots, handled by the NDArray cell
 - kernel fusion      = XLA fusion (replaces src/operator/fusion NVRTC JIT)
 
-Eager dispatch compiles one tiny XLA executable per (op, params, shapes) and
+Eager dispatch compiles one tiny XLA executable per (op, params, device) and
 caches it — the analogue of the reference's per-op engine push, with PJRT's
 async dispatch supplying the "return immediately, sync on read" semantics of
-the dependency engine (src/engine/threaded_engine.cc).
+the dependency engine (src/engine/threaded_engine.cc). The dispatch fast
+path is donation-aware: ops with declared `mutate` slots compile with
+`donate_argnums` so in-place updates (optimizer steps, BatchNorm moving
+stats) reuse their input HBM buffers instead of allocating. When op bulking
+is active (mxnet_tpu.engine), dispatch is diverted into the recording hook
+installed by the engine and ops accumulate into a lazy segment instead of
+executing one executable each.
 """
 from __future__ import annotations
 
 import functools
+import os as _os
 
 from ..base import MXNetError
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op"]
+__all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op",
+           "dispatch", "dispatch_stats", "reset_dispatch_stats",
+           "set_eager_donation"]
 
 _OPS: dict[str, "OpDef"] = {}
 _ALIASES: dict[str, str] = {}
@@ -41,15 +50,21 @@ class OpDef:
         place from extra outputs (e.g. BatchNorm moving stats, optimizer
         weight updates). fn must return (primary_outs..., *mutated_values).
     wrap_param : optional callable normalizing params before dispatch.
+    dynamic_params : tuple of scalar keyword names that eager dispatch
+        passes as runtime operands instead of compile-time constants.
+        Hyperparameters that drift every step (a scheduled/bias-corrected
+        ``lr``, ``rescale_grad`` after a batch-size change) would otherwise
+        churn the executable cache with one recompile per distinct value.
+        Only valid for params used arithmetically (no Python control flow).
     """
 
     __slots__ = (
         "name", "fn", "num_outputs", "mutate", "aliases", "no_grad",
-        "param_normalizer", "doc",
+        "param_normalizer", "dynamic_params", "doc",
     )
 
     def __init__(self, name, fn, num_outputs=1, mutate=(), aliases=(),
-                 no_grad=False, param_normalizer=None):
+                 no_grad=False, param_normalizer=None, dynamic_params=()):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
@@ -59,6 +74,7 @@ class OpDef:
         self.aliases = tuple(aliases)
         self.no_grad = no_grad
         self.param_normalizer = param_normalizer
+        self.dynamic_params = tuple(dynamic_params)
         self.doc = fn.__doc__
 
     def n_out(self, params):
@@ -79,15 +95,30 @@ class OpDef:
         fn = self.fn
         return functools.partial(fn, **params) if params else fn
 
+    def split_dynamic(self, params):
+        """Split params into (dyn_keys, dyn_vals, static_params). The key
+        order is the operand order both the eager executable and bulked
+        segments consume the values in — keep the two paths on this one
+        helper. Returns ((), (), params) when nothing is dynamic."""
+        if not self.dynamic_params:
+            return (), (), params
+        present = tuple(k for k in self.dynamic_params if k in params)
+        if not present:
+            return (), (), params
+        vals = tuple(params[k] for k in present)
+        static = {k: v for k, v in params.items() if k not in present}
+        return present, vals, static
+
 
 def register(name, *, num_outputs=1, mutate=(), aliases=(), no_grad=False,
-             param_normalizer=None):
+             param_normalizer=None, dynamic_params=()):
     """Decorator registering a jax-traceable function as an operator."""
 
     def _reg(fn):
         op = OpDef(name, fn, num_outputs=num_outputs, mutate=mutate,
                    aliases=aliases, no_grad=no_grad,
-                   param_normalizer=param_normalizer)
+                   param_normalizer=param_normalizer,
+                   dynamic_params=dynamic_params)
         _OPS[name] = op
         for a in aliases:
             _ALIASES[a] = name
@@ -128,29 +159,323 @@ def _hashable(v):
     return v
 
 
-# (op name, param key, device) -> compiled executable
+# --------------------------------------------------------------------- jax
+# The jax handles are resolved once at first dispatch and cached in module
+# globals; the previous design re-imported jax/jax.core inside every
+# apply_op call, which cost two sys.modules lookups plus attribute chasing
+# per op on the hottest path in the framework.
+_JAX = None
+_TRACER_CLS = None
+
+
+def _init_jax():
+    global _JAX, _TRACER_CLS
+    import jax
+    import jax.core
+
+    _JAX = jax
+    _TRACER_CLS = jax.core.Tracer
+    return _JAX
+
+
+def tracer_class():
+    """The jax Tracer class, resolved once (for callers doing their own
+    traced-input checks without paying a per-call import)."""
+    if _TRACER_CLS is None:
+        _init_jax()
+    return _TRACER_CLS
+
+
+# ---------------------------------------------------------------- key intern
+class _InternedKey:
+    """Hash-caching wrapper for the eager-cache key.
+
+    Cache keys are nested tuples (op name, sorted param items, device,
+    donate flag); hashing the deep tuple on every dispatch is measurable at
+    eager-op rates. Keys are interned in `_KEY_INTERN` so every repeat
+    dispatch reuses one canonical object whose hash was computed exactly
+    once.
+    """
+
+    __slots__ = ("parts", "_hash")
+
+    def __init__(self, parts):
+        self.parts = parts
+        self._hash = hash(parts)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return self.parts == other.parts
+
+
+_KEY_INTERN: dict = {}
+
+
+def _param_key(op, params):
+    """Hashable (op, params) identity. Params are already normalized."""
+    if not params:
+        return (op.name, ())
+    return (op.name,
+            tuple(sorted((k, _hashable(v)) for k, v in params.items())))
+
+
+# ------------------------------------------------------------- dispatch stats
+# Flat counters, merged into profiler.dumps() / profiler.dispatch_stats().
+_STATS = {
+    "eager_cache_hit": 0,
+    "eager_cache_miss": 0,
+    "eager_retrace": 0,
+    "donated_dispatches": 0,
+    "donated_args": 0,
+    "device_put_skipped": 0,
+    "device_put_performed": 0,
+}
+
+
+def dispatch_stats():
+    return dict(_STATS)
+
+
+def reset_dispatch_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# (interned (op, params, device, donate)) -> (jitted fn, donated slot count)
 _EAGER_CACHE: dict = {}
 
+# Donation policy: 0 = never, 1 = always, 2 = auto (donate on accelerators,
+# where reusing the input HBM buffer halves allocation traffic; skip on the
+# CPU backend, where PJRT donation adds per-call overhead with nothing to
+# save). MXNET_TPU_EAGER_DONATE=0/1 pins the policy.
+_DONATE_MODE = {"0": 0, "1": 1}.get(
+    _os.environ.get("MXNET_TPU_EAGER_DONATE", ""), 2)
 
-def _eager_fn(op: OpDef, params: dict, device):
-    key = (op.name, tuple(sorted((k, _hashable(v)) for k, v in params.items())), device)
-    fn = _EAGER_CACHE.get(key)
-    if fn is None:
-        import jax
 
-        # Output placement follows committed input buffers (PJRT); no device
-        # pin needed — the cache key still includes the device so per-device
-        # executables don't collide.
-        fn = jax.jit(op.closed(dict(params)))
-        _EAGER_CACHE[key] = fn
-    return fn
+def set_eager_donation(mode):
+    """Set the eager donation policy (0=off, 1=on, 2=auto). Returns the
+    previous mode. Exposed for tests and benchmarks."""
+    global _DONATE_MODE
+    prev, _DONATE_MODE = _DONATE_MODE, int(mode)
+    return prev
+
+
+# Buffers aliased by more than one NDArray cell (detach(), kvstore pull)
+# must never be donated: the other cell would be left pointing at a deleted
+# buffer. Sharing sites register the buffer here; donation checks it.
+# id -> weakref so dead buffers can be pruned (and stale id reuse detected).
+import weakref as _weakref
+
+_SHARED_BUFFERS: dict = {}
+
+
+def mark_shared(buf):
+    """Record that `buf` (a jax array) is referenced by multiple cells."""
+    try:
+        _SHARED_BUFFERS[id(buf)] = _weakref.ref(buf)
+    except TypeError:
+        return
+    if len(_SHARED_BUFFERS) > 4096:
+        for k in [k for k, r in _SHARED_BUFFERS.items() if r() is None]:
+            del _SHARED_BUFFERS[k]
+
+
+def _is_shared(buf):
+    r = _SHARED_BUFFERS.get(id(buf))
+    if r is None:
+        return False
+    live = r()
+    if live is not buf:  # dead, or id reused by a different object
+        del _SHARED_BUFFERS[id(buf)]
+        return False
+    return True
+
+# Bulking hook, installed by mxnet_tpu.engine the first time a nonzero bulk
+# size is requested. None means bulking has never been enabled in this
+# process and eager dispatch pays a single global None-check for it.
+_BULK_HOOK = None
+_PLACEHOLDER_CLS = None
+
+
+def _set_bulk_hook(hook, placeholder_cls):
+    global _BULK_HOOK, _PLACEHOLDER_CLS
+    _BULK_HOOK = hook
+    _PLACEHOLDER_CLS = placeholder_cls
+
+
+_AUTOGRAD = None
+
+
+def _autograd():
+    global _AUTOGRAD
+    if _AUTOGRAD is None:
+        from .. import autograd
+
+        _AUTOGRAD = autograd
+    return _AUTOGRAD
+
+
+_JIT_ACTIVE = None
+
+
+def _trace_session_active():
+    global _JIT_ACTIVE
+    if _JIT_ACTIVE is None:
+        from ..jit import _active
+
+        _JIT_ACTIVE = _active
+    return _JIT_ACTIVE() is not None
+
+
+def _compile(op, params, dyn_keys, device, donate_slots, key):
+    """Compile one eager executable and cache it under `key`. Dynamic
+    scalar params (`dyn_keys`) arrive as trailing runtime operands."""
+    if _JAX is None:
+        _init_jax()
+    n_dyn = len(dyn_keys)
+    if n_dyn:
+        base = functools.partial(op.fn, **params) if params else op.fn
+
+        def traced(*args):
+            _STATS["eager_retrace"] += 1
+            return base(*args[:-n_dyn], **dict(zip(dyn_keys, args[-n_dyn:])))
+    else:
+        closed = op.closed(dict(params))
+
+        def traced(*xs):
+            # runs only while jax (re)traces — one per specialization
+            _STATS["eager_retrace"] += 1
+            return closed(*xs)
+
+    # Output placement follows committed input buffers (PJRT); no device
+    # pin needed — the cache key still includes the device so per-device
+    # executables don't collide.
+    fn = _JAX.jit(traced, donate_argnums=donate_slots)
+    entry = (fn, len(donate_slots))
+    _EAGER_CACHE[key] = entry
+    return entry
+
+
+def _donate_slots_for(op, params, arrays, device):
+    """Input slots safe to donate for this dispatch, or () when donation
+    must stay off.
+
+    Donation is *correct* only when nothing else can read the input buffer
+    after the call. Declared `mutate` slots are rebound by the caller, so
+    the only other readers are (a) the autograd tape, which captures input
+    buffers of recorded ops — so no donation while recording — and (b) a
+    jit.trace discovery pass, which snapshots pre-mutation buffers for
+    rollback — so no donation while a TraceSession is live.
+    """
+    mode = _DONATE_MODE
+    if mode == 0 or (mode == 2 and device.platform == "cpu"):
+        return ()
+    slots = op.mutate_slots(params)
+    if not slots:
+        return ()
+    ag = _autograd()
+    # tape_alive covers buffers captured by nodes that OUTLIVE the record
+    # scope (backward(retain_graph=True), pending grad() replay)
+    if ag.is_recording() or ag.tape_alive() or _trace_session_active():
+        return ()
+    # duplicated buffers across slots would double-donate; buffers shared
+    # with another cell (detach, kvstore pull) must stay alive for it
+    seen = set()
+    shared = _SHARED_BUFFERS
+    for s in slots:
+        if s >= len(arrays):
+            return ()
+        a = arrays[s]
+        if id(a) in seen or (shared and _is_shared(a)):
+            return ()
+        seen.add(id(a))
+    return slots
+
+
+def dispatch(op, params, arrays, device, is_traced=None):
+    """Core dispatch: run `op` on raw jax arrays with normalized `params`.
+
+    Inside a trace, call the function directly so everything fuses into the
+    surrounding jit; eagerly, go through the per-op executable cache (with
+    bulking/donation as applicable).
+    """
+    tracer = _TRACER_CLS
+    if tracer is None:
+        _init_jax()
+        tracer = _TRACER_CLS
+    if is_traced is None:
+        is_traced = False
+        for a in arrays:
+            if isinstance(a, tracer):
+                is_traced = True
+                break
+    if _RECORD_DIR is not None and not is_traced and \
+            op.name not in _RECORDED:
+        _record_call(op, arrays, params)
+    if device is None or is_traced:
+        return op.closed(params)(*arrays)
+
+    if _BULK_HOOK is not None:
+        out = _BULK_HOOK(op, params, arrays, device)
+        if out is not NotImplemented:
+            return out
+        if _PLACEHOLDER_CLS is not None:
+            # bulking declined the call; resolve any lazy inputs so the
+            # eager executable sees concrete buffers
+            ph = _PLACEHOLDER_CLS
+            if any(type(a) is ph for a in arrays):
+                arrays = tuple(
+                    a._mxtpu_force() if type(a) is ph else a for a in arrays)
+
+    # scalar hyperparams declared dynamic become runtime operands so their
+    # per-step drift (scheduled lr, bias-corrected lr) can't churn the
+    # cache (fresh static dict: the caller's params feed the tape/mutate
+    # logic unchanged)
+    dyn_keys, dyn_vals, params = op.split_dynamic(params)
+    donate_slots = _donate_slots_for(op, params, arrays, device)
+    key = _InternedKey((_param_key(op, params), dyn_keys, device,
+                        bool(donate_slots)))
+    key = _KEY_INTERN.setdefault(key, key)
+    entry = _EAGER_CACHE.get(key)
+    if entry is None:
+        _STATS["eager_cache_miss"] += 1
+        entry = _compile(op, params, dyn_keys, device, donate_slots, key)
+    else:
+        _STATS["eager_cache_hit"] += 1
+    fn, n_donated = entry
+    # ctx placement: committed-on-device inputs pass through untouched (the
+    # previous per-call jax.device_put of every input dominated dispatch
+    # time); only host arrays / wrong-device buffers are moved.
+    moved = None
+    for i, a in enumerate(arrays):
+        try:
+            d = a.device
+            on_dev = d is device or d == device
+        except Exception:  # numpy input / sharded array
+            on_dev = False
+        if on_dev:
+            _STATS["device_put_skipped"] += 1
+        else:
+            _STATS["device_put_performed"] += 1
+            if moved is None:
+                moved = list(arrays)
+            moved[i] = _JAX.device_put(a, device)
+    if moved is not None:
+        arrays = moved
+    if n_donated:
+        _STATS["donated_dispatches"] += 1
+        _STATS["donated_args"] += n_donated
+    if dyn_vals:
+        return fn(*arrays, *dyn_vals)
+    return fn(*arrays)
 
 
 # op-call recording (tools/parity_sweep.py --full): first concrete call
 # per op name is captured so the chip-parity sweep can replay the exact
 # inputs the test suite certified on CPU. Enabled by the
 # MXNET_TPU_RECORD_OPS=<dir> env var (set by the sweep's record phase).
-import os as _os
 
 _RECORD_DIR = None
 _RECORDED: set = set()
@@ -161,6 +486,19 @@ if _os.environ.get("MXNET_TPU_RECORD_OPS"):
 
 def _record_call(op, arrays, params):
     import pickle
+
+    # Cheap bail-outs first: lazy (bulked) arrays must not be forced just to
+    # record them — skip without syncing and without marking the op done, so
+    # a later concrete call can still capture it. Unpicklable params are
+    # detected before any np.asarray device sync.
+    ph = _PLACEHOLDER_CLS
+    if ph is not None and any(type(a) is ph for a in arrays):
+        return
+    try:
+        pickle.dumps(params)
+    except Exception:
+        _RECORDED.add(op.name)
+        return
     import numpy as _rnp
 
     try:
@@ -172,31 +510,14 @@ def _record_call(op, arrays, params):
             pickle.dump({"name": op.name, "arrays": arrs,
                          "params": params}, f)
         _RECORDED.add(op.name)
-    except Exception:  # unpicklable param / lazy array: skip silently
+    except Exception:  # unpicklable array payload: skip silently
         _RECORDED.add(op.name)
 
 
 def apply_op(name, *arrays, device=None, **params):
-    """Run an op on raw jax arrays. Inside a trace, call the function
-    directly so everything fuses into the surrounding jit; eagerly, go
-    through the per-op jit cache."""
+    """Run an op on raw jax arrays (public entry; see `dispatch`)."""
     op = get_op(name)
-    params = op.normalize(params)
-    import jax.core as jcore
-
-    is_traced = any(isinstance(a, jcore.Tracer) for a in arrays)
-    if _RECORD_DIR is not None and op.name not in _RECORDED and \
-            not is_traced:
-        _record_call(op, arrays, params)
-    if device is None or is_traced:
-        return op.closed(params)(*arrays)
-    # make ctx placement real: move inputs to the requested device (no-op
-    # when already there) so the executable and its outputs land on ctx —
-    # matters when both a CPU and a TPU backend are live
-    import jax
-
-    arrays = tuple(jax.device_put(a, device) for a in arrays)
-    return _eager_fn(op, params, device)(*arrays)
+    return dispatch(op, op.normalize(params), arrays, device)
 
 
 def invoke(name, *arrays, device=None, **params):

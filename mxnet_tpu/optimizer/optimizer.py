@@ -39,7 +39,7 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
-                 param_dict=None, **kwargs):
+                 param_dict=None, aggregate_num=0, **kwargs):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -59,7 +59,11 @@ class Optimizer:
         # (reference Optimizer.__init__ calls set_wd_mult({}) itself — the
         # defaults must not depend on whether a user ever sets a mult)
         self.set_wd_mult({})
-        self.aggregate_num = 0
+        # aggregate_num > 1 asks the Trainer to run updates through an
+        # engine.bulk lazy segment of that many update ops, the TPU-native
+        # stand-in for the reference's MXNET_OPTIMIZER_AGGREGATION_SIZE
+        # multi-tensor kernels (0 keeps per-op eager dispatch)
+        self.aggregate_num = int(aggregate_num)
 
     def create_state(self, index, weight):
         return None

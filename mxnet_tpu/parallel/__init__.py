@@ -5,7 +5,7 @@ DataParallelExecutorGroup) is replaced by named device meshes + GSPMD
 shardings; tp/pp/sp axes — absent in the reference — are exposed here as
 first-class (free on XLA).
 """
-from .mesh import create_mesh, default_mesh, local_devices, AXES
+from .mesh import create_mesh, default_mesh, local_devices, AXES, shard_map
 from .functional import functional_call, param_arrays, aux_arrays
 from .trainer import ShardedTrainer, make_update_fn
 from . import mesh

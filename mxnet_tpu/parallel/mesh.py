@@ -55,3 +55,18 @@ def default_mesh(n_devices=None):
     if n_devices is not None:
         devs = devs[:n_devices]
     return create_mesh({"dp": len(devs)}, devs)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check=True):
+    """Version-portable jax shard_map: jax >= 0.6 exposes `jax.shard_map`
+    with the replication check named check_vma; earlier releases ship it
+    as jax.experimental.shard_map with check_rep."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _smap
+
+    return _smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 check_rep=check)

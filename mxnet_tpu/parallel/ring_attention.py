@@ -128,11 +128,12 @@ def _ring_fn(mesh, axis_name, causal, scale, impl, interpret):
     inner = functools.partial(ring_attention_inner, axis_name=axis_name,
                               causal=causal, scale=scale, impl=impl,
                               interpret=interpret)
+    from .mesh import shard_map
+
     # pallas_call outputs carry no varying-mesh-axes (vma) annotation, so
-    # the flash path runs with the vma type check off
-    return jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec,
-                                 check_vma=(impl != "flash")))
+    # the flash path runs with the replication/vma type check off
+    return jax.jit(shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec, check=(impl != "flash")))
 
 
 def _pick_impl(impl, t_local, d, ring=True):

@@ -127,9 +127,35 @@ class ShardedTrainer:
                        for k, v in self.params.items()}
         self.aux = {k: put(v, self._aux_sharding[k])
                     for k, v in self.aux.items()}
+        self.opt_state = jax.tree.map(put, self.opt_state,
+                                      self._opt_sharding())
+
+    def _opt_sharding(self):
+        """Sharding pytree for opt_state: param-shaped state leaves
+        (momenta, adam moments, master copies) follow their parameter's
+        sharding; everything else (step counter, rng keys) is replicated.
+        Used both for placement and for the step's in/out shardings — the
+        two MUST agree, or the donated state input aliases an
+        incompatibly-sharded output buffer (XLA INTERNAL size-mismatch)."""
+        import jax
+
         repl = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec())
-        self.opt_state = jax.tree.map(lambda v: put(v, repl), self.opt_state)
+
+        def shard_for(name, leaf):
+            ps = self._param_sharding.get(name)
+            p = self.params.get(name)
+            if ps is not None and p is not None \
+                    and hasattr(leaf, "shape") \
+                    and tuple(leaf.shape) == tuple(p.shape):
+                return ps
+            return repl
+
+        state = {
+            k: jax.tree.map(lambda v, _k=k: shard_for(_k, v), s)
+            for k, s in self.opt_state["state"].items()}
+        return {**{k: repl for k in self.opt_state if k != "state"},
+                "state": state}
 
     def _build_step(self):
         import jax
@@ -182,12 +208,18 @@ class ShardedTrainer:
             new_params, new_opt = update(params, grads, opt_state)
             return new_params, new_aux, new_opt, loss
 
+        # opt_state shardings are pinned on BOTH sides: donation aliases
+        # each state input buffer to its output, which is only valid when
+        # the output keeps the input's sharding (XLA propagation would
+        # otherwise shard tp-param momenta and break the aliasing)
+        opt_sharding = self._opt_sharding()
         out_shardings = (self._param_sharding, self._aux_sharding,
-                         None, None)
+                         opt_sharding, None)
         self._step = jax.jit(
             step,
-            in_shardings=(self._param_sharding, self._aux_sharding, None,
-                          self._batch_sharding, self._batch_sharding),
+            in_shardings=(self._param_sharding, self._aux_sharding,
+                          opt_sharding, self._batch_sharding,
+                          self._batch_sharding),
             out_shardings=out_shardings,
             donate_argnums=(0, 1, 2))
 

@@ -17,7 +17,8 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "profiler_set_config", "profiler_set_state", "Task",
-           "Frame", "Event", "Counter", "Marker", "scope"]
+           "Frame", "Event", "Counter", "Marker", "scope", "dispatch_stats",
+           "reset_dispatch_stats"]
 
 _LOCK = threading.Lock()
 _CONFIG = {"filename": "profile.json", "profile_all": False,
@@ -101,8 +102,44 @@ def dump(finished=True, profile_process="worker"):
         json.dump({"traceEvents": events}, f)
 
 
+def dispatch_stats(reset=False):
+    """Eager-dispatch observability counters as a flat dict: per-op
+    executable cache hits/misses, jax retraces, donated-buffer dispatches,
+    device_put skips, and bulk-segment stats from mxnet_tpu.engine.
+
+    Counter semantics (see docs/engine.md):
+    - eager_cache_hit/miss: per-op executable cache lookups in ops.registry
+    - eager_retrace: jax-level retraces (new shape/dtype specialization)
+    - donated_dispatches/donated_args: calls through (and args into)
+      donation-compiled executables for `mutate` ops
+    - device_put_skipped/performed: inputs already committed to the target
+      device vs. actually moved
+    - bulk_segments/bulk_ops/bulk_cache_hit/bulk_cache_miss/
+      bulk_max_segment/bulk_fallback_eager: lazy-segment bulking
+    """
+    from . import engine
+    from .ops import registry
+
+    stats = registry.dispatch_stats()
+    stats.update(engine.bulk_stats())
+    if reset:
+        reset_dispatch_stats()
+    return stats
+
+
+def reset_dispatch_stats():
+    """Zero all dispatch counters (registry + engine)."""
+    from . import engine
+    from .ops import registry
+
+    registry.reset_dispatch_stats()
+    for k in engine._STATS:
+        engine._STATS[k] = 0
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate stats as a printable table (profiler.py:151)."""
+    """Aggregate stats as a printable table (profiler.py:151), followed by
+    the dispatch counter table (cache hits, donation, bulking)."""
     with _LOCK:
         agg = {}
         for name, _, dur, _cat in _EVENTS:
@@ -115,6 +152,10 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):
     for name, (tot, cnt) in rows:
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}"
                      f"{tot / cnt * 1e3:>12.3f}")
+    lines.append("")
+    lines.append(f"{'Dispatch counter':<40}{'Value':>12}")
+    for name, value in sorted(dispatch_stats(reset=reset).items()):
+        lines.append(f"{name:<40}{value:>12}")
     return "\n".join(lines)
 
 

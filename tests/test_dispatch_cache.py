@@ -1,0 +1,441 @@
+"""Eager dispatch fast path: executable cache, donation, op bulking.
+
+Covers the dispatch-layer rework (ops/registry.py + engine.py): cache
+hit/miss counters, donation semantics for `mutate` ops, bulk segment
+record/force correctness vs per-op eager, nested/exception-safe bulk
+scopes, and the dynamic-scalar-param executable cache.
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, profiler
+from mxnet_tpu.ops import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_dispatch_state():
+    profiler.reset_dispatch_stats()
+    yield
+    # never leak bulk mode or a forced donation policy into other tests
+    engine.set_bulk_size(0)
+    engine.flush()
+    registry.set_eager_donation(2)
+
+
+# ---------------------------------------------------------------- cache
+
+
+def test_eager_cache_hit_miss_counters():
+    a = mx.nd.ones((5, 7))
+    b = mx.nd.ones((5, 7))
+    (a + b).wait_to_read()  # ensure executable exists
+    profiler.reset_dispatch_stats()
+    for _ in range(3):
+        c = a + b
+    c.wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["eager_cache_hit"] == 3
+    assert s["eager_cache_miss"] == 0
+    # a params change is a different executable
+    c = a.sum(axis=0)
+    c.wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["eager_cache_miss"] >= 1
+
+
+def test_retrace_counter_counts_shapes_not_calls():
+    a = mx.nd.ones((3, 3))
+    (a * a).wait_to_read()
+    profiler.reset_dispatch_stats()
+    for _ in range(4):
+        (a * a).wait_to_read()
+    # same shapes: cached executable, no retrace
+    assert profiler.dispatch_stats()["eager_retrace"] == 0
+    b = mx.nd.ones((6, 2))
+    (b * b).wait_to_read()  # new shape: one retrace, same cache entry
+    assert profiler.dispatch_stats()["eager_retrace"] == 1
+
+
+def test_device_put_skipped_for_committed_inputs():
+    a = mx.nd.ones((4, 4))
+    (a + a).wait_to_read()
+    profiler.reset_dispatch_stats()
+    (a + a).wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["device_put_performed"] == 0
+    assert s["device_put_skipped"] >= 1
+
+
+def test_dumps_includes_dispatch_counters():
+    out = profiler.dumps()
+    assert "eager_cache_hit" in out and "bulk_segments" in out
+
+
+# -------------------------------------------------------------- donation
+
+
+def test_donated_mutate_op_correct_and_counted():
+    prev = registry.set_eager_donation(1)
+    try:
+        w = mx.nd.ones((32,))
+        g = mx.nd.full((32,), 0.25)
+        opt = mx.optimizer.create("sgd", learning_rate=1.0)
+        state = opt.create_state(0, w)
+        profiler.reset_dispatch_stats()
+        opt.update(0, w, g, state)
+        # w <- w - lr*g = 0.75; no stale buffer visible through the cell
+        assert np.allclose(w.asnumpy(), 0.75)
+        s = profiler.dispatch_stats()
+        assert s["donated_dispatches"] >= 1
+        assert s["donated_args"] >= 1
+        # repeated updates keep reading/writing the rebound cell correctly
+        opt.update(0, w, g, state)
+        assert np.allclose(w.asnumpy(), 0.5)
+        assert np.allclose(g.asnumpy(), 0.25)  # non-mutate input untouched
+    finally:
+        registry.set_eager_donation(prev)
+
+
+def test_donation_momentum_state_chain():
+    prev = registry.set_eager_donation(1)
+    try:
+        w = mx.nd.ones((16,))
+        g = mx.nd.ones((16,))
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+        state = opt.create_state(0, w)
+        ref_w, ref_m = 1.0, 0.0
+        for _ in range(4):
+            opt.update(0, w, g, state)
+            ref_m = 0.9 * ref_m - 0.1 * 1.0
+            ref_w = ref_w + ref_m
+        assert np.allclose(w.asnumpy(), ref_w, atol=1e-6)
+        assert np.allclose(state.asnumpy(), ref_m, atol=1e-6)
+    finally:
+        registry.set_eager_donation(prev)
+
+
+def test_no_donation_while_recording():
+    prev = registry.set_eager_donation(1)
+    try:
+        x = mx.nd.ones((4, 4))
+        gamma = mx.nd.ones((4,))
+        beta = mx.nd.zeros((4,))
+        mean = mx.nd.zeros((4,))
+        var = mx.nd.ones((4,))
+        x.attach_grad()
+        profiler.reset_dispatch_stats()
+        with autograd.record():
+            y = mx.nd.imperative_invoke(
+                "BatchNorm", x, gamma, beta, mean, var, fix_gamma=False)[0]
+        # tape holds input buffers: donation must have stayed off
+        assert profiler.dispatch_stats()["donated_dispatches"] == 0
+        y.backward()
+        assert x.grad is not None
+    finally:
+        registry.set_eager_donation(prev)
+
+
+def test_dynamic_lr_does_not_churn_cache():
+    w = mx.nd.ones((8, 8))
+    g = mx.nd.ones((8, 8))
+    opt = mx.optimizer.create("adam", learning_rate=1e-3)
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)  # compile once
+    profiler.reset_dispatch_stats()
+    for _ in range(5):
+        opt.update(0, w, g, state)  # bias-corrected lr drifts every step
+    w.wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["eager_cache_miss"] == 0
+    assert s["eager_retrace"] == 0
+    assert s["eager_cache_hit"] >= 5
+
+
+def test_dynamic_lr_values_correct():
+    # same op through two very different lrs must give different updates
+    # from ONE executable
+    def run(lr):
+        w = mx.nd.ones((4,))
+        g = mx.nd.ones((4,))
+        mx.nd.imperative_invoke("sgd_update", w, g, lr=lr, wd=0.0,
+                                rescale_grad=1.0)
+        return w.asnumpy()
+
+    assert np.allclose(run(0.5), 0.5)
+    assert np.allclose(run(0.125), 0.875)
+
+
+def test_no_donation_while_tape_alive():
+    """backward(retain_graph=True) keeps tape nodes (and their captured
+    input buffers) alive; a donated optimizer update in between would
+    delete a buffer the second backward still replays."""
+    prev = registry.set_eager_donation(1)
+    try:
+        w = mx.nd.ones((4,))
+        w.attach_grad()
+        g = mx.nd.full((4,), 0.5)
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        with autograd.record():
+            loss = (w * w).sum()
+        loss.backward(retain_graph=True)
+        g1 = w.grad.asnumpy().copy()
+        profiler.reset_dispatch_stats()
+        opt.update(0, w, g, None)  # must NOT donate: tape still alive
+        assert profiler.dispatch_stats()["donated_dispatches"] == 0
+        loss.backward(retain_graph=False)  # replays captured buffers
+        assert np.allclose(w.grad.asnumpy(), g1)
+        # tape cleared and collected: donation available again
+        del loss
+        import gc
+
+        gc.collect()
+        w2 = mx.nd.ones((4,))
+        opt.update(1, w2, g, None)
+        assert profiler.dispatch_stats()["donated_dispatches"] == 1
+    finally:
+        registry.set_eager_donation(prev)
+
+
+def test_no_donation_for_shared_buffers():
+    """A detach()ed alias shares the weight buffer; donation must stay off
+    for that dispatch so the alias remains readable."""
+    prev = registry.set_eager_donation(1)
+    try:
+        w = mx.nd.ones((8,))
+        g = mx.nd.full((8,), 0.5)
+        alias = w.detach()
+        opt = mx.optimizer.create("sgd", learning_rate=1.0)
+        st = opt.create_state(0, w)
+        profiler.reset_dispatch_stats()
+        opt.update(0, w, g, st)
+        assert np.allclose(w.asnumpy(), 0.5)
+        assert np.allclose(alias.asnumpy(), 1.0)  # old buffer still alive
+        assert profiler.dispatch_stats()["donated_dispatches"] == 0
+        # a weight with no aliases still donates
+        w2 = mx.nd.ones((8,))
+        opt.update(1, w2, g, opt.create_state(1, w2))
+        assert profiler.dispatch_stats()["donated_dispatches"] == 1
+    finally:
+        registry.set_eager_donation(prev)
+
+
+def test_kvstore_update_on_store_with_donation():
+    """update-on-kvstore shares the store buffer into pulled weights; the
+    donated store-side optimizer update must not delete it."""
+    prev = registry.set_eager_donation(1)
+    try:
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+        weight = mx.nd.ones((4,))
+        kv.init(3, weight)
+        kv.pull(3, weight)
+        kv.push(3, mx.nd.full((4,), 0.25))
+        kv.pull(3, weight)
+        assert np.allclose(weight.asnumpy(), 0.75)
+    finally:
+        registry.set_eager_donation(prev)
+
+
+# --------------------------------------------------------------- bulking
+
+
+def test_bulk_matches_eager_results():
+    a = mx.nd.array(np.random.RandomState(0).randn(6, 6))
+    b = mx.nd.array(np.random.RandomState(1).randn(6, 6))
+
+    def prog():
+        y = a + b
+        z = y * a
+        s = z.sum(axis=0)
+        return (s - 1.0).asnumpy()
+
+    ref = prog()
+    with engine.bulk(8):
+        got = prog()
+    assert np.allclose(ref, got, atol=1e-6)
+
+
+def test_bulk_counters_and_segment_cache():
+    a = mx.nd.ones((3, 3))
+    profiler.reset_dispatch_stats()
+    with engine.bulk(8):
+        r = ((a + 1.0) * 2.0).sum()
+        r.wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["bulk_ops"] == 3
+    assert s["bulk_segments"] == 1
+    assert s["bulk_cache_miss"] == 1
+    with engine.bulk(8):
+        r = ((a + 1.0) * 2.0).sum()
+        r.wait_to_read()
+    s = profiler.dispatch_stats()
+    assert s["bulk_cache_hit"] == 1  # same recorded sequence: compiled once
+
+
+def test_bulk_auto_flush_at_size():
+    a = mx.nd.ones((2, 2))
+    profiler.reset_dispatch_stats()
+    with engine.bulk(2):
+        y = a + 1.0
+        z = y * 3.0   # segment hits size 2: forced here
+        w = z - 1.0   # new segment, forced on scope exit
+    assert np.allclose(w.asnumpy(), 5.0)
+    s = profiler.dispatch_stats()
+    assert s["bulk_segments"] == 2
+    assert s["bulk_max_segment"] == 2
+
+
+def test_bulk_mutate_op_write_back():
+    w = mx.nd.ones((8,))
+    g = mx.nd.full((8,), 0.5)
+    with engine.bulk(8):
+        mx.nd.imperative_invoke("sgd_update", w, g, lr=1.0, wd=0.0,
+                                rescale_grad=1.0)
+        w2 = w * 2.0  # chained on the lazy updated weight
+    assert np.allclose(w.asnumpy(), 0.5)
+    assert np.allclose(w2.asnumpy(), 1.0)
+
+
+def test_bulk_nested_and_exception_safe():
+    x = mx.nd.ones((4,))
+    with engine.bulk(4):
+        y = x + 1.0
+        with pytest.raises(RuntimeError):
+            with engine.bulk(2):
+                z = y * 2.0
+                raise RuntimeError("boom")
+        # inner scope flushed on the exception; outer keeps bulking
+        w = z + y
+    assert np.allclose(y.asnumpy(), 2.0)
+    assert np.allclose(z.asnumpy(), 4.0)
+    assert np.allclose(w.asnumpy(), 6.0)
+    assert engine._state().size == 0  # fully unwound
+
+
+def test_bulk_bypassed_under_autograd():
+    x = mx.nd.ones((3, 3))
+    x.attach_grad()
+    profiler.reset_dispatch_stats()
+    with engine.bulk(8):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert profiler.dispatch_stats()["bulk_ops"] == 0
+    assert np.allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_bulk_lazy_input_consumed_by_recorded_op():
+    x = mx.nd.ones((4,))
+    v = mx.nd.full((4,), 3.0)
+    v.attach_grad()
+    with engine.bulk(8):
+        base = x * 2.0  # lazy
+        with autograd.record():
+            y = (v * base).sum()  # lazy input forced for the tape
+        y.backward()
+    assert np.allclose(v.grad.asnumpy(), 2.0)
+    assert float(y.asscalar()) == 24.0
+
+
+def test_bulk_smoke_tiny_segment():
+    """Tier-1-safe smoke: one tiny bulk segment runs under JAX_PLATFORMS=cpu
+    in every run (CI satellite)."""
+    a = mx.nd.arange(0, 6).reshape((2, 3))
+    with engine.bulk(4):
+        out = (a + 1.0) * 2.0
+    assert np.allclose(out.asnumpy(), (np.arange(6).reshape(2, 3) + 1) * 2)
+    assert profiler.dispatch_stats()["bulk_segments"] >= 1
+
+
+def test_set_bulk_size_flushes_open_segment():
+    a = mx.nd.ones((2,))
+    engine.set_bulk_size(16)
+    y = a + 1.0
+    engine.set_bulk_size(0)  # must force the open segment
+    assert np.allclose(y.asnumpy(), 2.0)
+
+
+def test_waitall_forces_segments():
+    a = mx.nd.ones((2,))
+    engine.set_bulk_size(16)
+    y = a + 1.0
+    mx.nd.waitall()
+    engine.set_bulk_size(0)
+    assert np.allclose(y.asnumpy(), 2.0)
+
+
+def test_bulk_dynamic_lr_stable_segment_cache():
+    """Adam's bias-corrected lr drifts every step; bulked segments must
+    pass it as a runtime operand, not bake it into the segment key."""
+    def train(bulk):
+        w = mx.nd.ones((16,))
+        g = mx.nd.full((16,), 0.5)
+        opt = mx.optimizer.create("adam", learning_rate=0.01)
+        st = opt.create_state(0, w)
+        for _ in range(6):
+            if bulk:
+                with engine.bulk(4):
+                    opt.update(0, w, g, st)
+            else:
+                opt.update(0, w, g, st)
+        return w.asnumpy()
+
+    eager = train(False)
+    profiler.reset_dispatch_stats()
+    bulked = train(True)
+    s = profiler.dispatch_stats()
+    assert s["bulk_cache_miss"] <= 1, s  # one compile, then hits
+    assert s["bulk_cache_hit"] >= 5, s
+    assert np.allclose(eager, bulked, atol=1e-6)
+
+
+def test_trainer_bulked_updates_match_eager():
+    import mxnet_tpu.gluon as gluon
+
+    def train_once(aggregate_num):
+        net = gluon.nn.Dense(3, in_units=4)
+        net.initialize(mx.init.Constant(0.1))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1,
+                            "aggregate_num": aggregate_num})
+        data = mx.nd.ones((2, 4))
+        with autograd.record():
+            loss = (net(data) ** 2).sum()
+        loss.backward()
+        tr.step(batch_size=2)
+        # block names are instance-counted (dense0_, dense1_, ...): key by
+        # the stable suffix
+        return {k.split("_", 1)[1]: v.data().asnumpy()
+                for k, v in net.collect_params().items()}
+
+    eager = train_once(0)
+    bulked = train_once(4)
+    assert sorted(eager) == sorted(bulked)
+    for k in eager:
+        assert np.allclose(eager[k], bulked[k], atol=1e-6), k
+
+
+# ------------------------------------------------------------ benchmark
+
+
+@pytest.mark.slow
+def test_dispatch_bench_runs():
+    """Runs the microbenchmark end to end and checks its acceptance bars:
+    bulk(>=8) beats per-op eager on the same segment."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "tools/dispatch_bench.py", "--iters", "600"],
+        capture_output=True, text=True, timeout=600,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd=__file__.rsplit("/tests/", 1)[0])
+    assert proc.returncode == 0, proc.stderr
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"] == "dispatch_eager_ops_per_s"
+    assert result["extra"]["bulk_vs_eager"] > 1.0
+    assert result["extra"]["donated_dispatches"] > 0

@@ -45,7 +45,7 @@ def test_gradients_through_ring():
     spec = P(None, None, "sp", None)
 
     def loss_ring(q_, k_, v_):
-        f = jax.shard_map(
+        f = parallel.shard_map(
             lambda a, b, c: parallel.ring.ring_attention_inner(
                 a, b, c, causal=True),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
@@ -75,8 +75,8 @@ def test_composes_with_dp_axis():
     spec = P("dp", None, "sp", None)
     inner = lambda a, b, c: parallel.ring.ring_attention_inner(  # noqa: E731
         a, b, c, causal=True)
-    f = jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
-                              out_specs=spec))
+    f = jax.jit(parallel.shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                                   out_specs=spec))
     arrs = [jax.device_put(a, NamedSharding(mesh, spec)) for a in (q, k, v)]
     out = np.asarray(f(*arrs))
     np.testing.assert_allclose(out, _dense_ref(q, k, v, True), atol=2e-5)

@@ -1,0 +1,188 @@
+"""Microbenchmark: eager dispatch fast path, op bulking, donation.
+
+Prints ONE JSON line (like bench.py) so BENCH rounds can track dispatch
+overhead:
+
+    {"metric": "dispatch_eager_ops_per_s", "value": ..., "unit": "ops/s",
+     "vs_baseline": ..., "extra": {...}}
+
+`vs_baseline` compares the cached-hit eager path against the pre-fast-path
+registry measured on the same CPU backend (PR 1 baseline: 2187 ops/s — key
+construction + unconditional device_put + per-call imports on every op).
+
+Sections (details on stderr):
+- eager:   cached-hit ops/sec on tensor-tensor elemwise dispatch
+- bulk:    same op chain recorded through engine.bulk(N) lazy segments
+- donate:  mutate-op (sgd_update) dispatch with donation forced on/off,
+           plus the profiler donation counters
+- dynamic: adam_update with per-step bias-corrected lr — exercises the
+           dynamic-scalar executable cache (would recompile per step if lr
+           were baked into the key)
+
+Run: JAX_PLATFORMS=cpu python tools/dispatch_bench.py [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_EAGER_OPS_S = 2187.0  # pre-fast-path registry, CPU backend
+
+
+def _timeit(fn, iters, sync):
+    fn()  # warmup / compile
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    sync()
+    return time.perf_counter() - t0
+
+
+def bench_eager(mx, iters, shape=(64, 64)):
+    a = mx.nd.ones(shape)
+    b = mx.nd.ones(shape)
+    holder = []
+
+    def one():
+        holder.append(a + b)
+        holder.clear()
+
+    dt = _timeit(one, iters, lambda: mx.nd.waitall())
+    return iters / dt
+
+
+def _chain(a, b, n_ops):
+    y = a
+    for _ in range(n_ops // 2):
+        y = y + b
+        y = y * a
+    return y
+
+
+def bench_bulk(mx, engine, iters, bulk_size, shape=(64, 64)):
+    """Same op chain, same final sync, eager vs bulked. Both variants sync
+    once at the end (the realistic training-loop discipline — per-segment
+    blocking would serialize record and execute and measure backend latency
+    rather than dispatch overhead)."""
+    a = mx.nd.ones(shape)
+    b = mx.nd.ones(shape)
+    seg_iters = max(1, iters // bulk_size)
+
+    _chain(a, b, bulk_size).wait_to_read()  # compile warmup
+    t0 = time.perf_counter()
+    for _ in range(seg_iters):
+        r = _chain(a, b, bulk_size)
+    r.wait_to_read()
+    dt_e = time.perf_counter() - t0
+
+    with engine.bulk(bulk_size):
+        r = _chain(a, b, bulk_size)
+    r.wait_to_read()  # segment compile warmup
+    t0 = time.perf_counter()
+    with engine.bulk(bulk_size):
+        for _ in range(seg_iters):
+            r = _chain(a, b, bulk_size)
+    r.wait_to_read()
+    dt_b = time.perf_counter() - t0
+
+    ops = seg_iters * bulk_size
+    return ops / dt_e, ops / dt_b
+
+
+def bench_donate(mx, registry, profiler, iters, shape=(256, 256)):
+    out = {}
+    for label, mode in (("donate_off", 0), ("donate_on", 1)):
+        prev = registry.set_eager_donation(mode)
+        try:
+            w = mx.nd.ones(shape)
+            g = mx.nd.ones(shape)
+            opt = mx.optimizer.create("sgd", learning_rate=0.01)
+            state = opt.create_state(0, w)
+            profiler.reset_dispatch_stats()
+
+            def one():
+                opt.update(0, w, g, state)
+
+            dt = _timeit(one, iters, lambda: w.wait_to_read())
+            stats = profiler.dispatch_stats()
+            out[label] = {"updates_per_s": iters / dt,
+                          "donated_dispatches": stats["donated_dispatches"],
+                          "donated_args": stats["donated_args"]}
+        finally:
+            registry.set_eager_donation(prev)
+    return out
+
+
+def bench_dynamic(mx, profiler, iters, shape=(64, 64)):
+    w = mx.nd.ones(shape)
+    g = mx.nd.ones(shape)
+    opt = mx.optimizer.create("adam", learning_rate=1e-3)
+    state = opt.create_state(0, w)
+    profiler.reset_dispatch_stats()
+
+    def one():
+        opt.update(0, w, g, state)  # bias-corrected lr drifts every step
+
+    dt = _timeit(one, iters, lambda: w.wait_to_read())
+    stats = profiler.dispatch_stats()
+    return {"updates_per_s": iters / dt,
+            "cache_misses": stats["eager_cache_miss"],
+            "retraces": stats["eager_retrace"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--bulk-size", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, profiler
+    from mxnet_tpu.ops import registry
+
+    eager_ops_s = bench_eager(mx, args.iters)
+    print(f"eager cached-hit: {eager_ops_s:.0f} ops/s", file=sys.stderr)
+
+    eager_seg_s, bulk_seg_s = bench_bulk(mx, engine, args.iters,
+                                         args.bulk_size)
+    print(f"segment (size {args.bulk_size}): eager {eager_seg_s:.0f} ops/s"
+          f" | bulk {bulk_seg_s:.0f} ops/s"
+          f" ({bulk_seg_s / eager_seg_s:.2f}x)", file=sys.stderr)
+
+    donate = bench_donate(mx, registry, profiler, max(200, args.iters // 10))
+    for k, v in donate.items():
+        print(f"{k}: {v['updates_per_s']:.0f} updates/s, "
+              f"{v['donated_dispatches']} donated dispatches "
+              f"({v['donated_args']} buffers)", file=sys.stderr)
+
+    dyn = bench_dynamic(mx, profiler, max(200, args.iters // 10))
+    print(f"adam dynamic-lr: {dyn['updates_per_s']:.0f} updates/s, "
+          f"{dyn['cache_misses']} cache misses, {dyn['retraces']} retraces",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "dispatch_eager_ops_per_s",
+        "value": round(eager_ops_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(eager_ops_s / BASELINE_EAGER_OPS_S, 2),
+        "extra": {
+            "bulk_ops_per_s": round(bulk_seg_s, 1),
+            "bulk_vs_eager": round(bulk_seg_s / eager_seg_s, 2),
+            "bulk_size": args.bulk_size,
+            "sgd_updates_per_s_donated":
+                round(donate["donate_on"]["updates_per_s"], 1),
+            "donated_dispatches": donate["donate_on"]["donated_dispatches"],
+            "adam_updates_per_s": round(dyn["updates_per_s"], 1),
+            "adam_cache_misses": dyn["cache_misses"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
