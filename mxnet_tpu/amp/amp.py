@@ -129,13 +129,20 @@ def scale_loss(loss, trainer):
 
 def unscale(trainer):
     """Explicitly check overflow + update the dynamic scale; returns True
-    if this step's gradients are safe to apply."""
+    if this step's gradients are safe to apply. Overflow skips land on
+    the same ``health_skipped_steps`` counter as resilience sentinel
+    skips (profiler.dispatch_stats()), so 'unhealthy steps' is one
+    series regardless of which guardrail caught it."""
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None:
         return True
     params = [p for p in trainer._params if p.grad_req != "null"]
     overflow = scaler.has_overflow(params)
     scaler.update_scale(overflow)
+    if overflow:
+        from ..resilience.sentinel import note_skip
+
+        note_skip("amp_overflow")
     return not overflow
 
 

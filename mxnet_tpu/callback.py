@@ -6,7 +6,7 @@ import math
 import time
 
 __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
-           "module_checkpoint"]
+           "module_checkpoint", "resilient_checkpoint"]
 
 
 def do_checkpoint(prefix, period=1):
@@ -28,6 +28,22 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
             mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+
+    return _callback
+
+
+def resilient_checkpoint(manager, net, trainer=None, period=1):
+    """Epoch-end callback writing atomic, versioned checkpoints through a
+    resilience.CheckpointManager (net params + trainer/optimizer state +
+    RNG + loss-scaler state, CRC-stamped, keep_n retention) — the
+    crash-safe upgrade of ``do_checkpoint``. Resume with
+    ``manager.restore_latest(net=net, trainer=trainer)``."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            manager.save(iter_no + 1, net=net, trainer=trainer,
+                         epoch=iter_no + 1)
 
     return _callback
 

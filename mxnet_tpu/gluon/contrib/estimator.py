@@ -125,19 +125,60 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochEnd):
         self.logger.info(" ".join(msgs))
 
 
-class CheckpointHandler(EpochEnd):
-    """Save parameters every epoch (event_handler.py CheckpointHandler)."""
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Checkpoint every epoch (event_handler.py CheckpointHandler).
 
-    def __init__(self, model_dir, model_prefix="model"):
+    Default mode keeps the legacy behavior (plain ``save_parameters``
+    files). With ``atomic=True`` (or an explicit ``checkpoint_manager``)
+    checkpoints go through resilience.CheckpointManager instead: atomic
+    publish, CRC manifest, trainer/optimizer + RNG + loss-scaler state,
+    ``keep_n`` retention — and ``resume=True`` restores the newest valid
+    checkpoint at train_begin so an interrupted ``fit`` continues where
+    it died.
+    """
+
+    def __init__(self, model_dir, model_prefix="model", atomic=False,
+                 checkpoint_manager=None, keep_n=None, resume=False,
+                 save_trainer=True):
         import os
 
         self.model_dir = model_dir
         self.model_prefix = model_prefix
+        self.resume = resume
+        self.save_trainer = save_trainer
+        self.resumed_manifest = None
+        self._step_offset = 0
+        if checkpoint_manager is None and (atomic or keep_n is not None
+                                           or resume):
+            from ...resilience import CheckpointManager
+
+            checkpoint_manager = CheckpointManager(
+                model_dir, keep_n=keep_n, prefix=model_prefix)
+        self.manager = checkpoint_manager
         os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if self.resume and self.manager is not None:
+            self.resumed_manifest = self.manager.restore_latest(
+                net=estimator.net,
+                trainer=estimator.trainer if self.save_trainer else None)
+            if self.resumed_manifest is not None:
+                # fit() restarts its epoch counter at 0 — keep checkpoint
+                # step numbers monotonic past the restored one, or
+                # restore_latest would later prefer the stale pre-crash
+                # checkpoints and retention would prune the fresh ones
+                self._step_offset = self.resumed_manifest["step"] + 1
 
     def epoch_end(self, estimator, epoch=None, **kwargs):
         import os
 
+        if self.manager is not None:
+            step = epoch + self._step_offset
+            self.manager.save(
+                step, net=estimator.net,
+                trainer=estimator.trainer if self.save_trainer else None,
+                epoch=step)
+            return
         path = os.path.join(self.model_dir,
                             f"{self.model_prefix}-epoch{epoch}.params")
         estimator.net.save_parameters(path)

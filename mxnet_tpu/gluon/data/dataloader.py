@@ -141,9 +141,16 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 max_worker_respawns=None):
+        import os as _os
+
         self._dataset = dataset
         self._timeout = timeout
+        if max_worker_respawns is None:
+            max_worker_respawns = int(_os.environ.get(
+                "MXNET_TPU_DATALOADER_RESPAWNS", str(max(1, num_workers))))
+        self._max_worker_respawns = max(0, max_worker_respawns)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
@@ -215,21 +222,87 @@ class DataLoader:
     def _mp_iter(self):
         """Fork worker processes; batches return via shared memory and are
         converted to device arrays in the parent (reference multiprocess
-        DataLoader semantics, dataloader.py:533)."""
+        DataLoader semantics, dataloader.py:533).
+
+        Robustness: a worker that dies mid-epoch (OOM-kill, segfault) is
+        respawned — up to ``max_worker_respawns`` times — and any batch
+        it may have taken to its grave is resubmitted (duplicate results
+        from requeue races are detected and their shared memory
+        reclaimed). The result poll is bounded by ``timeout`` per batch
+        and raises naming the dead worker instead of stalling forever.
+        """
         import multiprocessing as mp
         import time as _time
 
         ctx = mp.get_context("fork")
         job_q = ctx.Queue()
         result_q = ctx.Queue()
-        workers = [ctx.Process(target=_mp_worker,
-                               args=(self._dataset, self._batchify_fn,
-                                     job_q, result_q), daemon=True)
-                   for _ in range(self._num_workers)]
-        for w in workers:
+
+        def spawn():
+            w = ctx.Process(target=_mp_worker,
+                            args=(self._dataset, self._batchify_fn,
+                                  job_q, result_q), daemon=True)
             w.start()
+            return w
+
+        workers = [spawn() for _ in range(self._num_workers)]
         batches = list(self._batch_sampler)
         pending: dict[int, object] = {}
+        received: set[int] = set()
+        respawns = [0]
+
+        def accept(got_j, status, payload):
+            """Record one result; duplicates (from requeue races) are
+            dropped — including failing duplicates of a batch whose
+            original result already arrived."""
+            if got_j in received:
+                if status == "ok":
+                    _shm_discard(payload)
+                return
+            if status == "error":
+                raise RuntimeError(
+                    f"DataLoader worker failed on batch {got_j}: "
+                    f"{payload}")
+            received.add(got_j)
+            pending[got_j] = payload
+
+        def reap_and_respawn(waiting_for, submitted):
+            """Replace dead workers and resubmit possibly-lost jobs."""
+            dead = [w for w in workers if not w.is_alive()]
+            if not dead:
+                return
+            for w in dead:
+                info = f"pid {w.pid}, exitcode {w.exitcode}"
+                if respawns[0] >= self._max_worker_respawns:
+                    raise RuntimeError(
+                        f"DataLoader worker ({info}) died while producing "
+                        f"batch ~{waiting_for} and the respawn budget "
+                        f"({self._max_worker_respawns}) is exhausted; "
+                        "check the dataset __getitem__ for crashes/OOM, "
+                        "or raise max_worker_respawns")
+                respawns[0] += 1
+                workers[workers.index(w)] = spawn()
+                import warnings
+
+                warnings.warn(
+                    f"DataLoader worker ({info}) died mid-epoch; "
+                    f"respawned (respawn {respawns[0]}/"
+                    f"{self._max_worker_respawns})")
+            # drain already-delivered results first so only genuinely
+            # missing jobs get resubmitted
+            while True:
+                try:
+                    accept(*result_q.get_nowait())
+                except queue.Empty:
+                    break
+            # a submitted-but-undelivered job may have been lost inside a
+            # dead worker: resubmit those (ones still sitting untaken in
+            # job_q get recomputed as duplicates — rare, bounded by the
+            # prefetch depth, and deduped on receive)
+            for i in range(waiting_for, submitted):
+                if i not in received:
+                    job_q.put((i, batches[i]))
+
         try:
             depth = min(len(batches),
                         self._prefetch or 2 * self._num_workers)
@@ -241,23 +314,21 @@ class DataLoader:
                 deadline = _time.monotonic() + self._timeout
                 while j not in pending:
                     try:
-                        got_j, status, payload = result_q.get(timeout=1.0)
+                        got = result_q.get(timeout=1.0)
                     except queue.Empty:
-                        if not any(w.is_alive() for w in workers):
-                            raise RuntimeError(
-                                "DataLoader worker processes died "
-                                "(killed/segfault?) before delivering "
-                                f"batch {j}")
+                        reap_and_respawn(j, submitted)
                         if _time.monotonic() > deadline:
+                            states = ", ".join(
+                                f"pid {w.pid}: "
+                                f"{'alive' if w.is_alive() else f'dead (exitcode {w.exitcode})'}"
+                                for w in workers)
                             raise RuntimeError(
                                 f"DataLoader timed out after "
-                                f"{self._timeout}s waiting for batch {j}")
+                                f"{self._timeout}s waiting for batch {j} "
+                                f"(workers: {states}); raise timeout= or "
+                                "check the dataset for a hang")
                         continue
-                    if status == "error":
-                        raise RuntimeError(
-                            f"DataLoader worker failed on batch {got_j}: "
-                            f"{payload}")
-                    pending[got_j] = payload
+                    accept(*got)
                 if submitted < len(batches):
                     job_q.put((submitted, batches[submitted]))
                     submitted += 1

@@ -14,6 +14,7 @@ import warnings
 
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from ..resilience import faults as _faults
 from .parameter import Parameter
 from ..ndarray import NDArray
 
@@ -51,6 +52,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._states_to_init = False
+        self._sentinel = None  # set by resilience.HealthSentinel.attach
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -98,11 +100,17 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Makes one step of parameter update: allreduce grads then apply
-        the optimizer (trainer.py:320)."""
+        the optimizer (trainer.py:320). An attached HealthSentinel is
+        consulted between the allreduce and the (possibly bulked) update,
+        so an unhealthy batch never reaches the weights."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        _faults.maybe_nan_grads(self._params)
+        if self._sentinel is not None \
+                and not self._sentinel.before_update(self):
+            return  # skipped or rolled back per the sentinel policy
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
@@ -128,6 +136,10 @@ class Trainer:
             "supported. Try setting `update_on_kvstore` to False when " \
             "creating trainer."
         self._optimizer.rescale_grad = self._scale / batch_size
+        _faults.maybe_nan_grads(self._params)
+        if self._sentinel is not None \
+                and not self._sentinel.before_update(self):
+            return
         self._update(ignore_stale_grad)
 
     def _bulk_size(self):
@@ -174,24 +186,35 @@ class Trainer:
                     for i, g, w in upd:
                         updater(i, g, w)
 
-    def save_states(self, fname):
-        """Saves trainer states (optimizer + scheduler) to a file
-        (trainer.py:463)."""
+    def get_states_bytes(self):
+        """Serialized trainer states (optimizer state per parameter) —
+        the byte form consumed by resilience.CheckpointManager."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "wb") as fout:
-            fout.write(self._updaters[0].get_states(
-                dump_optimizer=self._update_on_kvstore))
+        return self._updaters[0].get_states(
+            dump_optimizer=self._update_on_kvstore)
 
-    def load_states(self, fname):
-        """Loads trainer states from a file (trainer.py:492)."""
+    def set_states_bytes(self, states):
+        """Inverse of get_states_bytes (bitwise round-trip)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "rb") as f:
-            states = f.read()
         for updater in self._updaters:
             updater.set_states(states)
             updater.optimizer = self._optimizer
         self._optimizer.param_dict = {
             i: param for i, param in enumerate(self._params)}
+
+    def save_states(self, fname):
+        """Saves trainer states (optimizer + scheduler) to a file
+        (trainer.py:463). Atomic: temp file + fsync + rename, so a crash
+        mid-write can never truncate an existing states file."""
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(fname, self.get_states_bytes())
+
+    def load_states(self, fname):
+        """Loads trainer states from a file (trainer.py:492)."""
+        with open(fname, "rb") as f:
+            states = f.read()
+        self.set_states_bytes(states)

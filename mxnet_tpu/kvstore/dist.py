@@ -21,15 +21,23 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as _np
 
+from ..resilience import faults as _faults
 from .kvstore import KVStoreTPU, _pairs
 
-__all__ = ["KVStoreDist", "init_distributed", "is_distributed"]
+__all__ = ["KVStoreDist", "init_distributed", "is_distributed",
+           "DistConfigError"]
 
 _init_lock = threading.Lock()
 _initialized = False
+
+
+class DistConfigError(ValueError):
+    """Invalid DMLC_*/coordinator configuration, caught before touching
+    jax.distributed (whose errors surface deep inside the runtime)."""
 
 
 def _coordinator_from_env():
@@ -43,12 +51,76 @@ def _coordinator_from_env():
     return None
 
 
-def init_distributed(coordinator=None, num_processes=None, process_id=None):
+def _env_int(name):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise DistConfigError(
+            f"{name}={raw!r} is not an integer; fix the launcher "
+            "environment (tools/launch.py sets these)") from None
+
+
+def _validate_config(coordinator, num_processes, process_id):
+    """Fail fast with actionable messages instead of a hang or an opaque
+    error deep inside jax.distributed."""
+    if num_processes <= 0:
+        raise DistConfigError(
+            f"DMLC_NUM_WORKER must be a positive integer, got "
+            f"{num_processes}")
+    if not 0 <= process_id < num_processes:
+        raise DistConfigError(
+            f"DMLC_WORKER_ID={process_id} is out of range for "
+            f"DMLC_NUM_WORKER={num_processes} (ranks are 0.."
+            f"{num_processes - 1}); every worker needs a distinct rank")
+    host, sep, port = str(coordinator).rpartition(":")
+    if not sep or not host:
+        raise DistConfigError(
+            f"coordinator address {coordinator!r} must be 'host:port' "
+            "(set MXNET_TPU_COORDINATOR or DMLC_PS_ROOT_URI/"
+            "DMLC_PS_ROOT_PORT)")
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise DistConfigError(
+            f"coordinator port {port!r} in {coordinator!r} is not an "
+            "integer (check DMLC_PS_ROOT_PORT)") from None
+    if not 1 <= port_n <= 65535:
+        raise DistConfigError(
+            f"coordinator port {port_n} in {coordinator!r} is outside "
+            "1..65535 (check DMLC_PS_ROOT_PORT)")
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None,
+                     timeout=None, max_retries=None, backoff=None):
     """Initialize the jax distributed runtime (idempotent).
 
     Replaces the reference's ps-lite Van/tracker bootstrap: a single TCP
     coordination service (jax.distributed) instead of scheduler+server
-    processes.
+    processes. The reference's ps-lite Van retried sends forever; here a
+    missing peer fails LOUDLY in bounded time instead of hanging:
+
+    - ``timeout`` — hard wall-clock deadline in seconds for the whole
+      bootstrap, retries included (env ``MXNET_TPU_DIST_TIMEOUT``,
+      default 300);
+    - ``max_retries`` — connect attempts beyond the first (env
+      ``MXNET_TPU_DIST_RETRIES``, default 60 so the deadline, not the
+      retry count, is what normally bounds startup skew between ranks),
+      spaced by exponential backoff starting at ``backoff`` seconds
+      (env ``MXNET_TPU_DIST_BACKOFF``, default 1.0, capped at 30).
+
+    Non-coordinator ranks first PROBE the coordinator's TCP endpoint
+    under this retry/deadline loop and only then enter
+    jax.distributed.initialize. This matters: some jax/XLA versions
+    (e.g. 0.4.37) LOG(FATAL) and abort the whole process when the
+    coordination handshake times out, so the unreachable-peer case must
+    be caught before jax ever sees it. Rank 0 hosts the service and
+    needs no probe.
+
+    Raises DistConfigError for invalid env combinations and TimeoutError
+    when the coordinator stays unreachable past the deadline.
     """
     global _initialized
     with _init_lock:
@@ -56,25 +128,113 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
             return True
         coordinator = coordinator or _coordinator_from_env()
         if num_processes is None:
-            num_processes = int(os.environ.get("DMLC_NUM_WORKER", "0")) or None
+            num_processes = _env_int("DMLC_NUM_WORKER") or None
         if process_id is None:
-            wid = os.environ.get("DMLC_WORKER_ID")
-            process_id = int(wid) if wid is not None else None
+            process_id = _env_int("DMLC_WORKER_ID")
         if coordinator is None or num_processes is None or process_id is None:
             return False  # not launched as a distributed job
+        _validate_config(coordinator, num_processes, process_id)
+        if timeout is None:
+            timeout = float(os.environ.get("MXNET_TPU_DIST_TIMEOUT", "300"))
+        if max_retries is None:
+            max_retries = int(os.environ.get("MXNET_TPU_DIST_RETRIES", "60"))
+        if backoff is None:
+            backoff = float(os.environ.get("MXNET_TPU_DIST_BACKOFF", "1.0"))
         import jax
 
-        try:
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=num_processes,
-                                       process_id=process_id)
-        except RuntimeError as e:
-            # The user may have called jax.distributed.initialize() at
-            # program start themselves — that's fine, use their runtime.
-            if "already initialized" not in str(e).lower():
-                raise
-        _initialized = True
-        return True
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        last_err = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                _faults.maybe_dist_connect_fault()
+                if process_id != 0:
+                    _probe_coordinator(coordinator, min(remaining, 10.0))
+                _jax_dist_init(jax, coordinator, num_processes, process_id,
+                               remaining)
+                _initialized = True
+                return True
+            except RuntimeError as e:
+                # The user may have called jax.distributed.initialize()
+                # at program start themselves — that's fine, use theirs.
+                if "already initialized" in str(e).lower():
+                    _initialized = True
+                    return True
+                # only connectivity-flavored RuntimeErrors are worth
+                # retrying; deterministic failures (mismatched process
+                # counts, bad state) must surface immediately, not after
+                # a full backoff schedule dressed up as a TimeoutError
+                if not _is_connect_error(e):
+                    raise
+                last_err = e
+                _safe_shutdown(jax)
+            except (TimeoutError, ConnectionError, OSError) as e:
+                last_err = e
+                _safe_shutdown(jax)
+            attempt += 1
+            if attempt > max_retries:
+                break
+            delay = min(backoff * (2 ** (attempt - 1)), 30.0,
+                        max(0.0, deadline - time.monotonic()))
+            if delay > 0:
+                time.sleep(delay)
+        raise TimeoutError(
+            f"init_distributed: worker {process_id}/{num_processes} could "
+            f"not reach coordinator {coordinator} within {timeout:.1f}s "
+            f"({attempt} attempt(s), exponential backoff from "
+            f"{backoff:.1f}s). Last error: {last_err!r}. Check that the "
+            "coordinator process is up and DMLC_PS_ROOT_URI/"
+            "DMLC_PS_ROOT_PORT (or MXNET_TPU_COORDINATOR) point at it.")
+
+
+def _is_connect_error(e):
+    msg = str(e).lower()
+    return any(m in msg for m in ("deadline", "unavailable", "timed out",
+                                  "timeout", "connect", "refused",
+                                  "unreachable"))
+
+
+def _probe_coordinator(coordinator, timeout):
+    """Bounded TCP reachability check of the coordinator endpoint. Raises
+    ConnectionError (retryable) instead of letting the XLA coordination
+    client hit its fatal-abort path on an unreachable peer."""
+    import socket
+
+    host, _, port = coordinator.rpartition(":")
+    try:
+        sock = socket.create_connection((host, int(port)), timeout=timeout)
+        sock.close()
+    except OSError as e:
+        raise ConnectionError(
+            f"coordinator {coordinator} is not accepting connections "
+            f"({e})") from e
+
+
+def _safe_shutdown(jax):
+    """Best-effort teardown of a half-initialized distributed runtime so
+    the next initialize attempt doesn't trip 'should only be called
+    once'."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def _jax_dist_init(jax, coordinator, num_processes, process_id, remaining):
+    """One bootstrap attempt, bounded by the remaining deadline when this
+    jax version supports initialization_timeout (older versions fall back
+    to jax's internal default — the socket probe above still bounds the
+    unreachable-coordinator case)."""
+    kwargs = dict(coordinator_address=coordinator,
+                  num_processes=num_processes, process_id=process_id)
+    try:
+        jax.distributed.initialize(
+            initialization_timeout=max(1, int(remaining)), **kwargs)
+    except TypeError:
+        jax.distributed.initialize(**kwargs)
 
 
 def is_distributed():

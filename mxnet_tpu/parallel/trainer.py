@@ -318,6 +318,75 @@ class ShardedTrainer:
             self.params, self.aux, self.opt_state, x, y)
         return loss
 
+    def get_states_bytes(self):
+        """Serialize opt_state (host-side npz keyed by pytree path) — the
+        byte form consumed by resilience.CheckpointManager and
+        save_states."""
+        import io
+
+        import numpy as np
+
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.opt_state)
+        entries = {jax.tree_util.keystr(path): np.asarray(leaf)
+                   for path, leaf in flat}
+        buf = io.BytesIO()
+        np.savez(buf, **entries)
+        return buf.getvalue()
+
+    def set_states_bytes(self, data):
+        """Restore opt_state from get_states_bytes output. Every leaf is
+        re-placed with its original NamedSharding (via _opt_sharding), so
+        sharded optimizer state comes back sharded — loading it
+        replicated would break step donation aliasing AND silently
+        multiply per-device memory."""
+        import io
+
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        f = np.load(io.BytesIO(data), allow_pickle=False)
+        stored = {k: f[k] for k in f.files}
+        shardings = self._opt_sharding()
+
+        def restore(path, leaf, sh):
+            key = jax.tree_util.keystr(path)
+            if key not in stored:
+                raise ValueError(
+                    f"trainer states file is missing opt_state leaf {key} "
+                    "(saved from a different optimizer/model?)")
+            arr = stored.pop(key)
+            if tuple(arr.shape) != tuple(np.shape(leaf)):
+                raise ValueError(
+                    f"opt_state leaf {key} has shape {arr.shape} in the "
+                    f"states file but {np.shape(leaf)} in this trainer")
+            return jax.device_put(jnp.asarray(arr), sh)
+
+        new_state = jax.tree_util.tree_map_with_path(
+            restore, self.opt_state, shardings)
+        if stored:
+            raise ValueError(
+                "trainer states file has extra opt_state leaves "
+                f"{sorted(stored)[:3]} (saved from a different "
+                "optimizer/model?)")
+        self.opt_state = new_state
+
+    def save_states(self, fname):
+        """Save optimizer state to a file, atomically (temp + fsync +
+        rename); counterpart of gluon Trainer.save_states."""
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(fname, self.get_states_bytes())
+
+    def load_states(self, fname):
+        """Load optimizer state saved by save_states, restoring each
+        leaf's mesh sharding."""
+        with open(fname, "rb") as f:
+            self.set_states_bytes(f.read())
+
     def sync_to_net(self):
         """Write the sharded parameter state back into the gluon net
         (collapsed to one device so eager ops keep working)."""

@@ -116,25 +116,32 @@ def dispatch_stats(reset=False):
       device vs. actually moved
     - bulk_segments/bulk_ops/bulk_cache_hit/bulk_cache_miss/
       bulk_max_segment/bulk_fallback_eager: lazy-segment bulking
+    - resilience counters (docs/resilience.md): sentinel_checks/
+      sentinel_nonfinite/sentinel_grad_norm_trips/sentinel_rollbacks,
+      health_skipped_steps (sentinel skips + AMP overflow skips, one
+      shared series), ckpt_saves/ckpt_restores/ckpt_restore_skipped,
+      faults_armed/faults_fired
     """
-    from . import engine
+    from . import engine, resilience
     from .ops import registry
 
     stats = registry.dispatch_stats()
     stats.update(engine.bulk_stats())
+    stats.update(resilience.stats())
     if reset:
         reset_dispatch_stats()
     return stats
 
 
 def reset_dispatch_stats():
-    """Zero all dispatch counters (registry + engine)."""
-    from . import engine
+    """Zero all dispatch counters (registry + engine + resilience)."""
+    from . import engine, resilience
     from .ops import registry
 
     registry.reset_dispatch_stats()
     for k in engine._STATS:
         engine._STATS[k] = 0
+    resilience.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

@@ -1,0 +1,37 @@
+"""mxnet_tpu.resilience — survivable long-running training.
+
+Three cooperating pieces (docs/resilience.md):
+
+- :class:`CheckpointManager` — atomic, versioned, CRC-verified
+  checkpoints with retention and verified fall-back restore;
+- :class:`HealthSentinel` — per-step NaN/Inf + grad-norm watchdog with
+  ``raise | skip_batch | rollback`` policies;
+- :mod:`faults` — deterministic fault-injection harness used by the test
+  suite (and chaos drills) to prove the two above actually work.
+"""
+from . import faults
+from . import checkpoint as _checkpoint_mod
+from . import sentinel as _sentinel_mod
+from .checkpoint import (CheckpointManager, CheckpointCorruptError,
+                         atomic_write_bytes)
+from .sentinel import HealthSentinel, NumericHealthError, note_skip
+
+__all__ = ["CheckpointManager", "CheckpointCorruptError",
+           "atomic_write_bytes", "HealthSentinel", "NumericHealthError",
+           "note_skip", "faults", "stats", "reset_stats"]
+
+
+def stats():
+    """All resilience counters as one flat dict (merged into
+    ``profiler.dispatch_stats()``)."""
+    out = {}
+    out.update(_sentinel_mod.stats())
+    out.update(_checkpoint_mod.stats())
+    out.update(faults.stats())
+    return out
+
+
+def reset_stats():
+    _sentinel_mod.reset_stats()
+    _checkpoint_mod.reset_stats()
+    faults.reset_stats()

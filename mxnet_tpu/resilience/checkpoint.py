@@ -1,0 +1,474 @@
+"""Atomic, versioned training checkpoints.
+
+The durability contract (the property MXNet's multi-day training runs
+leaned on via checkpoint callbacks, and TensorFlow formalized in its
+fault-tolerance design):
+
+- a checkpoint is either fully present and internally consistent, or it
+  does not exist — payloads are written into a hidden temp directory,
+  fsynced, stamped with CRC32s in a manifest written last, and published
+  with a single directory rename;
+- ``restore_latest`` never trusts a checkpoint it cannot verify: missing
+  manifest, size or CRC mismatch, or unreadable payload makes it fall
+  back to the next older checkpoint;
+- a restore is bitwise: parameters, optimizer/trainer state, the global
+  RNG key, and the AMP loss-scaler state all round-trip exactly, so a
+  killed job resumes as if it never died.
+
+Layout under ``directory``::
+
+    ckpt-00000042/
+        manifest.json      # step/epoch/rng/scaler + per-file crc32/size
+        params.npz         # parameters (+ aux state for sharded trainers)
+        trainer.state      # optimizer state (Updater pickle or opt_state npz)
+
+Works with both trainer flavors: the eager ``gluon.Trainer`` (sharded or
+not — via its states-bytes API) and the pjit-ed ``parallel.ShardedTrainer``
+(params/aux/opt_state pytrees re-placed onto the mesh with their original
+NamedShardings on restore). Multi-host note: the manager is a per-process
+writer; on a multi-process mesh have rank 0 save (replicated state) or
+point each rank at its own directory.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import zlib
+
+import numpy as _np
+
+from . import faults
+
+__all__ = ["CheckpointManager", "CheckpointCorruptError", "atomic_write_bytes"]
+
+_MANIFEST = "manifest.json"
+_PARAMS = "params.npz"
+_TRAINER = "trainer.state"
+_FORMAT_VERSION = 1
+
+_STATS = {"ckpt_saves": 0, "ckpt_save_failures": 0, "ckpt_restores": 0,
+          "ckpt_restore_skipped": 0, "ckpt_pruned": 0}
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A specific checkpoint failed integrity verification."""
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def atomic_write_bytes(path, data, _fsync=True):
+    """Crash-safe byte write: temp file in the same directory + fsync +
+    rename. All checkpoint payloads (and Trainer.save_states) route
+    through here, which is also the fault-injection point for ENOSPC and
+    partial-write simulation."""
+    path = os.fspath(path)
+    data = faults.checkpoint_write_filter(path, data)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            if _fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if _fsync:
+        _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _npz_bytes(entries):
+    buf = io.BytesIO()
+    _np.savez(buf, **entries)
+    return buf.getvalue()
+
+
+def _is_sharded_trainer(trainer):
+    return trainer is not None and hasattr(trainer, "opt_state") \
+        and hasattr(trainer, "_param_sharding")
+
+
+def _net_param_map(net):
+    """name -> Parameter for a Block, ParameterDict, or plain mapping."""
+    if hasattr(net, "_params_with_prefix"):
+        return net._params_with_prefix()
+    if hasattr(net, "items"):
+        return dict(net.items())
+    raise TypeError(f"cannot collect parameters from {type(net)}")
+
+
+def _rng_state():
+    from .. import random as _random
+
+    key = _random._KEY
+    if key is None:
+        return None
+    return _np.asarray(key.asnumpy()).tolist()
+
+
+def _restore_rng(state):
+    if state is None:
+        return
+    import jax.numpy as jnp
+
+    from .. import random as _random
+
+    if _random._KEY is None:
+        _random.seed(0)  # materialize the key cell, then overwrite it
+    _random._KEY._set_data(jnp.asarray(_np.asarray(state, _np.uint32)))
+
+
+def _scaler_state(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return None
+    return {"loss_scale": float(scaler.loss_scale),
+            "unskipped": int(scaler._unskipped)}
+
+
+def _restore_scaler(trainer, state):
+    if state is None or trainer is None:
+        return
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    scaler.loss_scale = state["loss_scale"]
+    scaler._unskipped = state["unskipped"]
+
+
+class CheckpointManager:
+    """Atomic versioned checkpoints with retention and verified restore.
+
+    Parameters
+    ----------
+    directory : str — checkpoint root (created on first save)
+    keep_n : int — retain at most this many published checkpoints
+        (oldest pruned after each successful save; env default
+        ``MXNET_TPU_CKPT_KEEP``, fallback 5). ``keep_n <= 0`` keeps all.
+    prefix : str — checkpoint directory name prefix.
+    """
+
+    def __init__(self, directory, keep_n=None, prefix="ckpt"):
+        self.directory = os.fspath(directory)
+        if keep_n is None:
+            keep_n = int(os.environ.get("MXNET_TPU_CKPT_KEEP", "5"))
+        self.keep_n = int(keep_n)
+        self.prefix = prefix
+
+    # ------------------------------------------------------------- listing
+
+    def _tag(self, step):
+        return f"{self.prefix}-{int(step):08d}"
+
+    def list_checkpoints(self):
+        """[(step, path)] of *published* checkpoints, oldest first (no
+        integrity verification — see ``latest_valid``)."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        want = self.prefix + "-"
+        for name in os.listdir(self.directory):
+            if not name.startswith(want):
+                continue
+            suffix = name[len(want):]
+            if not suffix.isdigit():
+                continue
+            path = os.path.join(self.directory, name)
+            if os.path.isdir(path):
+                out.append((int(suffix), path))
+        return sorted(out)
+
+    def verify(self, path):
+        """Load and integrity-check one checkpoint; returns the manifest.
+        Raises CheckpointCorruptError with the precise reason."""
+        return self._verify(path)[0]
+
+    def _verify(self, path):
+        """verify() plus the payload bytes it had to read for the CRC
+        pass, so restore doesn't hit the disk twice."""
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: unreadable manifest ({e})") from e
+        if manifest.get("format_version") != _FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                f"{path}: unsupported format_version "
+                f"{manifest.get('format_version')!r}")
+        payloads = {}
+        for fname, meta in manifest.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            try:
+                with open(fpath, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"{path}: missing payload {fname} ({e})") from e
+            if len(data) != meta["size"]:
+                raise CheckpointCorruptError(
+                    f"{path}: {fname} truncated "
+                    f"({len(data)} != {meta['size']} bytes)")
+            if zlib.crc32(data) & 0xFFFFFFFF != meta["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{path}: {fname} failed CRC32 integrity check")
+            payloads[fname] = data
+        return manifest, payloads
+
+    def latest_valid(self):
+        """(step, path, manifest) of the newest checkpoint that passes
+        verification, or None. Corrupt/partial checkpoints are skipped
+        with a warning (counted in ``ckpt_restore_skipped``)."""
+        import warnings
+
+        for step, path in reversed(self.list_checkpoints()):
+            try:
+                return step, path, self.verify(path)
+            except CheckpointCorruptError as e:
+                _STATS["ckpt_restore_skipped"] += 1
+                warnings.warn(f"skipping corrupt checkpoint: {e}")
+        return None
+
+    # ---------------------------------------------------------------- save
+
+    def save(self, step, net=None, trainer=None, epoch=None, extra=None):
+        """Write one checkpoint atomically; returns its published path.
+
+        Snapshots, as available: ``net`` parameters (or the sharded
+        trainer's params+aux), ``trainer`` optimizer state (gluon Trainer
+        or parallel ShardedTrainer), the global RNG key, and the attached
+        AMP loss-scaler state. On any failure the previous checkpoints
+        are untouched.
+        """
+        if net is None and trainer is None:
+            raise ValueError("save() needs a net and/or a trainer")
+        os.makedirs(self.directory, exist_ok=True)
+        self._gc_debris()
+        tag = self._tag(step)
+        final = os.path.join(self.directory, tag)
+        tmpdir = os.path.join(self.directory, f".{tag}.tmp.{os.getpid()}")
+        if os.path.isdir(tmpdir):
+            shutil.rmtree(tmpdir)
+        os.makedirs(tmpdir)
+        try:
+            files = {}
+
+            def write(fname, data):
+                atomic_write_bytes(os.path.join(tmpdir, fname), data)
+                files[fname] = {"crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                                "size": len(data)}
+
+            kind = "sharded" if _is_sharded_trainer(trainer) else "gluon"
+            params = self._param_entries(net, trainer, kind)
+            if params is not None:
+                write(_PARAMS, _npz_bytes(params))
+            if trainer is not None:
+                write(_TRAINER, trainer.get_states_bytes())
+            faults.maybe_crash("ckpt_crash_before_manifest")
+            manifest = {"format_version": _FORMAT_VERSION,
+                        "kind": kind,
+                        "step": int(step),
+                        "epoch": None if epoch is None else int(epoch),
+                        "tag": tag,
+                        "rng_key": _rng_state(),
+                        "loss_scaler": _scaler_state(trainer),
+                        "files": files,
+                        "extra": extra or {}}
+            atomic_write_bytes(os.path.join(tmpdir, _MANIFEST),
+                               json.dumps(manifest, indent=1).encode())
+            # re-saving an existing step: move the old dir aside (rename,
+            # preserving its contents) rather than deleting it, so a kill
+            # here can at worst leave this step absent-but-recoverable,
+            # never destroyed-before-replaced
+            old = None
+            if os.path.isdir(final):
+                old = os.path.join(self.directory,
+                                   f".{tag}.old.{os.getpid()}")
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                os.replace(final, old)
+            os.replace(tmpdir, final)
+            _fsync_dir(self.directory)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+        except faults.SimulatedCrash:
+            # leave the partial temp dir behind, like a real SIGKILL would
+            _STATS["ckpt_save_failures"] += 1
+            raise
+        except BaseException:
+            _STATS["ckpt_save_failures"] += 1
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            raise
+        _STATS["ckpt_saves"] += 1
+        self._prune()
+        return final
+
+    def _param_entries(self, net, trainer, kind):
+        if kind == "sharded":
+            entries = {f"param:{k}": _np.asarray(v)
+                       for k, v in trainer.params.items()}
+            entries.update({f"aux:{k}": _np.asarray(v)
+                            for k, v in trainer.aux.items()})
+            return entries
+        if net is None:
+            return None
+        return {name: p.data().asnumpy() if hasattr(p, "data") else
+                _np.asarray(p)
+                for name, p in _net_param_map(net).items()}
+
+    def _gc_debris(self):
+        """Clean up after dead writers: remove stale ``.{tag}.tmp.{pid}``
+        dirs (a kill mid-save) and handle ``.{tag}.old.{pid}`` dirs — if
+        the kill landed between move-aside and publish, the moved-aside
+        dir is the only copy of that step, so it is renamed back;
+        otherwise it is deleted. Live pids (concurrent writers into the
+        same directory) are left alone."""
+        pat = re.compile(
+            rf"^\.({re.escape(self.prefix)}-\d+)\.(tmp|old)\.(\d+)$")
+        for name in os.listdir(self.directory):
+            m = pat.match(name)
+            if not m:
+                continue
+            tag, kind, pid = m.group(1), m.group(2), int(m.group(3))
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            path = os.path.join(self.directory, name)
+            final = os.path.join(self.directory, tag)
+            if kind == "old" and not os.path.isdir(final):
+                os.replace(path, final)  # resurrect the moved-aside step
+            else:
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _prune(self):
+        if self.keep_n <= 0:
+            return
+        ckpts = self.list_checkpoints()
+        for _, path in ckpts[:max(0, len(ckpts) - self.keep_n)]:
+            shutil.rmtree(path, ignore_errors=True)
+            _STATS["ckpt_pruned"] += 1
+
+    # ------------------------------------------------------------- restore
+
+    def restore_latest(self, net=None, trainer=None):
+        """Restore the newest *valid* checkpoint into ``net``/``trainer``;
+        returns its manifest, or None if no valid checkpoint exists.
+        Corrupt or partially-written checkpoints are skipped in favor of
+        the previous valid one."""
+        import warnings
+
+        if os.path.isdir(self.directory):
+            self._gc_debris()  # resurrect a step lost mid-publish
+        for _, path in reversed(self.list_checkpoints()):
+            try:
+                manifest, payloads = self._verify(path)
+            except CheckpointCorruptError as e:
+                _STATS["ckpt_restore_skipped"] += 1
+                warnings.warn(f"skipping corrupt checkpoint: {e}")
+                continue
+            return self._apply(manifest, payloads, net, trainer)
+        return None
+
+    def restore(self, path, net=None, trainer=None):
+        """Restore one specific checkpoint (verified, bitwise) and return
+        its manifest."""
+        manifest, payloads = self._verify(path)
+        return self._apply(manifest, payloads, net, trainer)
+
+    def _apply(self, manifest, payloads, net, trainer):
+        """Apply already-verified payload bytes (one disk read total)."""
+        kind = manifest.get("kind", "gluon")
+        if _PARAMS in payloads:
+            f = _np.load(io.BytesIO(payloads[_PARAMS]), allow_pickle=False)
+            entries = {k: f[k] for k in f.files}
+            if kind == "sharded":
+                if trainer is None:
+                    raise ValueError(
+                        "sharded checkpoint requires trainer= to restore")
+                self._restore_sharded_arrays(trainer, entries)
+            elif net is not None:
+                self._restore_net(net, entries)
+        if trainer is not None and _TRAINER in payloads:
+            trainer.set_states_bytes(payloads[_TRAINER])
+        _restore_rng(manifest.get("rng_key"))
+        _restore_scaler(trainer, manifest.get("loss_scaler"))
+        _STATS["ckpt_restores"] += 1
+        return manifest
+
+    def _restore_net(self, net, entries):
+        from ..ndarray import ndarray as _nd
+
+        params = _net_param_map(net)
+        missing = set(params) - set(entries)
+        if missing:
+            raise CheckpointCorruptError(
+                f"checkpoint lacks parameters {sorted(missing)[:5]} "
+                "required by the net")
+        for name, arr in entries.items():
+            if name not in params:
+                raise CheckpointCorruptError(
+                    f"checkpoint parameter '{name}' not present in net")
+            params[name].set_data(_nd.array(arr, dtype=arr.dtype))
+
+    def _restore_sharded_arrays(self, trainer, entries):
+        import jax
+        import jax.numpy as jnp
+
+        new_params, new_aux = {}, {}
+        for key, arr in entries.items():
+            group, _, name = key.partition(":")
+            if group == "param":
+                sh = trainer._param_sharding.get(name)
+                if sh is None:
+                    raise CheckpointCorruptError(
+                        f"checkpoint param '{name}' unknown to trainer")
+                new_params[name] = jax.device_put(jnp.asarray(arr), sh)
+            elif group == "aux":
+                sh = trainer._aux_sharding.get(name)
+                if sh is None:
+                    raise CheckpointCorruptError(
+                        f"checkpoint aux '{name}' unknown to trainer")
+                new_aux[name] = jax.device_put(jnp.asarray(arr), sh)
+        missing = set(trainer.params) - set(new_params)
+        if missing:
+            raise CheckpointCorruptError(
+                f"checkpoint lacks sharded params {sorted(missing)[:5]}")
+        trainer.params.update(new_params)
+        trainer.aux.update(new_aux)

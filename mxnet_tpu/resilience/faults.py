@@ -1,0 +1,216 @@
+"""Deterministic fault-injection harness.
+
+The resilience subsystem is only trustworthy if its failure paths are
+exercised, so every guarded operation in the runtime calls a cheap hook
+here (`maybe_*`) that is a no-op unless the matching fault is armed.
+Faults are armed either programmatically (the `inject` context manager /
+`arm`) or from the environment (`MXNET_TPU_FAULTS`), which lets
+subprocess tests crash a child at a precise point without code changes.
+
+Supported fault kinds (the hook that honours each is noted):
+
+- ``nan_grad``                  — poison one parameter gradient with NaN
+                                  (gluon ``Trainer.step``/``update``)
+- ``ckpt_enospc``               — checkpoint byte-write raises ENOSPC
+                                  (``resilience.checkpoint.atomic_write_bytes``)
+- ``ckpt_partial_write``        — checkpoint byte-write silently truncates
+                                  (same hook; caught later by CRC verify)
+- ``ckpt_crash_before_manifest``— simulated process death between payload
+                                  and manifest write (``CheckpointManager.save``)
+- ``dist_connect_timeout``      — coordinator connect raises TimeoutError
+                                  (``kvstore.dist.init_distributed``)
+
+Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
+times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
+``times=None`` = every invocation from k on). The env form is a comma list
+of ``kind[@at_step[:times]]`` with ``*`` for unlimited, e.g.::
+
+    MXNET_TPU_FAULTS="nan_grad@3,ckpt_crash_before_manifest,dist_connect_timeout@0:*"
+
+This module imports only the stdlib so hot-path callers can import it at
+module scope without dragging in jax.
+"""
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import threading
+
+__all__ = ["SimulatedCrash", "FaultInjected", "inject", "arm", "disarm",
+           "reset", "active", "get", "stats", "reset_stats",
+           "maybe_nan_grads", "checkpoint_write_filter", "maybe_crash",
+           "maybe_dist_connect_fault"]
+
+
+class SimulatedCrash(BaseException):
+    """Injected process death. Derives from BaseException so ordinary
+    ``except Exception`` cleanup handlers don't tidy up after it — the
+    point is to leave the same debris a SIGKILL would."""
+
+
+class FaultInjected(RuntimeError):
+    """Base class for injected recoverable errors (lets tests assert the
+    failure came from the harness, not a real defect)."""
+
+
+_LOCK = threading.Lock()
+_ACTIVE: dict[str, "_Fault"] = {}
+_STATS = {"faults_armed": 0, "faults_fired": 0}
+
+
+class _Fault:
+    """One armed fault: fires on invocations [at_step, at_step + times)."""
+
+    def __init__(self, kind, at_step=0, times=1):
+        self.kind = kind
+        self.at_step = int(at_step)
+        self.times = None if times is None else int(times)
+        self.calls = 0
+        self.fired = 0
+
+    def should_fire(self):
+        with _LOCK:
+            step = self.calls
+            self.calls += 1
+            if step < self.at_step:
+                return False
+            if self.times is not None and self.fired >= self.times:
+                return False
+            self.fired += 1
+            _STATS["faults_fired"] += 1
+            return True
+
+    def __repr__(self):
+        return (f"_Fault({self.kind!r}, at_step={self.at_step}, "
+                f"times={self.times}, calls={self.calls}, "
+                f"fired={self.fired})")
+
+
+def arm(kind, at_step=0, times=1):
+    """Arm a fault; returns the fault record (inspect ``.fired`` after)."""
+    fault = _Fault(kind, at_step, times)
+    with _LOCK:
+        _ACTIVE[kind] = fault
+        _STATS["faults_armed"] += 1
+    return fault
+
+
+def disarm(kind):
+    with _LOCK:
+        _ACTIVE.pop(kind, None)
+
+
+def reset():
+    """Disarm everything (tests call this between cases)."""
+    with _LOCK:
+        _ACTIVE.clear()
+
+
+def active(kind=None):
+    if kind is None:
+        return bool(_ACTIVE)
+    return kind in _ACTIVE
+
+
+def get(kind):
+    return _ACTIVE.get(kind)
+
+
+@contextlib.contextmanager
+def inject(kind, at_step=0, times=1):
+    """Arm ``kind`` for the duration of the block; yields the fault record
+    so callers can assert on ``.fired``."""
+    fault = arm(kind, at_step, times)
+    try:
+        yield fault
+    finally:
+        disarm(kind)
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def _install_from_env():
+    """Parse MXNET_TPU_FAULTS="kind[@at_step[:times]],..." once at import
+    (times "*" = unlimited)."""
+    spec = os.environ.get("MXNET_TPU_FAULTS", "").strip()
+    if not spec:
+        return
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        at_step, times = 0, 1
+        kind, _, addr = item.partition("@")
+        if addr:
+            s, _, t = addr.partition(":")
+            at_step = int(s)
+            if t:
+                times = None if t == "*" else int(t)
+        arm(kind, at_step=at_step, times=times)
+
+
+# --------------------------------------------------------------------- hooks
+# Each hook is called unconditionally from the runtime; the `if not
+# _ACTIVE` early-out keeps the disarmed cost to one dict truthiness check.
+
+def maybe_nan_grads(params):
+    """Poison the first non-null gradient in ``params`` (list of gluon
+    Parameters) with NaN. Hooked into Trainer.step/update."""
+    if not _ACTIVE:
+        return False
+    fault = _ACTIVE.get("nan_grad")
+    if fault is None or not fault.should_fire():
+        return False
+    for p in params:
+        if getattr(p, "grad_req", "write") == "null":
+            continue
+        g = p.grad()
+        g._set_data((g * float("nan"))._data)
+        return True
+    return False
+
+
+def checkpoint_write_filter(path, data):
+    """Filter applied to every checkpoint byte-write. May raise ENOSPC
+    (``ckpt_enospc``) or return a truncated payload
+    (``ckpt_partial_write``)."""
+    if not _ACTIVE:
+        return data
+    fault = _ACTIVE.get("ckpt_enospc")
+    if fault is not None and fault.should_fire():
+        raise OSError(errno.ENOSPC,
+                      "No space left on device [injected fault]", str(path))
+    fault = _ACTIVE.get("ckpt_partial_write")
+    if fault is not None and fault.should_fire():
+        return data[:max(1, len(data) // 2)]
+    return data
+
+
+def maybe_crash(point):
+    """Raise SimulatedCrash when the fault named ``point`` fires."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get(point)
+    if fault is not None and fault.should_fire():
+        raise SimulatedCrash(f"injected crash at {point}")
+
+
+def maybe_dist_connect_fault():
+    """Simulate an unreachable coordinator in init_distributed."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get("dist_connect_timeout")
+    if fault is not None and fault.should_fire():
+        raise TimeoutError(
+            "coordinator connect timed out [injected fault]")
+
+
+_install_from_env()

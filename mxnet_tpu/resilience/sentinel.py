@@ -1,0 +1,204 @@
+"""Numeric-health sentinel: cheap per-step NaN/Inf and grad-norm watchdog.
+
+One fused ``multi_all_finite`` reduction over every gradient per checked
+step (the same kernel the AMP loss scaler uses), plus an optional global
+grad-norm check via ``multi_sum_sq``. Hooked into ``gluon.Trainer.step``
+and ``update`` — the check runs after the gradient allreduce and before
+the (possibly bulked) optimizer update, so an unhealthy batch never
+touches the weights regardless of the dispatch path.
+
+Policies (``MXNET_TPU_HEALTH_POLICY`` or constructor arg):
+
+- ``raise``      — raise NumericHealthError immediately (default)
+- ``skip_batch`` — drop this step's update, keep training; shares the
+  ``health_skipped_steps`` counter with AMP overflow skips
+  (``amp.unscale``), surfaced via ``profiler.dispatch_stats()``
+- ``rollback``   — restore the last valid checkpoint (parameters,
+  optimizer state, RNG key, loss scaler) through an attached
+  CheckpointManager, then skip the step
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["HealthSentinel", "NumericHealthError", "note_skip", "stats",
+           "reset_stats"]
+
+POLICIES = ("raise", "skip_batch", "rollback")
+
+_STATS = {"sentinel_checks": 0, "sentinel_nonfinite": 0,
+          "sentinel_grad_norm_trips": 0, "sentinel_rollbacks": 0,
+          "health_skipped_steps": 0, "amp_overflow_skips": 0}
+
+
+class NumericHealthError(ArithmeticError):
+    """Training numerics went bad (NaN/Inf gradients or loss, or a global
+    grad-norm explosion) under the ``raise`` policy."""
+
+
+def note_skip(reason="sentinel"):
+    """Record one skipped update step. Both sentinel skips and AMP
+    loss-scaler overflow skips land on this one counter so dashboards see
+    a single 'unhealthy steps' series."""
+    _STATS["health_skipped_steps"] += 1
+    if reason == "amp_overflow":
+        _STATS["amp_overflow_skips"] += 1
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+class HealthSentinel:
+    """Per-step numeric watchdog for a gluon Trainer.
+
+    Parameters
+    ----------
+    policy : 'raise' | 'skip_batch' | 'rollback' (default: env
+        ``MXNET_TPU_HEALTH_POLICY``, else 'raise')
+    grad_norm_threshold : float or None — additionally trip when the
+        global gradient L2 norm exceeds this (None = finiteness only,
+        which keeps the check to a single fused reduction).
+    check_every : int — check every Nth step (amortize the device sync
+        when steps are tiny).
+    checkpoint_manager : CheckpointManager — required for 'rollback'.
+
+    Usage::
+
+        sentinel = HealthSentinel(policy="skip_batch").attach(trainer)
+        ...
+        trainer.step(batch)          # checked automatically
+        sentinel.check_loss(loss)    # optional explicit loss check
+    """
+
+    def __init__(self, policy=None, grad_norm_threshold=None, check_every=1,
+                 checkpoint_manager=None):
+        if policy is None:
+            policy = os.environ.get("MXNET_TPU_HEALTH_POLICY", "raise")
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {policy!r} "
+                "(check MXNET_TPU_HEALTH_POLICY)")
+        self.policy = policy
+        self.grad_norm_threshold = (None if grad_norm_threshold is None
+                                    else float(grad_norm_threshold))
+        self.check_every = max(1, int(check_every))
+        self.manager = checkpoint_manager
+        self._trainer = None
+        self._net = None
+        self._step = 0
+        self.last_reason = None
+
+    def attach(self, trainer, net=None, checkpoint_manager=None):
+        """Register with a gluon Trainer (trainer.step will consult this
+        sentinel before applying updates). Returns self for chaining."""
+        if checkpoint_manager is not None:
+            self.manager = checkpoint_manager
+        if self.policy == "rollback":
+            if self.manager is None:
+                raise ValueError(
+                    "rollback policy needs a CheckpointManager "
+                    "(pass checkpoint_manager= to attach())")
+            if net is None:
+                raise ValueError(
+                    "rollback policy needs the net (pass net= to "
+                    "attach()): restoring optimizer state without the "
+                    "parameters would leave an inconsistent model")
+        self._trainer = trainer
+        self._net = net
+        trainer._sentinel = self
+        return self
+
+    def detach(self):
+        if self._trainer is not None \
+                and getattr(self._trainer, "_sentinel", None) is self:
+            self._trainer._sentinel = None
+        self._trainer = None
+        return self
+
+    # ------------------------------------------------------------- checks
+
+    def _grads(self, trainer):
+        out = []
+        for p in trainer._params:
+            if p.grad_req != "null":
+                out.extend(p.list_grad())
+        return out
+
+    def _grads_healthy(self, trainer):
+        from ..ndarray import ndarray as _nd
+
+        grads = self._grads(trainer)
+        if not grads:
+            return True, None
+        finite = _nd.imperative_invoke(
+            "multi_all_finite", *grads, num_arrays=len(grads))[0]
+        if not bool(finite.asnumpy().reshape(-1)[0]):
+            _STATS["sentinel_nonfinite"] += 1
+            return False, "non-finite gradient (NaN/Inf)"
+        if self.grad_norm_threshold is not None:
+            sq = _nd.imperative_invoke(
+                "multi_sum_sq", *grads, num_arrays=len(grads))
+            total = float(sum(s.asnumpy().reshape(-1)[0] for s in sq))
+            norm = total ** 0.5
+            if norm > self.grad_norm_threshold:
+                _STATS["sentinel_grad_norm_trips"] += 1
+                return False, (f"global grad norm {norm:.3e} exceeds "
+                               f"threshold {self.grad_norm_threshold:.3e}")
+        return True, None
+
+    def before_update(self, trainer):
+        """Called by Trainer.step/update before the optimizer sweep.
+        Returns True when the update should proceed."""
+        self._step += 1
+        if (self._step - 1) % self.check_every:
+            return True
+        _STATS["sentinel_checks"] += 1
+        healthy, reason = self._grads_healthy(trainer)
+        if healthy:
+            return True
+        return self._apply_policy(trainer, reason)
+
+    def check_loss(self, loss):
+        """Explicit loss health check (call after forward). Returns True
+        when the loss is finite; applies the policy otherwise."""
+        import numpy as _np
+
+        _STATS["sentinel_checks"] += 1
+        val = loss.asnumpy() if hasattr(loss, "asnumpy") else _np.asarray(loss)
+        if bool(_np.isfinite(val).all()):
+            return True
+        _STATS["sentinel_nonfinite"] += 1
+        return self._apply_policy(self._trainer, "non-finite loss")
+
+    def _apply_policy(self, trainer, reason):
+        self.last_reason = reason
+        if self.policy == "raise":
+            raise NumericHealthError(
+                f"numeric health check failed at sentinel step "
+                f"{self._step}: {reason}")
+        if self.policy == "skip_batch":
+            note_skip("sentinel")
+            return False
+        # rollback: restore last valid checkpoint (params + optimizer
+        # state + RNG + scaler all come back from the manifest); counters
+        # move only once the restore actually happened — a failed
+        # rollback is fatal, not a skipped step
+        if self.manager is None:
+            raise NumericHealthError(
+                f"rollback requested ({reason}) but no CheckpointManager "
+                "is attached")
+        restored = self.manager.restore_latest(net=self._net,
+                                               trainer=trainer)
+        if restored is None:
+            raise NumericHealthError(
+                f"rollback requested ({reason}) but no valid checkpoint "
+                f"exists under {self.manager.directory}")
+        note_skip("sentinel")
+        _STATS["sentinel_rollbacks"] += 1
+        return False
