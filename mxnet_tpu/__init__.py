@@ -61,7 +61,7 @@ def __getattr__(name):
         "visualization": ".visualization", "contrib": ".contrib",
         "engine": ".engine", "operator": ".operator",
         "npx": ".numpy_extension", "numpy_extension": ".numpy_extension",
-        "resilience": ".resilience",
+        "resilience": ".resilience", "serving": ".serving",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

@@ -232,6 +232,29 @@ class Executor:
         self._pending_train = False
         return self._outputs
 
+    def forward_batch(self, feeds, raw=False):
+        """Inference fast path (mxnet_tpu.serving): run the jitted forward
+        with ``feeds`` (name -> NDArray or raw/numpy array) overriding the
+        bound arguments, WITHOUT writing into this executor's arg/aux
+        cells. Stateless per call, so concurrent callers never race —
+        the property the serving BatchServer relies on. Aux states are
+        read, not written (is_train=False inference: moving stats are
+        consumed, never updated). Returns raw jax arrays when ``raw``,
+        else NDArrays."""
+        arg_vals = []
+        for n in self._arg_names:
+            v = feeds.get(n)
+            if v is None:
+                v = self.arg_dict[n]._data
+            elif isinstance(v, NDArray):
+                v = v._data
+            arg_vals.append(v)
+        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        outs, _ = self._jit_fwd(arg_vals, aux_vals, False)
+        if raw:
+            return outs
+        return [NDArray(o, self._ctx) for o in outs]
+
     def backward(self, out_grads=None, is_train=True):
         import jax.numpy as jnp
 

@@ -68,11 +68,17 @@ def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None,
 
         def body(i, carry):
             m, w, o, kc, vc = carry
-            src = (my_idx - i) % axis_size
+            # axis_index must be (re)taken INSIDE the loop body: a value
+            # closed over from outside becomes a while-body constant, and
+            # under check_vma/check_rep=False jax re-materializes it as a
+            # PartitionId HLO, which SPMD partitioning rejects
+            # ("UNIMPLEMENTED: PartitionId instruction is not supported").
+            my = lax.axis_index(axis_name)
+            src = (my - i) % axis_size
             # per-hop streaming kernel: normalized block output + its lse
             out_i, lse_i = flash_attention_with_lse(
                 q, kc, vc, causal=causal, scale=s_scale,
-                interpret=interpret, q_offset=my_idx * t,
+                interpret=interpret, q_offset=my * t,
                 k_offset=src * t)
             # merge normalized hop results by log-sum-exp weight
             lse32 = lse_i.astype(jnp.float32)

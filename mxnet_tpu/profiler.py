@@ -121,27 +121,34 @@ def dispatch_stats(reset=False):
       health_skipped_steps (sentinel skips + AMP overflow skips, one
       shared series), ckpt_saves/ckpt_restores/ckpt_restore_skipped,
       faults_armed/faults_fired
+    - serving counters (docs/serving.md): serving_requests/batches/
+      batch_samples/padded_samples (pad waste), bucket hits/misses/
+      compiles, shed_deadline/shed_overload, poisoned_batches,
+      queue_peak, p50/p99 request latency (us)
     """
-    from . import engine, resilience
+    from . import engine, resilience, serving
     from .ops import registry
 
     stats = registry.dispatch_stats()
     stats.update(engine.bulk_stats())
     stats.update(resilience.stats())
+    stats.update(serving.stats())
     if reset:
         reset_dispatch_stats()
     return stats
 
 
 def reset_dispatch_stats():
-    """Zero all dispatch counters (registry + engine + resilience)."""
-    from . import engine, resilience
+    """Zero all dispatch counters (registry + engine + resilience +
+    serving)."""
+    from . import engine, resilience, serving
     from .ops import registry
 
     registry.reset_dispatch_stats()
     for k in engine._STATS:
         engine._STATS[k] = 0
     resilience.reset_stats()
+    serving.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

@@ -19,6 +19,9 @@ Supported fault kinds (the hook that honours each is noted):
                                   and manifest write (``CheckpointManager.save``)
 - ``dist_connect_timeout``      — coordinator connect raises TimeoutError
                                   (``kvstore.dist.init_distributed``)
+- ``nan_serving``               — poison one inference input batch with NaN
+                                  (``serving.Predictor``; proves the
+                                  BatchServer sentinel path)
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -40,7 +43,7 @@ import threading
 __all__ = ["SimulatedCrash", "FaultInjected", "inject", "arm", "disarm",
            "reset", "active", "get", "stats", "reset_stats",
            "maybe_nan_grads", "checkpoint_write_filter", "maybe_crash",
-           "maybe_dist_connect_fault"]
+           "maybe_dist_connect_fault", "maybe_nan_batch"]
 
 
 class SimulatedCrash(BaseException):
@@ -201,6 +204,39 @@ def maybe_crash(point):
     fault = _ACTIVE.get(point)
     if fault is not None and fault.should_fire():
         raise SimulatedCrash(f"injected crash at {point}")
+
+
+def maybe_nan_batch(feeds):
+    """Poison one inference batch (kind ``nan_serving``): the first
+    floating-point entry of ``feeds`` (dict name -> array) is replaced by
+    NaNs. Hooked into ``serving.Predictor`` just before execution, so the
+    poison flows through the real compiled executable and is caught by the
+    BatchServer's output health check — not short-circuited on the host."""
+    if not _ACTIVE:
+        return feeds
+    fault = _ACTIVE.get("nan_serving")
+    if fault is None:
+        return feeds
+    import numpy as np
+
+    # find a poisonable entry BEFORE consuming the fault's fire window:
+    # an all-integer feed (e.g. Embedding token ids) must not silently
+    # burn the injection and leave a test asserting on it hanging
+    target = None
+    for name, v in feeds.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            target = (name, a)
+            break
+    if target is None:
+        raise FaultInjected(
+            "nan_serving armed but the batch has no floating-point input "
+            f"to poison (inputs: {list(feeds)})")
+    if not fault.should_fire():
+        return feeds
+    out = dict(feeds)
+    out[target[0]] = np.full_like(target[1], np.nan)
+    return out
 
 
 def maybe_dist_connect_fault():

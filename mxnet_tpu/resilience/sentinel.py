@@ -164,6 +164,37 @@ class HealthSentinel:
             return True
         return self._apply_policy(trainer, reason)
 
+    def check_finite(self, arrays, what="serving batch"):
+        """Fused NaN/Inf check over a list of arrays (NDArray or raw jax
+        values) — the inference-side analogue of ``before_update``, called
+        by ``serving.BatchServer`` on every batch's outputs so one poisoned
+        request cannot wedge the queue or silently serve garbage. One
+        ``multi_all_finite`` reduction regardless of output count.
+
+        Returns True when healthy. Otherwise applies the policy and
+        returns False — except ``raise``, which raises. ``rollback``
+        degrades to ``skip_batch`` here: there is no trainer state to
+        restore on the inference path."""
+        from ..ndarray import ndarray as _nd
+
+        if not arrays:
+            return True
+        _STATS["sentinel_checks"] += 1
+        arrs = [a if isinstance(a, _nd.NDArray) else _nd.NDArray(a)
+                for a in arrays]
+        finite = _nd.imperative_invoke(
+            "multi_all_finite", *arrs, num_arrays=len(arrs))[0]
+        if bool(finite.asnumpy().reshape(-1)[0]):
+            return True
+        _STATS["sentinel_nonfinite"] += 1
+        self.last_reason = f"non-finite values in {what}"
+        if self.policy == "raise":
+            raise NumericHealthError(self.last_reason)
+        # no note_skip here: health_skipped_steps is the TRAINING-step
+        # series (shared with AMP overflow skips); poisoned inference
+        # batches have their own serving_poisoned_batches counter
+        return False
+
     def check_loss(self, loss):
         """Explicit loss health check (call after forward). Returns True
         when the loss is finite; applies the policy otherwise."""

@@ -1,0 +1,88 @@
+"""mxnet_tpu.serving — TPU-native inference runtime.
+
+The standalone deploy surface the reference ships as the C predict API
+(``include/mxnet/c_predict_api.h`` / ``src/c_api/c_predict_api.cc``:
+``MXPredCreate`` / ``MXPredSetInput`` / ``MXPredForward``), rebuilt for
+the compile-once/replay world. Two layers (docs/serving.md):
+
+- :class:`Predictor` — loads a saved Symbol JSON (ours or a
+  reference-saved one) + params, or wraps a gluon block, and compiles one
+  fused inference executable per **bucketed batch size** through the
+  Executor graph-binding path. ``set_input``/``forward``/``get_output``
+  give Predict-API parity; ``predict(batch)`` is the functional entry.
+- :class:`BatchServer` — a thread-safe dynamic batcher on top of a
+  Predictor: concurrent ``submit()`` returns futures, requests coalesce
+  up to ``max_batch_size`` or ``batch_timeout_ms``, batches pad to the
+  nearest declared bucket and unpad per request, per-request deadlines
+  shed late work, and a poisoned batch trips the HealthSentinel policy
+  instead of wedging the queue.
+
+All counters below surface through ``profiler.dispatch_stats()`` /
+``profiler.dumps()`` next to the PR 1 dispatch counters.
+"""
+from __future__ import annotations
+
+import threading as _threading
+from collections import deque as _deque
+
+# Counters are defined BEFORE the submodule imports at the bottom so
+# predictor.py / batcher.py can `from . import _STATS` during package init.
+_STATS = {
+    # Predictor
+    "serving_predict_calls": 0,    # forward()/predict() invocations
+    "serving_compiles": 0,         # bucket executors built (one XLA program)
+    "serving_bucket_hits": 0,      # predict() found its bucket executor
+    "serving_bucket_misses": 0,    # predict() had to build one
+    "serving_unbucketed": 0,       # exact-size compiles beyond max bucket
+    "serving_batch_samples": 0,    # rows executed (bucket-padded)
+    "serving_padded_samples": 0,   # of which padding (waste)
+    # BatchServer
+    "serving_requests": 0,         # accepted submits
+    "serving_batches": 0,          # coalesced batch executions
+    "serving_shed_deadline": 0,    # requests failed on expired deadline
+    "serving_shed_overload": 0,    # requests shed at the queue high-water
+    "serving_poisoned_batches": 0, # batches the health check rejected
+    "serving_queue_peak": 0,       # high-water mark of queued requests
+}
+
+_LAT_LOCK = _threading.Lock()
+_LATENCIES = _deque(maxlen=8192)  # seconds, submit -> result
+
+
+def record_latency(seconds):
+    with _LAT_LOCK:
+        _LATENCIES.append(seconds)
+
+
+def _percentile_us(sorted_lat, q):
+    if not sorted_lat:
+        return 0
+    idx = min(len(sorted_lat) - 1, int(q * (len(sorted_lat) - 1) + 0.5))
+    return int(sorted_lat[idx] * 1e6)
+
+
+def stats():
+    """All serving counters as one flat dict (merged into
+    ``profiler.dispatch_stats()``), including request-latency percentiles
+    over a sliding window of the last 8192 completed requests."""
+    out = dict(_STATS)
+    with _LAT_LOCK:
+        lat = sorted(_LATENCIES)
+    out["serving_p50_latency_us"] = _percentile_us(lat, 0.50)
+    out["serving_p99_latency_us"] = _percentile_us(lat, 0.99)
+    return out
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+    with _LAT_LOCK:
+        _LATENCIES.clear()
+
+
+from .predictor import Predictor  # noqa: E402
+from .batcher import (BatchServer, DeadlineExceeded, ServerClosed,  # noqa: E402
+                      ServerOverloaded)
+
+__all__ = ["Predictor", "BatchServer", "DeadlineExceeded", "ServerClosed",
+           "ServerOverloaded", "stats", "reset_stats", "record_latency"]

@@ -1,0 +1,349 @@
+"""BatchServer — thread-safe dynamic batching over a Predictor.
+
+The serving-side analogue of engine op-bulking: many small concurrent
+requests coalesce into one bucketed executable launch. The reference had
+no equivalent (its deploy surface is single-stream ``MXPredForward``);
+the design follows the TF-Serving batching layer the TensorFlow paper
+describes — a queue, a size trigger, a time trigger, and padding to a
+compiled shape.
+
+Mechanics:
+
+- ``submit(batch)`` enqueues and returns a ``concurrent.futures.Future``;
+  a background worker pops requests, coalesces up to ``max_batch_size``
+  rows or until ``batch_timeout_ms`` after the oldest request arrived,
+  pads the fused batch to the Predictor's nearest bucket, runs ONE
+  executable, and slices results back per request (padding rows never
+  reach a caller).
+- Only shape/dtype-compatible requests coalesce; a mixed queue batches
+  per-signature in arrival order.
+- Per-request deadlines: a request whose deadline passes while queued is
+  failed with :class:`DeadlineExceeded`, never executed.
+- Load shedding at ``max_queue_depth``: ``reject_new`` fails the incoming
+  request, ``reject_oldest`` sheds the head of the queue in its favor.
+- ``close(drain=True)`` stops intake, flushes the queue, joins the
+  worker; ``drain=False`` fails pending requests with
+  :class:`ServerClosed`.
+- Resilience: every batch's outputs run through
+  ``HealthSentinel.check_finite`` (one fused ``multi_all_finite``); a
+  poisoned batch fails only its own requests with ``NumericHealthError``
+  and the queue keeps serving — the sentinel policy decides raise vs
+  skip accounting, the queue is never wedged either way.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..resilience.sentinel import HealthSentinel, NumericHealthError
+from . import _STATS, record_latency
+
+__all__ = ["BatchServer", "DeadlineExceeded", "ServerOverloaded",
+           "ServerClosed"]
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's SLA deadline passed before execution started."""
+
+
+class ServerOverloaded(RuntimeError):
+    """The request was shed at the queue high-water mark."""
+
+
+class ServerClosed(RuntimeError):
+    """The server is closed (or closing without drain)."""
+
+
+class _Request:
+    __slots__ = ("feeds", "rows", "sig", "future", "t_submit", "deadline")
+
+    def __init__(self, feeds, rows, sig, deadline):
+        self.feeds = feeds
+        self.rows = rows
+        self.sig = sig
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter time, or None
+
+
+def _env_float(name, default):
+    v = os.environ.get(name, "").strip()
+    return float(v) if v else default
+
+
+def _env_int(name, default):
+    v = os.environ.get(name, "").strip()
+    return int(v) if v else default
+
+
+class BatchServer:
+    """Dynamic batcher over a :class:`Predictor`.
+
+    Parameters
+    ----------
+    predictor : Predictor
+    max_batch_size : int — coalescing cap in ROWS (default: env
+        ``MXNET_TPU_SERVING_MAX_BATCH``, else the predictor's largest
+        declared bucket). A single request may not exceed it.
+    batch_timeout_ms : float — how long the oldest queued request may
+        wait for the batch to fill (default env
+        ``MXNET_TPU_SERVING_TIMEOUT_MS``, else 2.0).
+    max_queue_depth : int — request high-water mark before shedding
+        (default env ``MXNET_TPU_SERVING_QUEUE_DEPTH``, else 1024).
+    shed_policy : 'reject_new' | 'reject_oldest' (default env
+        ``MXNET_TPU_SERVING_SHED_POLICY``, else 'reject_new').
+    default_deadline_ms : per-request SLA applied when ``submit`` gives
+        none (default env ``MXNET_TPU_SERVING_DEADLINE_MS``, else off).
+    sentinel : HealthSentinel — output health policy (default: a fresh
+        sentinel with policy ``MXNET_TPU_SERVING_HEALTH`` or
+        'skip_batch'). Pass ``check_health=False`` to skip the check.
+    """
+
+    SHED_POLICIES = ("reject_new", "reject_oldest")
+
+    def __init__(self, predictor, max_batch_size=None, batch_timeout_ms=None,
+                 max_queue_depth=None, shed_policy=None,
+                 default_deadline_ms=None, sentinel=None, check_health=True):
+        self.predictor = predictor
+        self.max_batch_size = int(
+            max_batch_size if max_batch_size is not None
+            else _env_int("MXNET_TPU_SERVING_MAX_BATCH",
+                          max(predictor.buckets)))
+        self.batch_timeout_s = (
+            batch_timeout_ms if batch_timeout_ms is not None
+            else _env_float("MXNET_TPU_SERVING_TIMEOUT_MS", 2.0)) / 1e3
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None
+            else _env_int("MXNET_TPU_SERVING_QUEUE_DEPTH", 1024))
+        self.shed_policy = (shed_policy
+                            or os.environ.get("MXNET_TPU_SERVING_SHED_POLICY",
+                                              "reject_new"))
+        if self.shed_policy not in self.SHED_POLICIES:
+            raise ValueError(f"shed_policy must be one of "
+                             f"{self.SHED_POLICIES}, got {self.shed_policy!r}")
+        dms = (default_deadline_ms if default_deadline_ms is not None
+               else _env_float("MXNET_TPU_SERVING_DEADLINE_MS", 0.0))
+        self.default_deadline_s = dms / 1e3 if dms else None
+        if check_health:
+            self.sentinel = sentinel or HealthSentinel(
+                policy=os.environ.get("MXNET_TPU_SERVING_HEALTH",
+                                      "skip_batch"))
+        else:
+            self.sentinel = None
+        self._queue = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+        self._worker = threading.Thread(target=self._serve_loop,
+                                        name="mxnet-tpu-serving", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------ intake
+    def _coerce(self, data):
+        """One request's inputs -> (np feeds dict, rows, sig). Validation
+        (names, row consistency) is the Predictor's own ``_coerce_feeds``
+        — one rulebook for both entry points; on top of it, arrays are
+        snapshotted to host numpy COPIES so the caller may reuse (or
+        mutate) its buffers the moment submit returns."""
+        feeds, rows = self.predictor._coerce_feeds(data)
+        feeds = {name: _np.array(a, copy=True) for name, a in feeds.items()}
+        return feeds, rows, self.predictor._sig_of(feeds)
+
+    def submit(self, data, deadline_ms=None):
+        """Enqueue one request (array or dict name -> array, WITH batch
+        axis; 1..max_batch_size rows). Returns a Future resolving to the
+        list of output numpy arrays for exactly those rows."""
+        # cheap-path shedding BEFORE the input snapshot: under sustained
+        # overload with reject_new, a doomed request must not pay a full
+        # host copy of its batch just to be rejected
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("BatchServer is closed")
+            if len(self._queue) >= self.max_queue_depth and \
+                    self.shed_policy == "reject_new":
+                _STATS["serving_shed_overload"] += 1
+                fut = Future()
+                fut.set_exception(ServerOverloaded(
+                    f"queue depth {len(self._queue)} at high-water "
+                    f"mark {self.max_queue_depth}"))
+                return fut
+        feeds, rows, sig = self._coerce(data)
+        if rows < 1 or rows > self.max_batch_size:
+            raise MXNetError(f"request rows must be 1..{self.max_batch_size}"
+                             f", got {rows}")
+        if deadline_ms is not None:
+            deadline = time.perf_counter() + deadline_ms / 1e3
+        elif self.default_deadline_s is not None:
+            deadline = time.perf_counter() + self.default_deadline_s
+        else:
+            deadline = None
+        req = _Request(feeds, rows, sig, deadline)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("BatchServer is closed")
+            if len(self._queue) >= self.max_queue_depth:
+                _STATS["serving_shed_overload"] += 1
+                if self.shed_policy == "reject_new":
+                    req.future.set_exception(ServerOverloaded(
+                        f"queue depth {len(self._queue)} at high-water "
+                        f"mark {self.max_queue_depth}"))
+                    return req.future
+                oldest = self._queue.popleft()
+                oldest.future.set_exception(ServerOverloaded(
+                    "shed by a newer request (reject_oldest)"))
+            self._queue.append(req)
+            _STATS["serving_requests"] += 1
+            if len(self._queue) > _STATS["serving_queue_peak"]:
+                _STATS["serving_queue_peak"] = len(self._queue)
+            self._cond.notify_all()
+        return req.future
+
+    @property
+    def queue_depth(self):
+        with self._cond:
+            return len(self._queue)
+
+    # ------------------------------------------------------------------ worker
+    def _prune_expired(self):
+        """Shed every queued request whose deadline already passed (called
+        under the lock). Expired requests must not count toward the size
+        trigger or ride along in a popped batch: a queue half-full of dead
+        work would otherwise launch half-empty executables and shed live
+        traffic at the high-water mark."""
+        if not any(r.deadline is not None for r in self._queue):
+            return
+        now = time.perf_counter()
+        kept = deque()
+        for r in self._queue:
+            if r.deadline is not None and now > r.deadline:
+                _STATS["serving_shed_deadline"] += 1
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed {(now - r.deadline) * 1e3:.2f}ms "
+                    "before execution"))
+            else:
+                kept.append(r)
+        self._queue = kept
+
+    def _take_batch(self):
+        """Pop the next coalescable run of requests (same signature, total
+        rows <= max_batch_size), honoring the time trigger. Returns None
+        when closed and drained."""
+        with self._cond:
+            while True:
+                self._prune_expired()
+                if not self._queue:
+                    if self._closed:
+                        return None
+                    self._cond.wait()
+                    continue
+                head = self._queue[0]
+                rows = 0
+                for r in self._queue:
+                    if r.sig != head.sig:
+                        break
+                    rows += r.rows
+                now = time.perf_counter()
+                t_flush = head.t_submit + self.batch_timeout_s
+                if rows >= self.max_batch_size or now >= t_flush or \
+                        self._closed:
+                    batch, rows = [], 0
+                    while self._queue and \
+                            self._queue[0].sig == head.sig and \
+                            rows + self._queue[0].rows <= self.max_batch_size:
+                        req = self._queue.popleft()
+                        batch.append(req)
+                        rows += req.rows
+                    return batch
+                # wake at the flush trigger or the next queued deadline,
+                # whichever comes first (so expiry is shed promptly)
+                t_wake = t_flush
+                for r in self._queue:
+                    if r.deadline is not None and r.deadline < t_wake:
+                        t_wake = r.deadline
+                self._cond.wait(max(0.0, t_wake - now))
+
+    def _serve_loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if not self._drain:
+                for r in batch:
+                    r.future.set_exception(ServerClosed(
+                        "BatchServer closed without drain"))
+                continue
+            # second line of defense: time passes between pop and launch
+            now = time.perf_counter()
+            live = []
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    _STATS["serving_shed_deadline"] += 1
+                    r.future.set_exception(DeadlineExceeded(
+                        f"deadline passed {(now - r.deadline) * 1e3:.2f}ms "
+                        "before execution"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            self._execute(live)
+
+    def _execute(self, batch):
+        try:
+            fused = {name: (batch[0].feeds[name] if len(batch) == 1
+                            else _np.concatenate(
+                                [r.feeds[name] for r in batch], axis=0))
+                     for name in batch[0].feeds}
+            outs, _n = self.predictor.predict_raw(fused)
+            healthy = True
+            err = None
+            if self.sentinel is not None:
+                try:
+                    healthy = self.sentinel.check_finite(
+                        outs, what="serving batch outputs")
+                except NumericHealthError as e:
+                    healthy, err = False, e
+            if not healthy:
+                _STATS["serving_poisoned_batches"] += 1
+                err = err or NumericHealthError(
+                    self.sentinel.last_reason or
+                    "non-finite values in serving batch outputs")
+                for r in batch:
+                    r.future.set_exception(err)
+                return
+            np_outs = [_np.asarray(o) for o in outs]
+            _STATS["serving_batches"] += 1
+            offset = 0
+            t_done = time.perf_counter()
+            for r in batch:
+                sl = slice(offset, offset + r.rows)
+                r.future.set_result(
+                    [o[sl].copy() if o.ndim and o.shape[0] == _n else o.copy()
+                     for o in np_outs])
+                offset += r.rows
+                record_latency(t_done - r.t_submit)
+        except Exception as e:  # never wedge the queue on a bad batch
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    # ------------------------------------------------------------------- close
+    def close(self, drain=True, timeout=None):
+        """Stop intake; with ``drain`` (default) serve every queued
+        request first, otherwise fail them with ServerClosed. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)

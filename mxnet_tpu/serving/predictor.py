@@ -1,0 +1,444 @@
+"""Predictor — Predict-API parity over the Executor compile caches.
+
+Parity: ``include/mxnet/c_predict_api.h`` + ``src/c_api/c_predict_api.cc``.
+The reference's deploy contract is: load a saved Symbol JSON + params blob,
+bind a forward-only executor, then ``MXPredSetInput`` / ``MXPredForward`` /
+``MXPredGetOutput`` per request — no training stack involved. Here the
+same contract compiles one fused XLA inference executable per **bucketed
+batch size** (and per input shape/dtype signature) through
+``executor.py`` graph binding, with parameters shared across every bucket
+executor — N buckets cost N executables, not N parameter copies.
+
+Inputs land on the bind context; ``group2ctx`` placement flows through to
+the Executor exactly as in training bind (the reference's manual model
+parallelism works on the deploy path too).
+
+Construction sources:
+
+- a Symbol (or its JSON string / ``*.json`` file path — reference-saved
+  ``arg_nodes`` JSON included) plus a params dict / ``*.params`` file
+  (``arg:``/``aux:`` prefixes of ``model.save_checkpoint`` honored);
+- a gluon block via :meth:`Predictor.from_block` (traced symbolically the
+  way ``HybridBlock.export`` does, skipping the filesystem round-trip).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from . import _STATS
+
+__all__ = ["Predictor", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def _declared_buckets(batch_sizes):
+    if batch_sizes is None:
+        env = os.environ.get("MXNET_TPU_SERVING_BUCKETS", "").strip()
+        if env:
+            batch_sizes = [int(x) for x in env.split(",") if x.strip()]
+        else:
+            batch_sizes = DEFAULT_BUCKETS
+    out = tuple(sorted({int(b) for b in batch_sizes}))
+    if not out or out[0] < 1:
+        raise ValueError(f"batch_sizes must be positive ints, got {out}")
+    return out
+
+
+def _as_symbol(symbol):
+    from .. import symbol as sym
+
+    if isinstance(symbol, sym.Symbol):
+        return symbol
+    if isinstance(symbol, str):
+        if symbol.lstrip().startswith("{"):
+            return sym.load_json(symbol)
+        return sym.load(symbol)
+    raise MXNetError(f"Predictor: cannot build a symbol from {type(symbol)}")
+
+
+def _raw(a):
+    return a._data if hasattr(a, "_data") else a
+
+
+class Predictor:
+    """Forward-only model server core.
+
+    Parameters
+    ----------
+    symbol : Symbol | JSON string | path to ``*-symbol.json``
+        Our format and reference-saved (``arg_nodes``) JSON both load.
+    params : dict | path to ``*.params``
+        name -> array. ``arg:``/``aux:`` key prefixes are honored; plain
+        names split by the symbol's argument/auxiliary lists.
+    ctx : Context (default: current context)
+    input_shapes : dict name -> PER-SAMPLE shape (no batch axis)
+        Declares the free inputs. Unlike ``MXPredCreate`` (whose shapes
+        carry a fixed batch dim) the batch axis is managed by the
+        bucketing layer. When omitted, free inputs are discovered as
+        "arguments not present in params" and executors are built lazily
+        from the first batch's actual shapes (no warmup possible).
+    batch_sizes : iterable of declared batch buckets
+        (default env ``MXNET_TPU_SERVING_BUCKETS`` or ``(1,2,4,8,16)``).
+        ``predict`` pads each batch up to the smallest bucket that fits;
+        larger batches compile an exact-size executable.
+    group2ctx : dict group-name -> Context (manual placement, as in bind)
+    warmup : bool — eagerly compile every declared bucket at construction
+        (needs ``input_shapes``). ``warmup_ms`` records the cost.
+    """
+
+    def __init__(self, symbol, params=None, ctx=None, input_shapes=None,
+                 batch_sizes=None, group2ctx=None, warmup=True,
+                 batch_axis=0, dtype=_np.float32):
+        from ..context import current_context
+
+        if batch_axis != 0:
+            raise MXNetError("Predictor: only batch_axis=0 is supported")
+        self._symbol = _as_symbol(symbol)
+        self._ctx = ctx or current_context()
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._buckets = _declared_buckets(batch_sizes)
+        self._dtype = _np.dtype(dtype)
+        self._arg_names = self._symbol.list_arguments()
+        self._aux_names = self._symbol.list_auxiliary_states()
+        self.output_names = self._symbol.list_outputs()
+        self._arg_params, self._aux_params = self._split_params(params)
+        if input_shapes is not None:
+            self.input_names = list(input_shapes)
+            self._input_tails = {n: tuple(s) for n, s in input_shapes.items()}
+        else:
+            self.input_names = [n for n in self._arg_names
+                                if n not in self._arg_params]
+            self._input_tails = None
+        unknown = [n for n in self.input_names if n not in self._arg_names]
+        if unknown:
+            raise MXNetError(f"Predictor: inputs {unknown} are not "
+                             f"arguments of the symbol ({self._arg_names})")
+        self._execs = {}           # (bucket, sig) -> Executor
+        self._lock = threading.Lock()
+        self._pending = {}         # MXPredSetInput state
+        self._outputs = None
+        self.warmup_ms = 0.0
+        if warmup and self._input_tails is not None:
+            t0 = time.perf_counter()
+            self.warmup()
+            self.warmup_ms = (time.perf_counter() - t0) * 1e3
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def from_block(cls, block, input_shapes=None, input_names=("data",),
+                   ctx=None, **kwargs):
+        """Wrap an initialized gluon block (Hybrid or not) without the
+        export-to-disk round trip: trace it symbolically the way
+        ``HybridBlock.export`` does, and take the parameter values straight
+        from ``collect_params()``. Parameters with deferred initialization
+        must be materialized first (run one forward or pass explicit
+        shapes to ``initialize``)."""
+        from .. import symbol as sym
+
+        if input_shapes is not None:
+            input_names = list(input_shapes)
+        out = block(*[sym.var(n) for n in input_names])
+        if isinstance(out, (list, tuple)):
+            out = sym.Group(list(out))
+        params = {}
+        for name, p in block.collect_params().items():
+            params[name] = p.data()
+        return cls(out, params, ctx=ctx, input_shapes=input_shapes, **kwargs)
+
+    def _split_params(self, params):
+        from ..ndarray import ndarray as nd
+        from ..ndarray.ndarray import NDArray
+
+        if params is None:
+            params = {}
+        elif isinstance(params, str):
+            params = nd.load(params)
+        arg_params, aux_params = {}, {}
+        arg_set, aux_set = set(self._arg_names), set(self._aux_names)
+        for key, v in params.items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                dst = arg_params
+            elif kind == "aux":
+                dst = aux_params
+            else:
+                name = key
+                dst = aux_params if key in aux_set else arg_params
+            if name in aux_set and dst is arg_params:
+                dst = aux_params
+            if not isinstance(v, NDArray):
+                v = nd.array(v, ctx=self._ctx)
+            else:
+                v = self._place(v)
+            dst[name] = v
+        extra = [n for n in arg_params if n not in arg_set]
+        extra += [n for n in aux_params if n not in aux_set]
+        if extra:
+            raise MXNetError(f"Predictor: params {extra} are not arguments "
+                             "or auxiliary states of the symbol")
+        return arg_params, aux_params
+
+    def _place(self, v):
+        """Commit an NDArray param to the Predictor's ctx. `nd.load`/
+        `from_block` values arrive on whatever device produced them;
+        mixing their placement with the ctx-committed input cells would
+        make jit raise 'incompatible devices' on the first forward —
+        exactly on the non-CPU deploy path the tests can't cover."""
+        import jax
+
+        tgt = self._ctx.jax_device()
+        try:
+            dev = v._data.device
+            on_ctx = dev is tgt or dev == tgt
+        except Exception:  # tracer / sharded value: leave placement alone
+            return v
+        if on_ctx:
+            return v
+        from ..ndarray.ndarray import NDArray
+
+        return NDArray(jax.device_put(v._data, tgt), self._ctx)
+
+    # ----------------------------------------------------------------- buckets
+    def bucket_for(self, n):
+        """Smallest declared bucket that fits ``n`` rows (``n`` itself —
+        an exact-size executable — beyond the largest declared)."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return n
+
+    def _sig_of(self, feeds):
+        return tuple(sorted((name, tuple(a.shape[1:]), str(a.dtype))
+                            for name, a in feeds.items()))
+
+    def _default_sig(self, dtype=None):
+        dt = str(_np.dtype(dtype or self._dtype))
+        return tuple(sorted((n, tuple(t), dt)
+                            for n, t in self._input_tails.items()))
+
+    def _executor_for(self, bucket, sig):
+        key = (bucket, sig)
+        ex = self._execs.get(key)
+        if ex is not None:
+            _STATS["serving_bucket_hits"] += 1
+            return ex
+        with self._lock:
+            ex = self._execs.get(key)
+            if ex is not None:
+                _STATS["serving_bucket_hits"] += 1
+                return ex
+            _STATS["serving_bucket_misses"] += 1
+            ex = self._build_executor(bucket, sig)
+            self._execs[key] = ex
+            return ex
+
+    def _build_executor(self, bucket, sig):
+        """Bind one forward-only Executor for this bucket: parameters are
+        the SHARED NDArray cells (every bucket reuses the same buffers);
+        inputs and label-like unfed arguments are fresh zero cells of the
+        bucketed shape. The jitted forward compiles lazily on the first
+        batch (warmup() forces it)."""
+        from ..executor import _alloc_for_name
+        from ..ndarray.ndarray import zeros as nd_zeros
+
+        known = {n: tuple(v.shape) for n, v in self._arg_params.items()}
+        known.update({n: tuple(v.shape) for n, v in self._aux_params.items()})
+        input_shapes = {}
+        for name, tail, dt in sig:
+            input_shapes[name] = (bucket,) + tuple(tail)
+        known.update(input_shapes)
+        arg_shapes, _, aux_shapes = self._symbol._infer_shape_impl(
+            partial=True, **known)
+        arg_dict = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            if name in self._arg_params:
+                arg_dict[name] = self._arg_params[name]
+            elif name in input_shapes:
+                arg_dict[name] = nd_zeros(input_shapes[name], self._ctx,
+                                          self._dtype)
+            else:
+                # unfed argument: zero-filling is the c_predict_api
+                # contract for LABEL inputs of a retained training head
+                # only — a missing WEIGHT must be a hard error, or a
+                # truncated/misnamed params file silently serves garbage
+                if not name.endswith("label"):
+                    raise MXNetError(
+                        f"Predictor: argument '{name}' is missing from "
+                        "params and is not a declared input (only "
+                        "*_label arguments are auto-zero-filled)")
+                if shape is None:
+                    raise MXNetError(
+                        f"Predictor: label argument '{name}' has no "
+                        "inferable shape — pass it via input_shapes")
+                arg_dict[name] = nd_zeros(shape, self._ctx, self._dtype)
+        aux_dict = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            if name in self._aux_params:
+                aux_dict[name] = self._aux_params[name]
+            elif name.endswith("rng_key"):
+                # auto-created dropout keys are never saved; everything
+                # else (BatchNorm moving stats!) default-initialized
+                # would silently serve garbage, like a missing weight
+                aux_dict[name] = _alloc_for_name(name, shape or (2,),
+                                                 self._ctx)
+            else:
+                raise MXNetError(
+                    f"Predictor: auxiliary state '{name}' is missing "
+                    "from params")
+        _STATS["serving_compiles"] += 1
+        if bucket not in self._buckets:
+            _STATS["serving_unbucketed"] += 1
+        return self._symbol.bind(self._ctx, arg_dict, grad_req="null",
+                                 aux_states=aux_dict,
+                                 group2ctx=self._group2ctx)
+
+    def warmup(self, buckets=None, dtype=None):
+        """Compile (bind + trace + XLA-compile) every declared bucket now,
+        so the first real request never pays compilation latency — the
+        eager analogue of the reference's bind-at-create. Requires
+        declared ``input_shapes``."""
+        if self._input_tails is None:
+            raise MXNetError("Predictor.warmup needs input_shapes")
+        import jax.numpy as jnp
+
+        sig = self._default_sig(dtype)
+        for b in (buckets or self._buckets):
+            ex = self._executor_for(int(b), sig)
+            feeds = {name: jnp.zeros((int(b),) + tuple(tail),
+                                     _np.dtype(dt))
+                     for name, tail, dt in sig}
+            outs = ex.forward_batch(feeds, raw=True)
+            for o in outs:
+                o.block_until_ready()
+        return self
+
+    # ----------------------------------------------------------------- running
+    @staticmethod
+    def _is_std_float(dtype):
+        try:
+            return _np.issubdtype(_np.dtype(str(dtype)), _np.floating)
+        except TypeError:  # extension dtype (bfloat16 et al.)
+            return False
+
+    def _coerce_feeds(self, data):
+        """data: array | dict name->array -> dict name->raw array."""
+        if not isinstance(data, dict):
+            if len(self.input_names) != 1:
+                raise MXNetError(
+                    f"Predictor has inputs {self.input_names}; pass a dict")
+            data = {self.input_names[0]: data}
+        feeds = {}
+        n = None
+        for name, a in data.items():
+            if name not in self.input_names:
+                raise MXNetError(f"unknown input '{name}' "
+                                 f"(declared: {self.input_names})")
+            a = _raw(a)
+            if not hasattr(a, "shape"):
+                a = _np.asarray(a, self._dtype)
+            elif a.dtype != self._dtype and self._is_std_float(a.dtype):
+                # normalize float inputs to the declared dtype: a client's
+                # float64 numpy array would otherwise sail past every
+                # warmed bucket (dtype is part of the executor signature)
+                # and compile a parallel float64 executor set at serve
+                # time. Integer/bool inputs (embedding ids) and extension
+                # dtypes a caller chose deliberately (bf16) pass through.
+                a = a.astype(self._dtype)
+            if a.ndim == 0:
+                raise MXNetError(f"input '{name}' must have a batch axis")
+            rows = a.shape[0]
+            if n is None:
+                n = rows
+            elif rows != n:
+                raise MXNetError(f"input '{name}' has {rows} rows, "
+                                 f"expected {n}")
+            feeds[name] = a
+        missing = [m for m in self.input_names if m not in feeds]
+        if missing:
+            raise MXNetError(f"missing inputs {missing}")
+        return feeds, n
+
+    def _pad(self, a, bucket):
+        n = a.shape[0]
+        if n == bucket:
+            return a
+        if isinstance(a, _np.ndarray):
+            pad = _np.zeros((bucket - n,) + a.shape[1:], a.dtype)
+            return _np.concatenate([a, pad], axis=0)
+        import jax.numpy as jnp
+
+        pad = jnp.zeros((bucket - n,) + tuple(a.shape[1:]), a.dtype)
+        return jnp.concatenate([a, pad], axis=0)
+
+    def predict_raw(self, data):
+        """Run one batch; returns (list of raw jax arrays, n_rows). The
+        batch is padded up to its bucket and outputs are sliced back to
+        the true row count, so callers see exactly their rows."""
+        feeds, n = self._coerce_feeds(data)
+        if n == 0:
+            raise MXNetError("Predictor: empty batch")
+        _STATS["serving_predict_calls"] += 1
+        bucket = self.bucket_for(n)
+        feeds = _faults.maybe_nan_batch(feeds)
+        padded = {name: self._pad(a, bucket) for name, a in feeds.items()}
+        ex = self._executor_for(bucket, self._sig_of(padded))
+        outs = ex.forward_batch(padded, raw=True)
+        _STATS["serving_batch_samples"] += bucket
+        _STATS["serving_padded_samples"] += bucket - n
+        if bucket != n:
+            outs = [o[:n] if o.ndim and o.shape[0] == bucket else o
+                    for o in outs]
+        return outs, n
+
+    def predict(self, data):
+        """Functional inference: ``data`` is one batch (array, or dict
+        name -> array for multi-input graphs). Returns the list of output
+        NDArrays, batch-sliced to the input's row count."""
+        from ..ndarray.ndarray import NDArray
+
+        outs, _ = self.predict_raw(data)
+        return [NDArray(o, self._ctx) for o in outs]
+
+    # --------------------------------------------------- MXPred parity surface
+    def set_input(self, name, array):
+        """``MXPredSetInput``: stage one named input for ``forward()``."""
+        if name not in self.input_names:
+            raise MXNetError(f"unknown input '{name}' "
+                             f"(declared: {self.input_names})")
+        self._pending[name] = array
+
+    def forward(self):
+        """``MXPredForward``: run the staged inputs through the compiled
+        executable for their bucket."""
+        if not self._pending:
+            raise MXNetError("Predictor.forward: no inputs staged "
+                             "(call set_input first)")
+        self._outputs = self.predict(dict(self._pending))
+        return self._outputs
+
+    def get_output(self, index=0):
+        """``MXPredGetOutput``: fetch output ``index`` of the last
+        ``forward()`` as an NDArray."""
+        if self._outputs is None:
+            raise MXNetError("Predictor.get_output before forward()")
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self):
+        return len(self.output_names)
+
+    @property
+    def buckets(self):
+        return self._buckets
+
+    @property
+    def compiled_buckets(self):
+        """Batch sizes with a live executor (cache introspection)."""
+        return sorted({b for (b, _sig) in self._execs})

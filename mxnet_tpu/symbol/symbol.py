@@ -515,6 +515,29 @@ def _rnn_hook(in_shapes, p):
     return hints
 
 
+def _softmax_output_hook(in_shapes, p):
+    # label shape from data shape (reference SoftmaxOutputShape,
+    # softmax_output.cc): (N,) default, (N, d1...) for multi_output over
+    # the channel axis, data.shape[:-1] under preserve_shape. Lets deploy
+    # graphs that kept their training head bind without an explicit
+    # label shape (the c_predict_api contract).
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    if p.get("multi_output"):
+        return {1: (data[0],) + tuple(data[2:])}
+    if p.get("preserve_shape"):
+        return {1: tuple(data[:-1])}
+    return {1: (data[0],)}
+
+
+def _regression_output_hook(in_shapes, p):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    return {1: tuple(data)}
+
+
 _PARAM_SHAPE_HOOKS = {
     "FullyConnected": _fc_hook,
     "Convolution": _conv_hook,
@@ -525,6 +548,10 @@ _PARAM_SHAPE_HOOKS = {
     "InstanceNorm": _groupnorm_hook,
     "Embedding": _embedding_hook,
     "RNN": _rnn_hook,
+    "SoftmaxOutput": _softmax_output_hook,
+    "LinearRegressionOutput": _regression_output_hook,
+    "LogisticRegressionOutput": _regression_output_hook,
+    "MAERegressionOutput": _regression_output_hook,
 }
 
 # ops whose primary output shape equals their primary input shape; a known
@@ -669,8 +696,72 @@ def load(fname):
         return load_json(f.read())
 
 
+def _parse_ref_attr(value):
+    """One reference-JSON attr string -> Python value. The reference
+    serializes every op param as a string ("64", "(3, 3)", "True",
+    "relu"); literal forms parse, everything else stays a string."""
+    import ast
+
+    if not isinstance(value, str):
+        return tuple(value) if isinstance(value, list) else value
+    try:
+        v = ast.literal_eval(value)
+    except (ValueError, SyntaxError):
+        return value
+    return tuple(v) if isinstance(v, list) else v
+
+
+def _node_attr_dict(jn):
+    """Per-node attribute dict across reference vintages: 1.x "attrs",
+    0.x "attr"/"param"."""
+    for key in ("attrs", "attr", "param"):
+        if jn.get(key):
+            return jn[key]
+    return {}
+
+
+def _entry(e):
+    """Graph entry [node_id, out_index(, version)] -> (id, index)."""
+    return (e[0], e[1] if len(e) > 1 else 0)
+
+
+def _load_reference_json(data):
+    """Import a reference-saved Symbol JSON (python/mxnet symbol.save /
+    nnvm::Graph SaveJSON: "arg_nodes" + "node_row_ptr" + stringly-typed
+    attrs). Auxiliary states are not tagged in the reference format —
+    they are recovered from the op registry's mutate slots, the same
+    declaration the creator path uses."""
+    nodes = []
+    for jn in data["nodes"]:
+        attrs = {k: _parse_ref_attr(v) for k, v in _node_attr_dict(jn).items()}
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"],
+                         attrs={k: v for k, v in attrs.items()
+                                if k.startswith("__")})
+        else:
+            params = {k: v for k, v in attrs.items()
+                      if not k.startswith("__")}
+            node = _Node(jn["op"], jn["name"], params=params,
+                         attrs={k: v for k, v in attrs.items()
+                                if k.startswith("__")})
+        node.inputs = [(nodes[i], s) for i, s in map(_entry, jn["inputs"])]
+        nodes.append(node)
+    for n in nodes:
+        if n.is_var:
+            continue
+        op = _registry.get_op(n.op)
+        for slot in op.mutate_slots(op.normalize(n.params)):
+            if slot < len(n.inputs):
+                tgt, _ = n.inputs[slot]
+                if tgt.is_var:
+                    tgt.aux_mark = True
+    return Symbol([(nodes[i], s) for i, s in map(_entry, data["heads"])])
+
+
 def load_json(json_str):
     data = json.loads(json_str)
+    if "arg_nodes" in data or "node_row_ptr" in data:
+        return _load_reference_json(data)
     nodes = []
     for jn in data["nodes"]:
         params = {k: json.loads(v) for k, v in jn.get("attrs", {}).items()}

@@ -1,0 +1,517 @@
+"""Serving runtime: Predictor (Predict-API parity) + BatchServer.
+
+Covers the ISSUE 3 acceptance surface: reference-saved Symbol JSON
+fixtures load end-to-end, Predictor output equals Module.predict / gluon
+forward numerically EXACTLY, the bucketed compile cache behaves (counter
+assertions), BatchServer under heavy thread concurrency returns bitwise
+the same bytes as unbatched Predictor calls, deadline/overload shedding,
+drain-on-close, and the fault-injected NaN batch tripping the
+HealthSentinel policy without wedging the queue.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.io.io import DataBatch
+from mxnet_tpu.resilience import HealthSentinel, NumericHealthError, faults
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+FIXTURES = {
+    "mlp": {"file": "mlp-symbol.json", "data": (20,),
+            "label": "softmax_label"},
+    "convnet": {"file": "convnet-symbol.json", "data": (1, 8, 8),
+                "label": None},
+    "mlp-bn": {"file": "mlp-bn-symbol.json", "data": (20,), "label": None},
+}
+
+
+def _load_fixture(name):
+    return mx.sym.load(os.path.join(DATA_DIR, FIXTURES[name]["file"]))
+
+
+def _make_params(sym, data_shape, seed=0):
+    """Random-but-fixed parameter dicts for a fixture symbol, shapes
+    recovered through the hooks-based partial shape inference."""
+    arg_shapes, _, aux_shapes = sym._infer_shape_impl(
+        partial=True, data=data_shape)
+    rng = np.random.RandomState(seed)
+    args, auxs = {}, {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n == "data" or n.endswith("label"):
+            continue
+        assert s is not None, f"shape of {n} not inferred"
+        args[n] = (rng.randn(*s) * 0.1).astype(np.float32)
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        assert s is not None, f"shape of aux {n} not inferred"
+        if n.endswith("var"):
+            auxs[n] = (np.abs(rng.randn(*s)) + 0.5).astype(np.float32)
+        else:
+            auxs[n] = (rng.randn(*s) * 0.1).astype(np.float32)
+    return args, auxs
+
+
+def _mlp_predictor(batch_sizes=(16,), warmup=True, seed=0, **kwargs):
+    sym = _load_fixture("mlp")
+    args, _ = _make_params(sym, (1, 20), seed=seed)
+    pred = serving.Predictor(sym, args, input_shapes={"data": (20,)},
+                             batch_sizes=batch_sizes, warmup=warmup,
+                             **kwargs)
+    return pred
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def test_reference_json_fixtures_load():
+    mlp = _load_fixture("mlp")
+    assert mlp.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert mlp.list_outputs() == ["softmax_output"]
+
+    conv = _load_fixture("convnet")
+    assert "conv1_weight" in conv.list_arguments()
+    assert conv.list_outputs() == ["prob_output"]
+
+    bn = _load_fixture("mlp-bn")
+    # reference JSON carries no aux tags: moving stats must be recovered
+    # from the op registry's mutate slots
+    assert bn.list_auxiliary_states() == ["bn1_moving_mean",
+                                          "bn1_moving_var"]
+    assert "bn1_moving_mean" not in bn.list_arguments()
+
+
+def test_reference_attr_strings_parse():
+    conv = _load_fixture("convnet")
+    node = next(n for n in conv._topo_nodes() if n.op == "Convolution")
+    assert node.params["kernel"] == (3, 3)
+    assert node.params["num_filter"] == 8
+    bn = _load_fixture("mlp-bn")
+    node = next(n for n in bn._topo_nodes() if n.op == "BatchNorm")
+    assert node.params["fix_gamma"] is False
+    assert node.params["eps"] == pytest.approx(1e-3)
+
+
+@pytest.mark.parametrize("name", list(FIXTURES))
+def test_predictor_matches_module(name):
+    """Predict-API outputs must be numerically identical to the training
+    stack's Module.predict for the same params on every fixture."""
+    fx = FIXTURES[name]
+    sym = _load_fixture(name)
+    n = 5
+    data_shape = (n,) + fx["data"]
+    args, auxs = _make_params(sym, (1,) + fx["data"])
+    rng = np.random.RandomState(7)
+    x = rng.rand(*data_shape).astype(np.float32)
+
+    pred = serving.Predictor(sym, {**args, **auxs},
+                             input_shapes={"data": fx["data"]},
+                             batch_sizes=(n,), warmup=False)
+    got = pred.predict(x)
+
+    label_names = (fx["label"],) if fx["label"] else ()
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=label_names,
+                        context=mx.cpu())
+    label_shapes = [(fx["label"], (n,))] if fx["label"] else None
+    mod.bind(data_shapes=[("data", data_shape)], label_shapes=label_shapes,
+             for_training=False)
+    mod.init_params(
+        arg_params={k: mx.nd.array(v) for k, v in args.items()},
+        aux_params={k: mx.nd.array(v) for k, v in auxs.items()})
+    labels = [mx.nd.zeros((n,))] if fx["label"] else []
+    mod.forward(DataBatch(data=[mx.nd.array(x)], label=labels),
+                is_train=False)
+    refs = mod.get_outputs()
+    assert len(got) == len(refs)
+    for g, r in zip(got, refs):
+        np.testing.assert_array_equal(g.asnumpy(), r.asnumpy())
+
+
+def test_predictor_from_gluon_block():
+    net = mx.gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(32, activation="relu"))
+        net.add(mx.gluon.nn.Dense(4))
+    net.initialize()
+    x = np.random.RandomState(3).rand(6, 12).astype(np.float32)
+    net(mx.nd.array(x))  # materialize deferred shapes
+    pred = serving.Predictor.from_block(net, input_shapes={"data": (12,)},
+                                        batch_sizes=(6,), warmup=True)
+    got = pred.predict(x)[0].asnumpy()
+    ref = net(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_predictor_accepts_json_string_and_params_file(tmp_path):
+    sym = _load_fixture("mlp")
+    args, _ = _make_params(sym, (1, 20))
+    pfile = str(tmp_path / "model.params")
+    mx.nd.save(pfile, {f"arg:{k}": mx.nd.array(v) for k, v in args.items()})
+    with open(os.path.join(DATA_DIR, "mlp-symbol.json")) as f:
+        json_str = f.read()
+    pred = serving.Predictor(json_str, pfile, input_shapes={"data": (20,)},
+                             batch_sizes=(2,), warmup=False)
+    x = np.random.RandomState(1).rand(2, 20).astype(np.float32)
+    ref = serving.Predictor(sym, args, input_shapes={"data": (20,)},
+                            batch_sizes=(2,), warmup=False).predict(x)
+    np.testing.assert_array_equal(pred.predict(x)[0].asnumpy(),
+                                  ref[0].asnumpy())
+
+
+def test_mxpred_parity_surface():
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=False)
+    x = np.random.RandomState(2).rand(3, 20).astype(np.float32)
+    pred.set_input("data", x)
+    outs = pred.forward()
+    assert outs[0].shape == (3, 10)
+    np.testing.assert_array_equal(pred.get_output(0).asnumpy(),
+                                  pred.predict(x)[0].asnumpy())
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("nope", x)
+    fresh = _mlp_predictor(batch_sizes=(4,), warmup=False)
+    with pytest.raises(mx.MXNetError):
+        fresh.get_output(0)
+
+
+def test_missing_weight_is_an_error_not_zeros():
+    """Only *_label arguments are auto-zero-filled; a weight absent from
+    the params dict (truncated/misnamed file) must fail loudly instead of
+    silently serving garbage."""
+    sym = _load_fixture("mlp")
+    args, _ = _make_params(sym, (1, 20))
+    del args["fc2_weight"]
+    pred = serving.Predictor(sym, args, input_shapes={"data": (20,)},
+                             batch_sizes=(2,), warmup=False)
+    with pytest.raises(mx.MXNetError, match="fc2_weight"):
+        pred.predict(np.zeros((1, 20), np.float32))
+    with pytest.raises(mx.MXNetError, match="auxiliary"):
+        serving.Predictor(_load_fixture("mlp-bn"),
+                          {"aux:bn1_moving_meen": np.zeros(32, np.float32)},
+                          input_shapes={"data": (20,)}, warmup=False)
+
+
+def test_missing_aux_state_is_an_error():
+    """BatchNorm moving stats absent from params must fail loudly, not
+    bind default-initialized stats (only auto-created rng keys may)."""
+    sym = _load_fixture("mlp-bn")
+    args, auxs = _make_params(sym, (1, 20))
+    pred = serving.Predictor(sym, args,  # no aux at all
+                             input_shapes={"data": (20,)},
+                             batch_sizes=(2,), warmup=False)
+    with pytest.raises(mx.MXNetError, match="bn1_moving"):
+        pred.predict(np.zeros((1, 20), np.float32))
+
+
+def test_float64_inputs_normalized_to_declared_dtype():
+    """A client's default-float64 numpy batch must land on the warmed
+    float32 bucket executors, not compile a parallel float64 set."""
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=True)
+    n_compiles = serving.stats()["serving_compiles"]
+    x64 = np.random.RandomState(14).rand(3, 20)  # float64
+    out = pred.predict(x64)
+    assert serving.stats()["serving_compiles"] == n_compiles  # cache hit
+    np.testing.assert_array_equal(
+        out[0].asnumpy(),
+        pred.predict(x64.astype(np.float32))[0].asnumpy())
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_bucket_cache_and_compile_counters():
+    from mxnet_tpu import profiler
+
+    pred = _mlp_predictor(batch_sizes=(2, 8), warmup=False)
+    profiler.reset_dispatch_stats()
+    rng = np.random.RandomState(4)
+
+    def run(n):
+        return pred.predict(rng.rand(n, 20).astype(np.float32))
+
+    run(1)  # -> bucket 2, compile
+    s = serving.stats()
+    assert s["serving_bucket_misses"] == 1 and s["serving_compiles"] == 1
+    run(2)  # -> bucket 2, cached
+    s = serving.stats()
+    assert s["serving_bucket_hits"] == 1 and s["serving_compiles"] == 1
+    run(5)  # -> bucket 8, compile
+    s = serving.stats()
+    assert s["serving_compiles"] == 2
+    assert s["serving_batch_samples"] == 2 + 2 + 8
+    assert s["serving_padded_samples"] == 1 + 0 + 3
+    run(11)  # beyond the largest bucket: exact-size executable
+    s = serving.stats()
+    assert s["serving_unbucketed"] == 1 and s["serving_compiles"] == 3
+    assert pred.compiled_buckets == [2, 8, 11]
+    assert pred.bucket_for(2) == 2 and pred.bucket_for(3) == 8
+
+
+def test_warmup_precompiles_declared_buckets():
+    pred = _mlp_predictor(batch_sizes=(1, 4), warmup=True)
+    assert pred.compiled_buckets == [1, 4]
+    assert pred.warmup_ms > 0
+    before = serving.stats()["serving_compiles"]
+    pred.predict(np.zeros((3, 20), np.float32))  # bucket 4: no new compile
+    assert serving.stats()["serving_compiles"] == before
+
+
+def test_group2ctx_flows_through_bind():
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="stage1"):
+        h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="stage2"):
+        out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    args, _ = _make_params(out, (1, 8))
+    x = np.random.RandomState(5).rand(2, 8).astype(np.float32)
+    plain = serving.Predictor(out, args, input_shapes={"data": (8,)},
+                              batch_sizes=(2,), warmup=False).predict(x)
+    placed = serving.Predictor(
+        out, args, input_shapes={"data": (8,)}, batch_sizes=(2,),
+        warmup=False,
+        group2ctx={"stage1": mx.cpu(), "stage2": mx.cpu()}).predict(x)
+    np.testing.assert_allclose(placed[0].asnumpy(), plain[0].asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------------------- BatchServer
+
+
+def test_batch_server_bitwise_under_concurrency():
+    """8 threads x 6 requests: every future must resolve to EXACTLY the
+    bytes an unbatched Predictor call produces, despite coalescing and
+    padding (single declared bucket => single executable shape => row
+    results are position-independent)."""
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    rng = np.random.RandomState(6)
+    xs = [rng.rand(1 + (i % 3), 20).astype(np.float32) for i in range(48)]
+    serving.reset_stats()
+    results = [None] * len(xs)
+    barrier = threading.Barrier(8)
+
+    with serving.BatchServer(pred, max_batch_size=16,
+                             batch_timeout_ms=2.0) as srv:
+        def client(tid):
+            barrier.wait()
+            futs = [(i, srv.submit(xs[i]))
+                    for i in range(tid, len(xs), 8)]
+            for i, f in futs:
+                results[i] = f.result(timeout=30)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    s = serving.stats()
+    assert s["serving_requests"] == len(xs)
+    assert s["serving_batches"] >= 1
+    # coalescing actually happened (48 requests in fewer launches)
+    assert s["serving_batches"] < len(xs)
+    assert s["serving_p99_latency_us"] > 0
+    for i, x in enumerate(xs):
+        direct = pred.predict(x)
+        assert len(results[i]) == len(direct)
+        for got, ref in zip(results[i], direct):
+            assert got.shape[0] == x.shape[0]
+            np.testing.assert_array_equal(got, ref.asnumpy())
+
+
+def test_deadline_shedding():
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=True)
+    serving.reset_stats()
+    # worker waits 100ms for the batch to fill; the deadline (1ms) passes
+    # while queued -> the request is failed, never executed
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=100.0) as srv:
+        fut = srv.submit(np.zeros((1, 20), np.float32), deadline_ms=1.0)
+        with pytest.raises(serving.DeadlineExceeded):
+            fut.result(timeout=10)
+    assert serving.stats()["serving_shed_deadline"] == 1
+
+
+def test_expired_requests_pruned_from_coalescing():
+    """A request whose deadline passes while queued is shed promptly and
+    never rides along in a popped batch or counts toward the size
+    trigger; live requests behind it are still served."""
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=True)
+    serving.reset_stats()
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=200.0) as srv:
+        dead = srv.submit(np.zeros((1, 20), np.float32), deadline_ms=1.0)
+        live = srv.submit(np.ones((1, 20), np.float32))
+        t0 = time.perf_counter()
+        with pytest.raises(serving.DeadlineExceeded):
+            dead.result(timeout=10)
+        # shed at its deadline, well before the 200ms flush trigger
+        assert time.perf_counter() - t0 < 0.15
+        assert live.result(timeout=10)[0].shape == (1, 10)
+    assert serving.stats()["serving_shed_deadline"] == 1
+
+
+def test_submit_snapshots_caller_buffers():
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    x = np.random.RandomState(13).rand(1, 20).astype(np.float32)
+    want = pred.predict(x.copy())[0].asnumpy()
+    with serving.BatchServer(pred, max_batch_size=16,
+                             batch_timeout_ms=50.0) as srv:
+        fut = srv.submit(x)
+        x[:] = -1.0  # caller reuses its buffer right after submit
+        np.testing.assert_array_equal(fut.result(timeout=10)[0], want)
+
+
+def test_overload_shedding_reject_new():
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    serving.reset_stats()
+    srv = serving.BatchServer(pred, max_batch_size=16,
+                              batch_timeout_ms=500.0, max_queue_depth=2,
+                              shed_policy="reject_new")
+    x = np.zeros((1, 20), np.float32)
+    f1, f2 = srv.submit(x), srv.submit(x)
+    f3 = srv.submit(x)  # over the high-water mark
+    with pytest.raises(serving.ServerOverloaded):
+        f3.result(timeout=10)
+    srv.close(drain=True)
+    assert f1.result(timeout=10) and f2.result(timeout=10)
+    assert serving.stats()["serving_shed_overload"] == 1
+
+
+def test_overload_shedding_reject_oldest():
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    srv = serving.BatchServer(pred, max_batch_size=16,
+                              batch_timeout_ms=500.0, max_queue_depth=2,
+                              shed_policy="reject_oldest")
+    x = np.zeros((1, 20), np.float32)
+    f1, f2 = srv.submit(x), srv.submit(x)
+    f3 = srv.submit(x)  # sheds f1 in its favor
+    with pytest.raises(serving.ServerOverloaded):
+        f1.result(timeout=10)
+    srv.close(drain=True)
+    assert f2.result(timeout=10) and f3.result(timeout=10)
+
+
+def test_drain_on_close():
+    pred = _mlp_predictor(batch_sizes=(8,), warmup=True)
+    srv = serving.BatchServer(pred, max_batch_size=8,
+                              batch_timeout_ms=250.0)
+    x = np.random.RandomState(8).rand(1, 20).astype(np.float32)
+    futs = [srv.submit(x) for _ in range(10)]
+    srv.close(drain=True)  # flushes the queue before the timeout trigger
+    for f in futs:
+        assert f.result(timeout=10)[0].shape == (1, 10)
+    with pytest.raises(serving.ServerClosed):
+        srv.submit(x)
+
+
+def test_close_without_drain_fails_pending():
+    pred = _mlp_predictor(batch_sizes=(8,), warmup=True)
+    srv = serving.BatchServer(pred, max_batch_size=8,
+                              batch_timeout_ms=10000.0)
+    futs = [srv.submit(np.zeros((1, 20), np.float32)) for _ in range(3)]
+    srv.close(drain=False)
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=10)
+        except serving.ServerClosed:
+            failed += 1
+    # the worker may already have started the first batch; everything
+    # still queued must be failed, nothing may hang
+    assert failed >= 1
+
+
+def test_request_validation():
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=False)
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=1.0) as srv:
+        with pytest.raises(mx.MXNetError):
+            srv.submit(np.zeros((5, 20), np.float32))  # > max_batch_size
+        with pytest.raises(mx.MXNetError):
+            srv.submit({"nope": np.zeros((1, 20), np.float32)})
+
+
+# -------------------------------------------------- resilience integration
+
+
+def test_nan_batch_trips_sentinel_without_wedging():
+    """A faults.py-poisoned batch must fail ONLY its own requests with
+    NumericHealthError (policy skip_batch) and the server must keep
+    serving afterwards — the queue never wedges."""
+    from mxnet_tpu import profiler
+
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    profiler.reset_dispatch_stats()
+    x = np.random.RandomState(9).rand(1, 20).astype(np.float32)
+    with serving.BatchServer(pred, max_batch_size=16,
+                             batch_timeout_ms=2.0) as srv:
+        assert srv.sentinel.policy == "skip_batch"
+        with faults.inject("nan_serving", times=1) as fault:
+            f1 = srv.submit(x)
+            with pytest.raises(NumericHealthError):
+                f1.result(timeout=30)
+            assert fault.fired == 1
+        # fault disarmed: the very next request is served normally
+        f2 = srv.submit(x)
+        np.testing.assert_array_equal(f2.result(timeout=30)[0],
+                                      pred.predict(x)[0].asnumpy())
+    stats = profiler.dispatch_stats()
+    assert stats["serving_poisoned_batches"] == 1
+    assert stats["sentinel_nonfinite"] >= 1
+    # poisoned INFERENCE batches must not inflate the training-step
+    # health series (shared with AMP overflow skips)
+    assert stats["health_skipped_steps"] == 0
+
+
+def test_nan_batch_raise_policy_does_not_wedge():
+    pred = _mlp_predictor(batch_sizes=(16,), warmup=True)
+    x = np.random.RandomState(10).rand(1, 20).astype(np.float32)
+    sentinel = HealthSentinel(policy="raise")
+    with serving.BatchServer(pred, max_batch_size=16, batch_timeout_ms=2.0,
+                             sentinel=sentinel) as srv:
+        with faults.inject("nan_serving", times=1):
+            with pytest.raises(NumericHealthError):
+                srv.submit(x).result(timeout=30)
+        ok = srv.submit(x).result(timeout=30)
+        assert ok[0].shape == (1, 10)
+
+
+def test_serving_counters_in_profiler_dumps():
+    pred = _mlp_predictor(batch_sizes=(2,), warmup=False)
+    pred.predict(np.zeros((1, 20), np.float32))
+    from mxnet_tpu import profiler
+
+    text = profiler.dumps()
+    assert "serving_predict_calls" in text
+    assert "serving_p99_latency_us" in text
+
+
+# ------------------------------------------------------------------ perf
+
+
+@pytest.mark.slow
+def test_batched_throughput_at_least_3x_single():
+    """Acceptance: batch-16 throughput >= 3x single-request throughput on
+    idle CPU (dispatch amortization)."""
+    pred = _mlp_predictor(batch_sizes=(1, 16), warmup=True)
+    x1 = np.random.RandomState(11).rand(1, 20).astype(np.float32)
+    x16 = np.random.RandomState(12).rand(16, 20).astype(np.float32)
+
+    def rate(x, iters):
+        pred.predict(x)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = pred.predict(x)
+        out[0].asnumpy()
+        return iters * x.shape[0] / (time.perf_counter() - t0)
+
+    single = rate(x1, 300)
+    batched = rate(x16, 300)
+    assert batched >= 3 * single, (single, batched)

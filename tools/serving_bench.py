@@ -1,0 +1,170 @@
+"""Microbenchmark: serving runtime (Predictor buckets + BatchServer).
+
+Prints ONE JSON line (same convention as dispatch_bench.py /
+resilience_bench.py) so BENCH rounds can track the inference path:
+
+    {"metric": "serving_samples_per_s_b16", "value": ..., "unit":
+     "samples/s", "vs_baseline": <batch16 vs single-request speedup>,
+     "extra": {...}}
+
+Sections (details on stderr):
+- single:  Predictor batch-1 throughput (the unbatched floor)
+- batched: Predictor batch-16 throughput (acceptance: >= 3x single)
+- server:  closed-loop BatchServer sweep at several client concurrencies
+           (throughput, p50/p99 latency, pad-waste %, shed count)
+- overload: tiny queue + many clients, proving load shedding engages
+
+Run: JAX_PLATFORMS=cpu python tools/serving_bench.py [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_predictor(mx, serving, buckets):
+    import numpy as np
+
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    out = mx.sym.softmax(h, name="prob")
+    rng = np.random.RandomState(0)
+    params = {
+        "fc1_weight": (rng.randn(64, 20) * 0.1).astype(np.float32),
+        "fc1_bias": np.zeros(64, np.float32),
+        "fc2_weight": (rng.randn(10, 64) * 0.1).astype(np.float32),
+        "fc2_bias": np.zeros(10, np.float32),
+    }
+    return serving.Predictor(out, params, input_shapes={"data": (20,)},
+                             batch_sizes=buckets, warmup=True)
+
+
+def bench_predict(pred, batch, iters):
+    import numpy as np
+
+    x = np.random.RandomState(1).rand(batch, 20).astype(np.float32)
+    pred.predict(x)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pred.predict(x)
+    out[0].asnumpy()
+    return iters * batch / (time.perf_counter() - t0)
+
+
+def bench_server(mx, serving, pred, clients, per_client, timeout_ms=1.0,
+                 **server_kw):
+    import numpy as np
+
+    serving.reset_stats()
+    xs = np.random.RandomState(2).rand(clients, 1, 20).astype(np.float32)
+    done = []
+    lock = threading.Lock()
+    srv = serving.BatchServer(pred, batch_timeout_ms=timeout_ms, **server_kw)
+    barrier = threading.Barrier(clients + 1)
+
+    def client(tid):
+        barrier.wait()
+        ok = shed = 0
+        for _ in range(per_client):
+            try:
+                srv.submit(xs[tid]).result(timeout=60)
+                ok += 1
+            except Exception:
+                shed += 1
+        with lock:
+            done.append((ok, shed))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    srv.close()
+    stats = serving.stats()
+    served = sum(ok for ok, _ in done)
+    shed = sum(s for _, s in done)
+    pad = stats["serving_padded_samples"]
+    total = max(1, stats["serving_batch_samples"])
+    return {
+        "rps": served / dt,
+        "p50_us": stats["serving_p50_latency_us"],
+        "p99_us": stats["serving_p99_latency_us"],
+        "pad_waste_pct": 100.0 * pad / total,
+        "batches": stats["serving_batches"],
+        "requests": stats["serving_requests"],
+        # client-observed failures; overload/deadline sheds surface to the
+        # client as failed futures, so this is NOT additive with the
+        # serving_shed_* counters
+        "shed": shed,
+        "offered": served + shed,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=1000)
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    pred = _build_predictor(mx, serving, buckets=(1, 16))
+    print(f"warmup: {pred.warmup_ms:.0f} ms for buckets "
+          f"{list(pred.buckets)}", file=sys.stderr)
+
+    single = bench_predict(pred, 1, args.iters)
+    batched = bench_predict(pred, 16, args.iters)
+    speedup = batched / single
+    print(f"predict: single {single:.0f} samples/s | batch16 "
+          f"{batched:.0f} samples/s ({speedup:.2f}x)", file=sys.stderr)
+
+    sweeps = {}
+    for clients in (1, 8, 32):
+        r = bench_server(mx, serving, pred, clients,
+                         per_client=max(20, args.iters // (4 * clients)))
+        sweeps[clients] = r
+        print(f"server c={clients:<3}: {r['rps']:.0f} req/s, "
+              f"p50 {r['p50_us']} us, p99 {r['p99_us']} us, "
+              f"pad waste {r['pad_waste_pct']:.1f}%, "
+              f"{r['batches']} batches / {r['requests']} reqs",
+              file=sys.stderr)
+
+    over = bench_server(mx, serving, pred, 16, per_client=20,
+                        timeout_ms=20.0, max_queue_depth=4,
+                        shed_policy="reject_new")
+    print(f"overload (depth 4): shed {over['shed']} of "
+          f"{over['offered']} offered", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "serving_samples_per_s_b16",
+        "value": round(batched, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(speedup, 2),  # batch16 vs single-request
+        "extra": {
+            "single_samples_per_s": round(single, 1),
+            "batch16_vs_single": round(speedup, 2),
+            "warmup_ms": round(pred.warmup_ms, 1),
+            "server_rps_c8": round(sweeps[8]["rps"], 1),
+            "server_rps_c32": round(sweeps[32]["rps"], 1),
+            "p50_us_c8": sweeps[8]["p50_us"],
+            "p99_us_c8": sweeps[8]["p99_us"],
+            "pad_waste_pct_c8": round(sweeps[8]["pad_waste_pct"], 1),
+            "overload_shed": over["shed"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
