@@ -24,7 +24,22 @@ import numpy as np
 from ... import ndarray as nd
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
-__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn",
+           "stats", "reset_stats"]
+
+# Resilience observability: worker respawns survive the local warning and
+# surface in profiler.dispatch_stats() next to the watchdog/elastic
+# counters, so one call reports every resilience event (docs/resilience.md).
+_STATS = {"dataloader_respawns": 0}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
 
 
 def default_batchify_fn(data):
@@ -281,6 +296,7 @@ class DataLoader:
                         "check the dataset __getitem__ for crashes/OOM, "
                         "or raise max_worker_respawns")
                 respawns[0] += 1
+                _STATS["dataloader_respawns"] += 1
                 workers[workers.index(w)] = spawn()
                 import warnings
 

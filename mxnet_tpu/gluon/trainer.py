@@ -15,6 +15,7 @@ import warnings
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
 from .parameter import Parameter
 from ..ndarray import NDArray
 
@@ -102,16 +103,50 @@ class Trainer:
         """Makes one step of parameter update: allreduce grads then apply
         the optimizer (trainer.py:320). An attached HealthSentinel is
         consulted between the allreduce and the (possibly bulked) update,
-        so an unhealthy batch never reaches the weights."""
+        so an unhealthy batch never reaches the weights. The whole sweep
+        runs under the step watchdog (MXNET_TPU_WATCHDOG_STEP_TIMEOUT):
+        a stall raises StallError — or, with a rollback-policy sentinel
+        attached, resumes from the last good checkpoint instead."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        _faults.maybe_nan_grads(self._params)
-        if self._sentinel is not None \
-                and not self._sentinel.before_update(self):
-            return  # skipped or rolled back per the sentinel policy
-        self._update(ignore_stale_grad)
+        try:
+            with _watchdog.guard(
+                    "step", detail="gluon.Trainer.step",
+                    step=getattr(self._sentinel, "_step", None)):
+                _faults.maybe_hang("hang_step")
+                self._allreduce_grads()
+                _faults.maybe_nan_grads(self._params)
+                if self._sentinel is not None \
+                        and not self._sentinel.before_update(self):
+                    return  # skipped or rolled back per the sentinel policy
+                self._update(ignore_stale_grad)
+        except _watchdog.PeerLostError:
+            raise  # a dead peer won't come back next step: rolling back
+            # and retrying would spin forever; surface the rank instead
+        except _watchdog.StallError as e:
+            if not self._stall_rollback(e):
+                raise
+
+    def _stall_rollback(self, err):
+        """A stalled step can resume from the last good checkpoint when a
+        rollback-policy sentinel (with a CheckpointManager) is attached:
+        restore params+optimizer+RNG+scaler, amend the crash report with
+        the restored manifest, and report the step as skipped. Returns
+        True when the stall was recovered."""
+        s = self._sentinel
+        if s is None or s.policy != "rollback" or s.manager is None:
+            return False
+        manifest = s.manager.restore_latest(net=s._net, trainer=self)
+        if manifest is None:
+            return False
+        _watchdog.note_rollback(err, manifest)
+        import warnings
+
+        warnings.warn(
+            f"training step stalled ({err}); rolled back to checkpoint "
+            f"step {manifest.get('step')} and skipped the step")
+        return True
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -136,11 +171,21 @@ class Trainer:
             "supported. Try setting `update_on_kvstore` to False when " \
             "creating trainer."
         self._optimizer.rescale_grad = self._scale / batch_size
-        _faults.maybe_nan_grads(self._params)
-        if self._sentinel is not None \
-                and not self._sentinel.before_update(self):
-            return
-        self._update(ignore_stale_grad)
+        try:
+            with _watchdog.guard(
+                    "step", detail="gluon.Trainer.update",
+                    step=getattr(self._sentinel, "_step", None)):
+                _faults.maybe_hang("hang_step")
+                _faults.maybe_nan_grads(self._params)
+                if self._sentinel is not None \
+                        and not self._sentinel.before_update(self):
+                    return
+                self._update(ignore_stale_grad)
+        except _watchdog.PeerLostError:
+            raise  # see step(): dead peers are not transient stalls
+        except _watchdog.StallError as e:
+            if not self._stall_rollback(e):
+                raise
 
     def _bulk_size(self):
         """Ops to bulk per lazy segment during _update (0 = eager).

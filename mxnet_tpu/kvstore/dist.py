@@ -19,20 +19,28 @@ Bootstrap env protocol (DMLC names kept for launcher compatibility):
 """
 from __future__ import annotations
 
+import logging
 import os
+import random as _random_mod
 import threading
 import time
 
 import numpy as _np
 
 from ..resilience import faults as _faults
-from .kvstore import KVStoreTPU, _pairs
+from ..resilience import watchdog as _watchdog
+from .kvstore import KVStore, KVStoreTPU, _pairs
 
 __all__ = ["KVStoreDist", "init_distributed", "is_distributed",
            "DistConfigError"]
 
+_log = logging.getLogger("mxnet_tpu.kvstore.dist")
+
 _init_lock = threading.Lock()
 _initialized = False
+
+# Per-process RNG for retry jitter (module-level so tests can seed it).
+_jitter = _random_mod.Random()
 
 
 class DistConfigError(ValueError):
@@ -110,6 +118,12 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None,
       retry count, is what normally bounds startup skew between ranks),
       spaced by exponential backoff starting at ``backoff`` seconds
       (env ``MXNET_TPU_DIST_BACKOFF``, default 1.0, capped at 30).
+      Each delay is jittered uniformly over the upper half of its
+      exponential ceiling, decorrelating the ranks: after a coordinator
+      blip, N workers that failed in the same instant would otherwise
+      all retry in lockstep and thundering-herd the recovering endpoint.
+      Every retry is logged (logger ``mxnet_tpu.kvstore.dist``) with the
+      attempt number, the chosen delay, and the last error.
 
     Non-coordinator ranks first PROBE the coordinator's TCP endpoint
     under this retry/deadline loop and only then enter
@@ -177,8 +191,16 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None,
             attempt += 1
             if attempt > max_retries:
                 break
-            delay = min(backoff * (2 ** (attempt - 1)), 30.0,
+            ceiling = min(backoff * (2 ** (attempt - 1)), 30.0)
+            # jitter over [ceiling/2, ceiling] so ranks decorrelate
+            # instead of hammering the coordinator in lockstep
+            delay = min(_jitter.uniform(ceiling / 2.0, ceiling),
                         max(0.0, deadline - time.monotonic()))
+            _log.warning(
+                "init_distributed: worker %s/%s attempt %d/%d failed "
+                "(%r); next retry in %.2fs",
+                process_id, num_processes, attempt, max_retries + 1,
+                last_err, max(0.0, delay))
             if delay > 0:
                 time.sleep(delay)
         raise TimeoutError(
@@ -266,7 +288,18 @@ class _WorkerRing:
         (returns the replicated result's local device buffer — the
         gradient never round-trips through the host, so on a pod the
         reduction rides ICI end-to-end; the numpy path exists for
-        host-resident values like the barrier's token)."""
+        host-resident values like the barrier's token).
+
+        Runs under the collective watchdog: a peer that died mid-run
+        surfaces as PeerLostError naming the rank, and a reduction that
+        makes no progress within MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT
+        raises StallError instead of blocking the slice forever."""
+        with _watchdog.collective_guard(
+                detail=f"kvstore('dist').allreduce{tuple(arr.shape)}"):
+            _faults.maybe_hang("hang_collective")
+            return self._allreduce(arr)
+
+    def _allreduce(self, arr):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -300,6 +333,15 @@ class KVStoreDist(KVStoreTPU):
         super().__init__(kind)
         init_distributed()
         self._ring = None  # built lazily so single-process use stays cheap
+
+    def push(self, key, value, priority=0):
+        # bypass KVStoreTPU's collective guard: here the real collective
+        # is the worker-ring allreduce inside _global_merge, which owns
+        # the guard — one guard + one hang_collective/peer_death hook
+        # consultation per COLLECTIVE (i.e. per key on a multi-key
+        # push), never a doubled-up wrapper around the same reduction,
+        # keeping the fault harness's step addressing deterministic
+        KVStore.push(self, key, value, priority)
 
     def _get_ring(self):
         if self._ring is None:

@@ -15,6 +15,8 @@ import warnings
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreDevice", "KVStoreTPU", "create"]
 
@@ -242,7 +244,18 @@ class KVStoreTPU(KVStore):
     jax.distributed. The fast path for training is not push/pull at all —
     Trainer/Module lower the gradient sum into the jitted step as a psum
     (see parallel/), exactly as the north star prescribes.
+
+    Every push runs under the collective watchdog
+    (MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT) with peer-liveness
+    bookkeeping: a dead peer surfaces as PeerLostError naming the rank,
+    a wedged reduction as StallError — never an infinite block.
     """
+
+    def push(self, key, value, priority=0):
+        with _watchdog.collective_guard(
+                detail=f"kvstore('{self._kind}').push({key!r})"):
+            _faults.maybe_hang("hang_collective")
+            super().push(key, value, priority)
 
     def _reduce(self, values):
         if len(values) == 1:

@@ -26,8 +26,12 @@ from __future__ import annotations
 
 import functools
 import os as _os
+import time as _time
 
 from ..base import MXNetError
+# stdlib-only at import; holds the last-K dispatch ring the watchdog's
+# crash reports embed (profiler.dispatch_ring)
+from .. import profiler as _profiler
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "invoke", "apply_op",
            "dispatch", "dispatch_stats", "reset_dispatch_stats",
@@ -416,6 +420,11 @@ def dispatch(op, params, arrays, device, is_traced=None):
         _record_call(op, arrays, params)
     if device is None or is_traced:
         return op.closed(params)(*arrays)
+
+    ring = _profiler._DISPATCH_RING
+    if ring is not None:  # last-K forensic trail for crash reports
+        ring.append((next(_profiler._DISPATCH_SEQ),
+                     _time.perf_counter(), op.name))
 
     if _BULK_HOOK is not None:
         out = _BULK_HOOK(op, params, arrays, device)

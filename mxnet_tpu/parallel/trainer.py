@@ -85,6 +85,13 @@ class ShardedTrainer:
         self._multiproc = self._is_multiprocess()
         self._place()
         self._step = None
+        # elastic execution state (resilience.elastic): current sticky
+        # accumulation count, the grad/apply executables it uses, and a
+        # monotonically increasing step counter for crash reports
+        self._elastic_n = 1
+        self._grads_fn = None
+        self._apply_fn = None
+        self._step_count = 0
 
     def _place(self):
         import numpy as np
@@ -157,13 +164,14 @@ class ShardedTrainer:
         return {**{k: repl for k in self.opt_state if k != "state"},
                 "state": state}
 
-    def _build_step(self):
-        import jax
+    def _make_compute_loss(self):
+        """The traced loss closure shared by the fused step and the
+        elastic (grad-accumulation) executables — one definition so both
+        paths compute bitwise-identical gradients."""
         import jax.numpy as jnp
 
         fwd = self._fwd
         loss_fn = self.loss_fn
-        update = self._update
         cdtype = self._compute_dtype
 
         from ..ndarray.ndarray import NDArray
@@ -201,6 +209,14 @@ class ShardedTrainer:
                 sess.note_created(y_nd)
                 loss = loss_fn(out_nd, y_nd)
             return loss.data_.mean(), new_aux
+
+        return compute_loss
+
+    def _build_step(self):
+        import jax
+
+        update = self._update
+        compute_loss = self._make_compute_loss()
 
         def step(params, aux, opt_state, x, y):
             (loss, new_aux), grads = jax.value_and_grad(
@@ -259,6 +275,7 @@ class ShardedTrainer:
                                    dict(self._optimizer_params))
         self._update = update
         self._step = None  # rebuild (and recompile) with the new rate
+        self._grads_fn = self._apply_fn = None  # elastic path too
 
     @property
     def learning_rate(self):
@@ -270,17 +287,30 @@ class ShardedTrainer:
         return any(d.process_index != jax.process_index()
                    for d in self.mesh.devices.flat)
 
-    def step(self, x, y):
+    def step(self, x, y, microbatches=None):
         """Run one sharded training step; returns the scalar loss.
 
         On a multi-process mesh, `x`/`y` are this process's LOCAL shard of
         the global batch (assembled with
         jax.make_array_from_process_local_data); single-process meshes
         take the full batch.
+
+        ``microbatches=N`` executes the step as N accumulated
+        microbatches (one optimizer update). Left at None, the step runs
+        fused — and on ``RESOURCE_EXHAUSTED`` the elastic layer
+        (resilience.elastic) transparently retries with doubling
+        accumulation until it fits; the shrink is sticky for subsequent
+        steps. The whole step runs under the step watchdog
+        (MXNET_TPU_WATCHDOG_STEP_TIMEOUT).
         """
+        import warnings
+
         import jax
 
         from ..ndarray.ndarray import NDArray
+        from ..resilience import elastic as _elastic
+        from ..resilience import faults as _faults
+        from ..resilience import watchdog as _watchdog
 
         if self._step is None:
             self._build_step()
@@ -314,9 +344,161 @@ class ShardedTrainer:
             if not (isinstance(y, jax.Array) and
                     y.sharding.is_equivalent_to(bs, y.ndim)):
                 y = jax.device_put(y, bs)
-        self.params, self.aux, self.opt_state, loss = self._step(
-            self.params, self.aux, self.opt_state, x, y)
+        self._step_count += 1
+        _watchdog.note_step(self._step_count)
+        rows = int(x.shape[0])
+        shards = int(self.mesh.shape.get(self._batch_axis, 1))
+        if microbatches is not None:
+            n = int(microbatches)
+            if n < 1 or rows % n or (rows // n) % max(1, shards):
+                raise ValueError(
+                    f"microbatches={n} does not divide the {rows}-row "
+                    f"batch into whole microbatches splittable over "
+                    f"{shards} dp shard(s); accumulation must never "
+                    "silently drop tail rows")
+        else:
+            # sticky n was validated against the batch size that OOMed;
+            # a different batch (e.g. the epoch's short tail) must fall
+            # back to the largest compatible count, never drop rows
+            n = self._elastic_n
+            while n > 1 and (rows % n or (rows // n) % max(1, shards)):
+                n //= 2
+        while True:
+            try:
+                # one guard per ATTEMPT: a legitimate elastic retry
+                # (recompile + N microbatch launches) gets a fresh
+                # deadline rather than being killed mid-recovery by the
+                # budget the failed fused attempt already spent
+                with _watchdog.guard("step",
+                                     detail="parallel.ShardedTrainer.step",
+                                     step=self._step_count):
+                    _faults.maybe_hang("hang_step")
+                    _faults.maybe_oom_step()
+                    if n <= 1:
+                        self.params, self.aux, self.opt_state, loss = \
+                            self._step(self.params, self.aux,
+                                       self.opt_state, x, y)
+                    else:
+                        loss = self._accum_step(n, x, y)
+                break
+            except Exception as e:
+                if microbatches is not None \
+                        or not (_elastic.enabled()
+                                and _elastic.is_oom_error(e)):
+                    # explicit schedules are the caller's contract —
+                    # elastic retry applies only to the implicit path
+                    raise
+                if self._multiproc:
+                    # microbatch slicing of a non-fully-addressable
+                    # global batch is an eager cross-process op jax
+                    # cannot run; surface the REAL OOM rather than a
+                    # masked addressability error mid-retry
+                    warnings.warn(
+                        "step OOM on a multi-process mesh: elastic "
+                        "microbatch retry is single-process only "
+                        "(docs/resilience.md) — lower the per-host "
+                        "batch or request microbatches= explicitly "
+                        "at a size every process can slice locally")
+                    raise
+                _elastic._STATS["elastic_oom_events"] += 1
+                self._check_state_alive(e)
+                nxt = _elastic.next_microbatches(n, rows, shards)
+                if nxt is None:
+                    raise
+                _elastic._STATS["elastic_shrinks"] += 1
+                warnings.warn(
+                    f"training step OOM at {n} microbatch(es) over a "
+                    f"{rows}-row batch; retrying as {nxt} accumulated "
+                    f"microbatches of {rows // nxt} rows")
+                n = nxt
+        if microbatches is None and n > self._elastic_n:
+            self._elastic_n = n  # sticky: don't re-OOM every step (a
+            # short tail batch's fallback must not discard the shrink)
         return loss
+
+    def _check_state_alive(self, cause):
+        """A fused step donates params/aux/opt_state; if the failure
+        happened after donation invalidated any of them, a retry would
+        compute on deleted buffers. Surface that explicitly instead."""
+        import jax
+
+        leaves = (list(self.params.values()) + list(self.aux.values())
+                  + jax.tree_util.tree_leaves(self.opt_state))
+        for v in leaves:
+            if getattr(v, "is_deleted", lambda: False)():
+                raise RuntimeError(
+                    "step failed after its donated inputs were "
+                    "invalidated; elastic retry is impossible — "
+                    "restore from the last checkpoint "
+                    "(resilience.CheckpointManager.restore_latest)"
+                ) from cause
+
+    def _build_elastic(self):
+        """Two executables for the accumulated path: a NON-donating
+        gradient function (its params are reused by every microbatch and
+        by any further retry) and an apply function for the single
+        optimizer update. Gradients land in the parameter shardings so
+        accumulation never reshards."""
+        import jax
+
+        update = self._update
+        compute_loss = self._make_compute_loss()
+
+        def grads_fn(params, aux, x, y):
+            (loss, new_aux), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params, aux, x, y)
+            return grads, new_aux, loss
+
+        self._grads_fn = jax.jit(
+            grads_fn,
+            in_shardings=(self._param_sharding, self._aux_sharding,
+                          self._batch_sharding, self._batch_sharding),
+            out_shardings=(self._param_sharding, self._aux_sharding, None))
+
+        def apply_fn(params, grads, opt_state):
+            return update(params, grads, opt_state)
+
+        opt_sharding = self._opt_sharding()
+        self._apply_fn = jax.jit(
+            apply_fn,
+            in_shardings=(self._param_sharding, self._param_sharding,
+                          opt_sharding),
+            out_shardings=(self._param_sharding, opt_sharding))
+
+    def _accum_step(self, n, x, y):
+        """One optimizer update from n accumulated microbatches: grads
+        are computed per microbatch on the SAME params, summed, divided
+        by n (mean-of-means == full-batch mean for equal slices), then
+        applied once. aux chains through microbatches sequentially.
+        Bitwise identical to an explicit step(..., microbatches=n)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..resilience import elastic as _elastic
+
+        if self._grads_fn is None:
+            self._build_elastic()
+        _elastic._STATS["elastic_accum_steps"] += 1
+        rows = int(x.shape[0])
+        mb = rows // n
+        params, aux, opt_state = self.params, self.aux, self.opt_state
+        acc = None
+        loss_sum = None
+        bs = self._batch_sharding
+        for i in range(n):
+            sl = slice(i * mb, (i + 1) * mb)
+            # an eager slice of a dp-sharded batch comes back replicated;
+            # re-place it so the grad executable sees the batch sharding
+            x_i = jax.device_put(x[sl], bs)
+            y_i = jax.device_put(y[sl], bs)
+            grads, aux, loss = self._grads_fn(params, aux, x_i, y_i)
+            acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
+            loss_sum = loss if loss_sum is None else loss_sum + loss
+        inv = 1.0 / n
+        acc = jax.tree.map(lambda g: g * inv, acc)
+        params, opt_state = self._apply_fn(params, acc, opt_state)
+        self.params, self.aux, self.opt_state = params, aux, opt_state
+        return loss_sum / n
 
     def get_states_bytes(self):
         """Serialize opt_state (host-side npz keyed by pytree path) — the

@@ -10,15 +10,18 @@ in-process for `dumps()` aggregate tables.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
+from collections import deque
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "profiler_set_config", "profiler_set_state", "Task",
            "Frame", "Event", "Counter", "Marker", "scope", "dispatch_stats",
-           "reset_dispatch_stats"]
+           "reset_dispatch_stats", "dispatch_ring", "record_dispatch",
+           "set_dispatch_ring"]
 
 _LOCK = threading.Lock()
 _CONFIG = {"filename": "profile.json", "profile_all": False,
@@ -30,6 +33,49 @@ _TRACE_DIR = None
 _EVENTS = []          # host-side (name, start, dur) events
 _COUNTERS = {}
 _PAUSED = False
+
+# Last-K eager-dispatch ring buffer: the forensic trail the watchdog's
+# crash reports embed ("what ops ran just before the stall"). Appends are
+# a deque.append from the dispatch hot path (ops.registry.dispatch), so
+# the cost is ~100 ns per op. MXNET_TPU_DISPATCH_RING sizes it (0
+# disables, default 64) — read ONCE at import to keep the hot path a
+# bare attribute load; resize after import with set_dispatch_ring().
+try:
+    _RING_SIZE = int(os.environ.get("MXNET_TPU_DISPATCH_RING", "64"))
+except ValueError:
+    _RING_SIZE = 64
+_DISPATCH_RING = deque(maxlen=_RING_SIZE) if _RING_SIZE > 0 else None
+_DISPATCH_SEQ = itertools.count(1)
+
+
+def set_dispatch_ring(size):
+    """Resize (or with ``size<=0`` disable) the dispatch ring at
+    runtime; returns the previous size. The registry reads the module
+    attribute on every dispatch, so the swap takes effect immediately —
+    this is the post-import counterpart of MXNET_TPU_DISPATCH_RING."""
+    global _DISPATCH_RING
+    prev = _DISPATCH_RING.maxlen if _DISPATCH_RING is not None else 0
+    size = int(size)
+    _DISPATCH_RING = deque(maxlen=size) if size > 0 else None
+    return prev
+
+
+def record_dispatch(name):
+    """Append one dispatched op to the ring (hot path; registry calls
+    the deque directly — this wrapper exists for external recorders)."""
+    ring = _DISPATCH_RING
+    if ring is not None:
+        ring.append((next(_DISPATCH_SEQ), time.perf_counter(), name))
+
+
+def dispatch_ring():
+    """The last-K eagerly dispatched ops, oldest first, as
+    ``{"seq", "t", "op"}`` dicts (``t`` = perf_counter seconds; compare
+    entries to each other, not to the wall clock)."""
+    if _DISPATCH_RING is None:
+        return []
+    return [{"seq": s, "t": t, "op": n}
+            for s, t, n in list(_DISPATCH_RING)]
 
 
 def set_config(**kwargs):
@@ -120,19 +166,24 @@ def dispatch_stats(reset=False):
       sentinel_nonfinite/sentinel_grad_norm_trips/sentinel_rollbacks,
       health_skipped_steps (sentinel skips + AMP overflow skips, one
       shared series), ckpt_saves/ckpt_restores/ckpt_restore_skipped,
-      faults_armed/faults_fired
+      faults_armed/faults_fired, watchdog_guards/stalls/crash_reports/
+      rollbacks/peer_lost, elastic_oom_events/shrinks/accum_steps
     - serving counters (docs/serving.md): serving_requests/batches/
       batch_samples/padded_samples (pad waste), bucket hits/misses/
       compiles, shed_deadline/shed_overload, poisoned_batches,
-      queue_peak, p50/p99 request latency (us)
+      stalled_batches, queue_peak, p50/p99 request latency (us)
+    - dataloader_respawns: multiprocessing DataLoader workers respawned
+      after dying mid-epoch (docs/resilience.md)
     """
     from . import engine, resilience, serving
+    from .gluon.data import dataloader
     from .ops import registry
 
     stats = registry.dispatch_stats()
     stats.update(engine.bulk_stats())
     stats.update(resilience.stats())
     stats.update(serving.stats())
+    stats.update(dataloader.stats())
     if reset:
         reset_dispatch_stats()
     return stats
@@ -140,8 +191,9 @@ def dispatch_stats(reset=False):
 
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
-    serving)."""
+    serving + dataloader)."""
     from . import engine, resilience, serving
+    from .gluon.data import dataloader
     from .ops import registry
 
     registry.reset_dispatch_stats()
@@ -149,6 +201,7 @@ def reset_dispatch_stats():
         engine._STATS[k] = 0
     resilience.reset_stats()
     serving.reset_stats()
+    dataloader.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

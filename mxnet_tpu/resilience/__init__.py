@@ -1,24 +1,35 @@
 """mxnet_tpu.resilience — survivable long-running training.
 
-Three cooperating pieces (docs/resilience.md):
+Five cooperating pieces (docs/resilience.md):
 
 - :class:`CheckpointManager` — atomic, versioned, CRC-verified
   checkpoints with retention and verified fall-back restore;
 - :class:`HealthSentinel` — per-step NaN/Inf + grad-norm watchdog with
   ``raise | skip_batch | rollback`` policies;
-- :mod:`faults` — deterministic fault-injection harness used by the test
-  suite (and chaos drills) to prove the two above actually work.
+- :mod:`watchdog` — stall watchdog ("no step may block forever"):
+  per-phase deadlines around step/collective/batch execution, crash
+  reports, peer-liveness bookkeeping (:class:`StallError`,
+  :class:`PeerLostError`);
+- :mod:`elastic` — elastic step retry: a ``RESOURCE_EXHAUSTED`` step
+  transparently re-executes as N accumulated microbatches;
+- :mod:`faults` — deterministic fault-injection harness used by the
+  test suite (and ``tools/chaos_run.py`` drills) to prove the above
+  actually work.
 """
 from . import faults
 from . import checkpoint as _checkpoint_mod
 from . import sentinel as _sentinel_mod
+from . import watchdog
+from . import elastic
 from .checkpoint import (CheckpointManager, CheckpointCorruptError,
                          atomic_write_bytes)
 from .sentinel import HealthSentinel, NumericHealthError, note_skip
+from .watchdog import StallError, PeerLostError
 
 __all__ = ["CheckpointManager", "CheckpointCorruptError",
            "atomic_write_bytes", "HealthSentinel", "NumericHealthError",
-           "note_skip", "faults", "stats", "reset_stats"]
+           "note_skip", "StallError", "PeerLostError", "faults",
+           "watchdog", "elastic", "stats", "reset_stats"]
 
 
 def stats():
@@ -28,6 +39,8 @@ def stats():
     out.update(_sentinel_mod.stats())
     out.update(_checkpoint_mod.stats())
     out.update(faults.stats())
+    out.update(watchdog.stats())
+    out.update(elastic.stats())
     return out
 
 
@@ -35,3 +48,5 @@ def reset_stats():
     _sentinel_mod.reset_stats()
     _checkpoint_mod.reset_stats()
     faults.reset_stats()
+    watchdog.reset_stats()
+    elastic.reset_stats()

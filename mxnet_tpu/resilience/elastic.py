@@ -1,0 +1,110 @@
+"""Elastic step execution: survive step OOM by microbatch accumulation.
+
+An XLA ``RESOURCE_EXHAUSTED`` from the jitted training step usually
+kills a long run that could have finished at a smaller microbatch. The
+elastic layer (threaded through ``parallel.ShardedTrainer.step``)
+catches it and transparently re-executes the step as N accumulated
+microbatches, halving the microbatch size (doubling N) until the step
+fits or the floor is reached. The shrink is sticky: once a run has
+shrunk, subsequent steps go straight to the accumulated path instead of
+re-OOMing every step.
+
+Semantics (documented contract, tested bitwise):
+
+- gradients are computed per microbatch on the SAME parameters, summed,
+  and divided by N before ONE optimizer update — mathematically the
+  full-batch mean gradient (each microbatch loss is a mean over B/N
+  rows), and **bitwise identical** to an explicitly requested
+  ``step(x, y, microbatches=N)`` run of the same schedule;
+- auxiliary state (BatchNorm moving stats, RNG key) threads through the
+  microbatches sequentially, exactly as hand-written gradient
+  accumulation would;
+- the optimizer update (and the AMP loss scaler, whose state advances
+  per *update*, not per microbatch) sees one step regardless of N, so
+  step counters, momentum, and scaler growth schedules are unaffected;
+- nothing is donated on the retry path: a failed accumulation attempt
+  leaves params/opt_state intact for the next (smaller) attempt.
+
+Env knobs:
+
+- ``MXNET_TPU_ELASTIC`` — ``0`` disables the retry (the OOM surfaces);
+- ``MXNET_TPU_ELASTIC_MIN_MICROBATCH`` — smallest rows-per-microbatch
+  the halving may reach (default 1).
+
+The ``oom_step[@step[:times]]`` fault kind raises an injected
+``RESOURCE_EXHAUSTED`` before the step launches (times = how many
+attempts fail, so ``times=2`` forces two halvings), making the whole
+path deterministic on CPU. Counters (``elastic_oom_events``,
+``elastic_shrinks``, ``elastic_accum_steps``) surface in
+``profiler.dispatch_stats()``.
+"""
+from __future__ import annotations
+
+import os
+
+from . import faults
+
+__all__ = ["enabled", "min_microbatch", "is_oom_error",
+           "next_microbatches", "stats", "reset_stats"]
+
+_STATS = {
+    "elastic_oom_events": 0,   # RESOURCE_EXHAUSTED caught from a step
+    "elastic_shrinks": 0,      # microbatch halvings performed
+    "elastic_accum_steps": 0,  # steps executed via accumulation (N > 1)
+}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def enabled():
+    return os.environ.get("MXNET_TPU_ELASTIC", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def min_microbatch():
+    try:
+        return max(1, int(os.environ.get(
+            "MXNET_TPU_ELASTIC_MIN_MICROBATCH", "1")))
+    except ValueError:
+        return 1
+
+
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted",
+                "out of memory", "out_of_memory", "allocation failure")
+
+
+def is_oom_error(err):
+    """Is this exception a step OOM worth retrying at a smaller
+    microbatch? Matches XLA's RESOURCE_EXHAUSTED surface (string-based:
+    jaxlib's exception types vary across versions) and the injected
+    ``oom_step`` fault."""
+    if isinstance(err, faults.InjectedOOM):
+        return True
+    msg = str(err).lower()
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def next_microbatches(n, rows, shards=1):
+    """The accumulation count to try after an OOM at ``n`` microbatches
+    over a ``rows``-row global batch, or None when shrinking further is
+    impossible. Halving stops when the microbatch would drop below
+    ``MXNET_TPU_ELASTIC_MIN_MICROBATCH`` rows, when ``rows`` stops
+    dividing evenly, or when the microbatch would no longer split across
+    the ``shards`` data-parallel shards of the mesh."""
+    nxt = int(n) * 2
+    rows = int(rows)
+    if nxt > rows or rows % nxt:
+        return None
+    mb = rows // nxt
+    if mb < min_microbatch():
+        return None
+    if shards > 1 and mb % shards:
+        return None
+    return nxt

@@ -22,6 +22,22 @@ Supported fault kinds (the hook that honours each is noted):
 - ``nan_serving``               — poison one inference input batch with NaN
                                   (``serving.Predictor``; proves the
                                   BatchServer sentinel path)
+- ``hang_step``                 — wedge the training step in an
+                                  interruptible sleep loop until the
+                                  watchdog fires (``Trainer.step``,
+                                  ``ShardedTrainer.step``)
+- ``hang_collective``           — same, inside a kvstore collective
+                                  (``kvstore='tpu'`` push, dist allreduce)
+- ``hang_batch``                — same, inside a BatchServer batch
+                                  execution
+- ``oom_step``                  — raise an injected RESOURCE_EXHAUSTED
+                                  from the jitted step (``times`` = how
+                                  many attempts fail, driving elastic
+                                  microbatch halving)
+- ``peer_death``                — declare a worker rank dead so the next
+                                  collective raises PeerLostError (rank
+                                  from ``MXNET_TPU_FAULT_PEER_RANK``,
+                                  default 1)
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -39,11 +55,13 @@ import contextlib
 import errno
 import os
 import threading
+import time
 
-__all__ = ["SimulatedCrash", "FaultInjected", "inject", "arm", "disarm",
-           "reset", "active", "get", "stats", "reset_stats",
-           "maybe_nan_grads", "checkpoint_write_filter", "maybe_crash",
-           "maybe_dist_connect_fault", "maybe_nan_batch"]
+__all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "inject",
+           "arm", "disarm", "reset", "active", "get", "stats",
+           "reset_stats", "maybe_nan_grads", "checkpoint_write_filter",
+           "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
+           "maybe_hang", "maybe_oom_step", "maybe_peer_death"]
 
 
 class SimulatedCrash(BaseException):
@@ -55,6 +73,11 @@ class SimulatedCrash(BaseException):
 class FaultInjected(RuntimeError):
     """Base class for injected recoverable errors (lets tests assert the
     failure came from the harness, not a real defect)."""
+
+
+class InjectedOOM(FaultInjected):
+    """Injected step OOM. The message mimics XLA's RESOURCE_EXHAUSTED so
+    string-based classifiers treat it exactly like the real thing."""
 
 
 _LOCK = threading.Lock()
@@ -247,6 +270,53 @@ def maybe_dist_connect_fault():
     if fault is not None and fault.should_fire():
         raise TimeoutError(
             "coordinator connect timed out [injected fault]")
+
+
+def maybe_hang(point):
+    """Wedge the calling thread at ``point`` (``hang_step`` /
+    ``hang_collective`` / ``hang_batch``): spin in short interruptible
+    sleeps so the watchdog's asynchronous StallError can land between
+    bytecodes — exactly the Python-level-hang class the watchdog is able
+    to unblock. Capped (``MXNET_TPU_FAULT_HANG_CAP``, default 30 s) so a
+    broken watchdog fails the test instead of hanging the suite."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get(point)
+    if fault is None or not fault.should_fire():
+        return
+    cap = float(os.environ.get("MXNET_TPU_FAULT_HANG_CAP", "30"))
+    deadline = time.monotonic() + cap
+    while time.monotonic() < deadline:
+        time.sleep(0.005)
+    raise FaultInjected(
+        f"injected hang at {point} ran its full {cap:.0f}s cap without "
+        "being interrupted — is the watchdog armed for this phase?")
+
+
+def maybe_oom_step():
+    """Raise an injected RESOURCE_EXHAUSTED before the jitted step
+    launches (kind ``oom_step``). Firing before dispatch means no buffer
+    has been donated yet, mirroring the common real case (OOM during
+    compile/allocation) where elastic retry is safe."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get("oom_step")
+    if fault is not None and fault.should_fire():
+        raise InjectedOOM(
+            "RESOURCE_EXHAUSTED: out of memory while running the training "
+            "step [injected fault]")
+
+
+def maybe_peer_death():
+    """When ``peer_death`` fires, return the rank to declare dead
+    (``MXNET_TPU_FAULT_PEER_RANK``, default 1); else None. The
+    watchdog's collective guard records it and raises PeerLostError."""
+    if not _ACTIVE:
+        return None
+    fault = _ACTIVE.get("peer_death")
+    if fault is not None and fault.should_fire():
+        return int(os.environ.get("MXNET_TPU_FAULT_PEER_RANK", "1"))
+    return None
 
 
 _install_from_env()

@@ -1,0 +1,513 @@
+"""Stall watchdog: the "no step may block forever" contract.
+
+PR 2's sentinel catches failures that announce themselves (NaN grads, a
+corrupt checkpoint). This module catches the ones that *hang*: a peer
+dropping mid-allreduce leaves every other worker blocked inside the
+collective, a wedged input pipeline stalls the step, a poisoned batch
+wedges the serving queue. A single daemon monitor thread watches every
+guarded scope; when a scope outlives its per-phase deadline the monitor
+
+- writes a **crash report** (JSON: faulting phase, step, RNG state, the
+  last-K eager-dispatch ring buffer, all runtime counters, an env
+  snapshot) to ``MXNET_TPU_CRASH_DIR`` (default
+  ``$TMPDIR/mxnet_tpu_crash``), then
+- raises a structured :class:`StallError` *in the stalled thread's
+  place* (``PyThreadState_SetAsyncExc``), so the blocked ``step()`` /
+  ``push()`` / batch execution returns with an exception instead of
+  hanging a 16-chip slice forever.
+
+Phases and their deadline env knobs (seconds; unset or ``0`` disables):
+
+- ``step``       — ``MXNET_TPU_WATCHDOG_STEP_TIMEOUT``
+  (``gluon.Trainer.step/update``, ``parallel.ShardedTrainer.step``)
+- ``collective`` — ``MXNET_TPU_WATCHDOG_COLLECTIVE_TIMEOUT``
+  (``kvstore='tpu'`` push, ``kvstore/dist.py`` allreduce/barrier/init)
+- ``batch``      — ``MXNET_TPU_WATCHDOG_BATCH_TIMEOUT``
+  (``serving.BatchServer`` batch execution and ``close()`` drain)
+
+Collectives additionally keep **peer-liveness bookkeeping**: a rank
+marked dead (``mark_peer_dead``, or the ``peer_death`` fault) makes the
+next collective fail fast with :class:`PeerLostError` naming the rank,
+and a collective that *stalls* while peers are known dead raises
+PeerLostError instead of a bare StallError.
+
+The async raise lands at a Python bytecode boundary, so it interrupts
+Python-level waits (locks, short sleeps, retry loops) but not a thread
+parked inside one C call; the crash report is written either way, which
+is the forensic trail a truly wedged process otherwise never leaves.
+Deterministic CPU coverage comes from ``faults.maybe_hang`` (kinds
+``hang_step`` / ``hang_collective`` / ``hang_batch``), whose injected
+hang sleeps in interruptible slices.
+
+Stdlib-only at import so hot-path callers (trainer, kvstore, serving)
+can import it at module scope without dragging in jax.
+"""
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+
+from . import faults as _faults
+
+__all__ = ["StallError", "PeerLostError", "guard", "collective_guard",
+           "timeout_for", "crash_dir", "note_step", "note_rollback",
+           "mark_peer_dead", "dead_peers", "reset_peers", "stats",
+           "reset_stats", "PHASES"]
+
+PHASES = ("step", "collective", "batch")
+
+_STATS = {
+    "watchdog_guards": 0,         # scopes armed (a timeout was configured)
+    "watchdog_stalls": 0,         # deadlines that expired
+    "watchdog_crash_reports": 0,  # reports successfully written
+    "watchdog_rollbacks": 0,      # stalls recovered via checkpoint rollback
+    "watchdog_peer_lost": 0,      # ranks declared dead
+}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# --------------------------------------------------------------------- errors
+
+# PyThreadState_SetAsyncExc only accepts an exception CLASS (CPython
+# instantiates it with no arguments at the bytecode boundary where it is
+# delivered), so the monitor parks the stall details here, keyed by the
+# stalled thread's ident, for __init__ to pick up.
+_PENDING_STALLS: dict = {}
+
+
+class StallError(RuntimeError):
+    """A guarded phase exceeded its watchdog deadline.
+
+    Attributes: ``phase`` (step|collective|batch), ``detail`` (the
+    guarded call site), ``timeout`` (the expired deadline, seconds), and
+    ``report_path`` (the crash report written before the raise, or None
+    when report writing failed)."""
+
+    phase = None
+    detail = None
+    timeout = None
+    report_path = None
+
+    def __init__(self, *args):
+        if not args:
+            info = _PENDING_STALLS.pop(threading.get_ident(), None)
+            if info is not None:
+                self.__dict__.update(info)
+                args = (info.get("message", "watchdog stall"),)
+        super().__init__(*args)
+
+
+class PeerLostError(StallError):
+    """A collective lost a peer: the named rank(s) are dead, so the
+    operation would have blocked forever. ``ranks`` lists them."""
+
+    ranks = ()
+
+
+# ---------------------------------------------------------------------- peers
+
+_PEER_LOCK = threading.Lock()
+_DEAD_PEERS: set = set()
+
+
+def mark_peer_dead(rank):
+    """Record that worker ``rank`` is gone. Every subsequent collective
+    fails fast with PeerLostError instead of blocking on it."""
+    with _PEER_LOCK:
+        rank = int(rank)
+        if rank not in _DEAD_PEERS:
+            _DEAD_PEERS.add(rank)
+            _STATS["watchdog_peer_lost"] += 1
+
+
+def dead_peers():
+    with _PEER_LOCK:
+        return sorted(_DEAD_PEERS)
+
+
+def reset_peers():
+    """Forget dead-peer bookkeeping (tests; or after an elastic restart
+    re-admits the rank)."""
+    with _PEER_LOCK:
+        _DEAD_PEERS.clear()
+
+
+def _peer_lost_error(ranks, detail, stalled=None):
+    ranks = tuple(ranks)
+    what = detail or "collective"
+    if stalled is None:
+        msg = (f"peer rank(s) {list(ranks)} lost: refusing to enter "
+               f"{what} that would block forever on the dead worker(s)")
+    else:
+        msg = (f"peer rank(s) {list(ranks)} lost: {what} stalled past its "
+               f"{stalled:.3g}s collective deadline waiting on the dead "
+               "worker(s)")
+    err = PeerLostError(msg)
+    err.phase = "collective"
+    err.detail = detail
+    err.ranks = ranks
+    err.timeout = stalled
+    return err
+
+
+# ------------------------------------------------------------------- guarding
+
+_TOKENS = itertools.count(1)
+_COND = threading.Condition()
+_GUARDS: dict = {}
+_MONITOR = None
+_WAKE_AT = None    # monotonic time the monitor is currently sleeping toward
+_LAST_STEP = None  # most recent training step seen by note_step()
+
+
+class _Guard:
+    __slots__ = ("token", "phase", "detail", "timeout", "deadline",
+                 "thread_id", "thread_name", "step", "fired", "cancelled",
+                 "cls", "info")
+
+    def __init__(self, phase, timeout, detail, step):
+        self.token = next(_TOKENS)
+        self.phase = phase
+        self.detail = detail
+        self.timeout = float(timeout)
+        self.deadline = time.monotonic() + self.timeout
+        t = threading.current_thread()
+        self.thread_id = t.ident
+        self.thread_name = t.name
+        self.step = step
+        self.fired = False      # monitor expired this guard
+        self.cancelled = False  # guarded thread resolved its own fate
+        self.cls = None
+        self.info = None
+
+
+def timeout_for(phase):
+    """The configured deadline (seconds) for ``phase``, or None when the
+    watchdog is disabled for it. Read from the environment on every call
+    so tests (and live operators) can arm it after import."""
+    raw = os.environ.get(
+        f"MXNET_TPU_WATCHDOG_{phase.upper()}_TIMEOUT", "").strip()
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+@contextlib.contextmanager
+def guard(phase, timeout=None, detail=None, step=None):
+    """Arm the watchdog around a block. ``timeout`` defaults to the
+    phase's env deadline; with no deadline configured this is a no-op
+    (one env read). On expiry the monitor thread writes a crash report
+    and asynchronously raises StallError (or PeerLostError, for a
+    collective with known-dead peers) inside the guarded thread."""
+    if timeout is None:
+        timeout = timeout_for(phase)
+    if timeout is None:
+        yield None
+        return
+    g = _Guard(phase, timeout, detail, step)
+    with _COND:
+        _GUARDS[g.token] = g
+        _STATS["watchdog_guards"] += 1
+        _ensure_monitor()
+        # Wake the monitor only when this deadline is EARLIER than what
+        # it already sleeps toward: a notify per guard would force a GIL
+        # handoff to the monitor on every training step (measured ~0.5 ms
+        # per step on the eager CPU path — far over the 5% budget). A
+        # stale-early wake just recomputes and sleeps again.
+        if _WAKE_AT is None or g.deadline < _WAKE_AT:
+            _COND.notify_all()
+    try:
+        yield g
+    except BaseException as body_exc:
+        with _COND:
+            _GUARDS.pop(g.token, None)
+            if g.fired and getattr(body_exc, "guard_token",
+                                   None) != g.token:
+                # the body is unwinding with an error that is NOT this
+                # guard's own delivered stall (its own failure, or a
+                # nested/outer guard's StallError): cancel THIS guard's
+                # delivery so it cannot erupt at an arbitrary later
+                # bytecode of the caller. Holding _COND makes this
+                # atomic with _fire's cancelled-check, so the monitor
+                # either sees the cancel or has already delivered —
+                # never delivers after it.
+                g.cancelled = True
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(threading.get_ident()), None)
+                _PENDING_STALLS.pop(threading.get_ident(), None)
+        raise
+    else:
+        with _COND:
+            _GUARDS.pop(g.token, None)
+            fired = g.fired
+        if fired:
+            _absorb_stall(g)
+
+
+def _absorb_stall(g):
+    """The block completed in the same instant the monitor fired: the
+    async exception is (about to be) pending on this thread — possibly
+    delayed behind the crash-report write. Park on interruptible sleeps
+    so it is delivered *here*, inside the guard, rather than at some
+    arbitrary later bytecode of the caller. If it never arrives, cancel
+    the delivery (atomically with _fire's cancelled-check) and surface
+    the stall synchronously instead."""
+    end = time.monotonic() + _REPORT_BUDGET + 2.0
+    while time.monotonic() < end:
+        time.sleep(0.001)  # a bytecode boundary: delivery happens here
+    with _COND:
+        g.cancelled = True
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(threading.get_ident()), None)
+        _PENDING_STALLS.pop(threading.get_ident(), None)
+    err = (g.cls or StallError)(
+        (g.info or {}).get("message",
+                           f"{g.phase} exceeded its {g.timeout:.3g}s "
+                           "watchdog deadline"))
+    err.__dict__.update(g.info or {"phase": g.phase, "timeout": g.timeout,
+                                   "detail": g.detail})
+    raise err
+
+
+@contextlib.contextmanager
+def collective_guard(detail=None, timeout=None):
+    """`guard('collective')` plus peer-liveness bookkeeping: consult the
+    ``peer_death`` fault hook, refuse to enter the collective when any
+    peer is already known dead (PeerLostError naming the rank — not an
+    infinite block), and arm the collective deadline around the body."""
+    rank = _faults.maybe_peer_death()
+    if rank is not None:
+        mark_peer_dead(rank)
+    dead = dead_peers()
+    if dead:
+        raise _peer_lost_error(dead, detail)
+    with guard("collective", timeout=timeout, detail=detail) as g:
+        yield g
+
+
+def note_step(step):
+    """Record the current training step so crash reports from guards
+    that don't know it (collectives, nested scopes) still carry it."""
+    global _LAST_STEP
+    _LAST_STEP = int(step)
+
+
+def note_rollback(err, manifest):
+    """Record that a stall was recovered by restoring a checkpoint:
+    bumps ``watchdog_rollbacks`` and amends the stall's crash report
+    with the restored manifest's step/tag so the report tells the whole
+    story (stalled at step X, resumed from step Y)."""
+    _STATS["watchdog_rollbacks"] += 1
+    path = getattr(err, "report_path", None)
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        report["rollback"] = {
+            "restored_step": manifest.get("step"),
+            "restored_tag": manifest.get("tag"),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, path)
+    except (OSError, ValueError):
+        pass
+
+
+# -------------------------------------------------------------------- monitor
+
+def _ensure_monitor():
+    """Start the daemon monitor thread lazily (called under _COND)."""
+    global _MONITOR
+    if _MONITOR is None or not _MONITOR.is_alive():
+        _MONITOR = threading.Thread(target=_monitor_loop,
+                                    name="mxnet-tpu-watchdog", daemon=True)
+        _MONITOR.start()
+
+
+def _monitor_loop():
+    global _WAKE_AT
+    while True:
+        expired = []
+        with _COND:
+            if not _GUARDS:
+                _WAKE_AT = None
+                _COND.wait(timeout=60.0)
+                continue
+            now = time.monotonic()
+            soonest = min(g.deadline for g in _GUARDS.values())
+            if soonest > now:
+                _WAKE_AT = soonest
+                _COND.wait(timeout=min(soonest - now, 60.0))
+                _WAKE_AT = None
+                continue
+            for token in [t for t, g in _GUARDS.items()
+                          if g.deadline <= now]:
+                g = _GUARDS.pop(token)
+                g.fired = True
+                expired.append(g)
+        for g in expired:
+            try:
+                _fire(g)
+            except Exception:
+                pass  # the monitor must survive anything
+
+
+# Hard budget (seconds) for writing one crash report. The write runs in
+# a helper thread so a wedged import lock or a hung crash-dir mount can
+# delay the report but can never stop the monitor from unwedging the
+# stalled thread — the raise is the contract, the report is forensics.
+_REPORT_BUDGET = 5.0
+
+
+def _fire(g):
+    """One expired guard: write the crash report (time-budgeted), pick
+    the error class, and raise it asynchronously in the stalled thread
+    — unless the guarded thread already resolved its own fate
+    (g.cancelled), in which case delivery is skipped."""
+    box = {}
+
+    def write():
+        box["path"] = _write_crash_report(g)
+
+    writer = threading.Thread(target=write, daemon=True,
+                              name="mxnet-tpu-crash-report")
+    writer.start()
+    writer.join(_REPORT_BUDGET)
+    report_path = box.get("path")
+    _STATS["watchdog_stalls"] += 1
+    dead = dead_peers()
+    if g.phase == "collective" and dead:
+        cls = PeerLostError
+        template = _peer_lost_error(dead, g.detail, stalled=g.timeout)
+        message = str(template)
+        extra = {"ranks": tuple(dead)}
+    else:
+        cls = StallError
+        what = g.detail or g.phase
+        message = (f"{what} stalled: no progress within its "
+                   f"{g.timeout:.3g}s '{g.phase}' watchdog deadline "
+                   f"(crash report: {report_path})")
+        extra = {}
+    info = {"message": message, "phase": g.phase, "detail": g.detail,
+            "timeout": g.timeout, "report_path": report_path,
+            "guard_token": g.token}  # lets cleanup tell its own stall
+    info.update(extra)               # apart from a nested guard's
+    g.cls = cls
+    g.info = info
+    with _COND:
+        # atomic with the guard-side cancel: either we deliver here and
+        # the cleanup's SetAsyncExc(None) finds nothing or clears it, or
+        # the cancel came first and we must not deliver at all
+        if g.cancelled:
+            return
+        _PENDING_STALLS[g.thread_id] = info
+        res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(g.thread_id), ctypes.py_object(cls))
+        if res != 1:
+            # 0: thread already exited; >1: multiple states touched — undo
+            if res > 1:
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(g.thread_id), None)
+            _PENDING_STALLS.pop(g.thread_id, None)
+
+
+# --------------------------------------------------------------- crash report
+
+def crash_dir():
+    return (os.environ.get("MXNET_TPU_CRASH_DIR", "").strip()
+            or os.path.join(tempfile.gettempdir(), "mxnet_tpu_crash"))
+
+
+def _rng_snapshot(budget=0.5):
+    """Best-effort RNG key snapshot. Reading it syncs the device, and a
+    stalled runtime may never answer — so the read runs in a helper
+    thread with a hard budget; 'unavailable' beats a wedged monitor."""
+    box = {}
+
+    def grab():
+        try:
+            from .. import random as _random
+
+            if _random._KEY is None:
+                box["v"] = None
+                return
+            import numpy as np
+
+            box["v"] = np.asarray(_random._KEY.asnumpy()).tolist()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=grab, daemon=True)
+    t.start()
+    t.join(budget)
+    return box.get("v", "unavailable")
+
+
+def _env_snapshot():
+    prefixes = ("MXNET_TPU_", "MXNET_", "JAX_", "XLA_", "DMLC_", "TPU_")
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(prefixes)}
+
+
+def _write_crash_report(g):
+    try:
+        from .. import profiler
+
+        try:
+            ring = profiler.dispatch_ring()
+        except Exception:
+            ring = []
+        try:
+            counters = profiler.dispatch_stats()
+        except Exception:
+            counters = {}
+        report = {
+            "schema_version": 1,
+            "kind": "stall",
+            "phase": g.phase,
+            "detail": g.detail,
+            "timeout_s": g.timeout,
+            "step": g.step if g.step is not None else _LAST_STEP,
+            "pid": os.getpid(),
+            "thread": {"ident": g.thread_id, "name": g.thread_name},
+            "wallclock": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "dead_peers": dead_peers(),
+            "rng_state": _rng_snapshot(),
+            "dispatch_ring": ring,
+            "counters": counters,
+            "env": _env_snapshot(),
+        }
+        d = crash_dir()
+        os.makedirs(d, exist_ok=True)
+        name = (f"crash-{time.strftime('%Y%m%d-%H%M%S')}-{g.phase}"
+                f"-pid{os.getpid()}-{g.token}.json")
+        path = os.path.join(d, name)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+        os.replace(tmp, path)
+        _STATS["watchdog_crash_reports"] += 1
+        return path
+    except Exception:
+        return None

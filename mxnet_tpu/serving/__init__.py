@@ -42,6 +42,7 @@ _STATS = {
     "serving_shed_deadline": 0,    # requests failed on expired deadline
     "serving_shed_overload": 0,    # requests shed at the queue high-water
     "serving_poisoned_batches": 0, # batches the health check rejected
+    "serving_stalled_batches": 0,  # batches the watchdog timed out
     "serving_queue_peak": 0,       # high-water mark of queued requests
 }
 

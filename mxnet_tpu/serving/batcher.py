@@ -36,11 +36,13 @@ import os
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as _np
 
 from ..base import MXNetError
+from ..resilience import faults as _faults
+from ..resilience import watchdog as _watchdog
 from ..resilience.sentinel import HealthSentinel, NumericHealthError
 from . import _STATS, record_latency
 
@@ -70,6 +72,20 @@ class _Request:
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time, or None
+
+
+def _try_resolve(future, result=None, exc=None):
+    """Resolve a future that close() may be failing concurrently: the
+    first writer wins, the loser is a silent no-op (never
+    InvalidStateError out of the worker or out of close())."""
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
 
 
 def _env_float(name, default):
@@ -140,6 +156,7 @@ class BatchServer:
         self._cond = threading.Condition()
         self._closed = False
         self._drain = True
+        self._inflight = ()  # batch currently executing (close() failover)
         self._worker = threading.Thread(target=self._serve_loop,
                                         name="mxnet-tpu-serving", daemon=True)
         self._worker.start()
@@ -294,12 +311,24 @@ class BatchServer:
             self._execute(live)
 
     def _execute(self, batch):
+        with self._cond:
+            self._inflight = tuple(batch)
         try:
-            fused = {name: (batch[0].feeds[name] if len(batch) == 1
-                            else _np.concatenate(
-                                [r.feeds[name] for r in batch], axis=0))
-                     for name in batch[0].feeds}
-            outs, _n = self.predictor.predict_raw(fused)
+            # the batch watchdog (MXNET_TPU_WATCHDOG_BATCH_TIMEOUT) bounds
+            # the executable launch: a wedged batch raises StallError into
+            # this worker thread, failing ONLY its own futures below —
+            # the queue keeps serving
+            with _watchdog.guard(
+                    "batch",
+                    detail=f"BatchServer batch "
+                           f"({sum(r.rows for r in batch)} rows, "
+                           f"{len(batch)} request(s))"):
+                _faults.maybe_hang("hang_batch")
+                fused = {name: (batch[0].feeds[name] if len(batch) == 1
+                                else _np.concatenate(
+                                    [r.feeds[name] for r in batch], axis=0))
+                         for name in batch[0].feeds}
+                outs, _n = self.predictor.predict_raw(fused)
             healthy = True
             err = None
             if self.sentinel is not None:
@@ -314,7 +343,7 @@ class BatchServer:
                     self.sentinel.last_reason or
                     "non-finite values in serving batch outputs")
                 for r in batch:
-                    r.future.set_exception(err)
+                    _try_resolve(r.future, exc=err)
                 return
             np_outs = [_np.asarray(o) for o in outs]
             _STATS["serving_batches"] += 1
@@ -322,25 +351,67 @@ class BatchServer:
             t_done = time.perf_counter()
             for r in batch:
                 sl = slice(offset, offset + r.rows)
-                r.future.set_result(
-                    [o[sl].copy() if o.ndim and o.shape[0] == _n else o.copy()
-                     for o in np_outs])
+                # close() may have failed this future already — first
+                # writer wins
+                if _try_resolve(r.future, result=[
+                        o[sl].copy()
+                        if o.ndim and o.shape[0] == _n else o.copy()
+                        for o in np_outs]):
+                    record_latency(t_done - r.t_submit)
                 offset += r.rows
-                record_latency(t_done - r.t_submit)
         except Exception as e:  # never wedge the queue on a bad batch
+            if isinstance(e, _watchdog.StallError):
+                _STATS["serving_stalled_batches"] += 1
             for r in batch:
-                if not r.future.done():
-                    r.future.set_exception(e)
+                _try_resolve(r.future, exc=e)
+        finally:
+            with self._cond:
+                self._inflight = ()
 
     # ------------------------------------------------------------------- close
     def close(self, drain=True, timeout=None):
         """Stop intake; with ``drain`` (default) serve every queued
-        request first, otherwise fail them with ServerClosed. Idempotent."""
+        request first, otherwise fail them with ServerClosed. Idempotent.
+
+        The drain itself is deadline-bounded: ``timeout`` (seconds;
+        default derived from the batch watchdog deadline,
+        MXNET_TPU_WATCHDOG_BATCH_TIMEOUT, scaled by the number of
+        pending batches) caps how long shutdown waits. If the worker
+        cannot finish — e.g. a poisoned in-flight batch is wedged — the
+        remaining queued and in-flight requests fail with
+        :class:`ServerClosed` instead of leaking unresolved futures, and
+        close() returns. With neither a timeout nor a batch deadline
+        configured, close() waits for a full drain as before."""
         with self._cond:
             self._closed = True
             self._drain = drain
+            pending_rows = sum(r.rows for r in self._queue)
+            inflight = 1 if self._inflight else 0
             self._cond.notify_all()
+        if timeout is None:
+            per_batch = _watchdog.timeout_for("batch")
+            if per_batch is not None:
+                # every pending BATCH gets its own deadline, plus slack
+                # (requests coalesce, so the queue drains in ~rows/max
+                # launches; mixed signatures may need more — then the
+                # leftover futures are failed below, still bounded)
+                batches = -(-pending_rows // self.max_batch_size) + inflight
+                timeout = per_batch * max(1, batches) + 1.0
         self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return
+        # drain blew its deadline: stop draining, fail whatever is left
+        with self._cond:
+            self._drain = False
+            leftovers = list(self._queue) + list(self._inflight)
+            self._queue.clear()
+            self._cond.notify_all()
+        err = ServerClosed(
+            "BatchServer drain exceeded its shutdown deadline "
+            f"({timeout:.3g}s); request abandoned at close")
+        for r in leftovers:
+            _try_resolve(r.future, exc=err)
+        self._worker.join(0.1)
 
     def __enter__(self):
         return self

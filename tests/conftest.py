@@ -42,3 +42,7 @@ def pytest_configure(config):
         "exhaustive: full-coverage sweep; the fast tier is "
         "-m 'not exhaustive and not slow' (~<8 min), the FULL default run "
         "remains the merge gate")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos drill (tools/chaos_run.py); fast "
+        "kinds run in tier-1, slow kinds carry the slow marker too")

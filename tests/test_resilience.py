@@ -536,8 +536,11 @@ class _AlwaysDieDataset:
 
 
 def test_dataloader_respawns_dead_worker(tmp_path):
+    from mxnet_tpu import profiler
+    from mxnet_tpu.gluon.data import dataloader as dl_mod
     from mxnet_tpu.gluon.data.dataloader import DataLoader
 
+    dl_mod.reset_stats()
     ds = _DieOnceDataset(12, str(tmp_path / "died.flag"))
     loader = DataLoader(ds, batch_size=2, num_workers=2, timeout=60)
     with warnings.catch_warnings(record=True) as w:
@@ -547,6 +550,8 @@ def test_dataloader_respawns_dead_worker(tmp_path):
     values = sorted(int(row[0]) for b in got for row in b)
     assert values == list(range(12))  # every batch delivered despite death
     assert any("respawned" in str(x.message) for x in w)
+    # the respawn also lands in the one-call resilience counter surface
+    assert profiler.dispatch_stats()["dataloader_respawns"] >= 1
 
 
 def test_dataloader_respawn_budget_exhausted(tmp_path):
