@@ -32,18 +32,21 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     return _callback
 
 
-def resilient_checkpoint(manager, net, trainer=None, period=1):
+def resilient_checkpoint(manager, net, trainer=None, period=1,
+                         async_=False):
     """Epoch-end callback writing atomic, versioned checkpoints through a
     resilience.CheckpointManager (net params + trainer/optimizer state +
     RNG + loss-scaler state, CRC-stamped, keep_n retention) — the
-    crash-safe upgrade of ``do_checkpoint``. Resume with
+    crash-safe upgrade of ``do_checkpoint``. ``async_=True`` publishes on
+    the manager's background writer (the training loop only pays the
+    host snapshot; the next save barriers). Resume with
     ``manager.restore_latest(net=net, trainer=trainer)``."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
             manager.save(iter_no + 1, net=net, trainer=trainer,
-                         epoch=iter_no + 1)
+                         epoch=iter_no + 1, async_=async_)
 
     return _callback
 

@@ -125,7 +125,7 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochEnd):
         self.logger.info(" ".join(msgs))
 
 
-class CheckpointHandler(TrainBegin, EpochEnd):
+class CheckpointHandler(TrainBegin, TrainEnd, EpochEnd):
     """Checkpoint every epoch (event_handler.py CheckpointHandler).
 
     Default mode keeps the legacy behavior (plain ``save_parameters``
@@ -134,28 +134,36 @@ class CheckpointHandler(TrainBegin, EpochEnd):
     publish, CRC manifest, trainer/optimizer + RNG + loss-scaler state,
     ``keep_n`` retention — and ``resume=True`` restores the newest valid
     checkpoint at train_begin so an interrupted ``fit`` continues where
-    it died.
+    it died. ``async_=True`` publishes each epoch's checkpoint on the
+    manager's background writer thread (the epoch loop only pays the
+    host snapshot); train_end barriers on the last in-flight write so
+    ``fit`` never returns with an unpublished checkpoint.
     """
 
     def __init__(self, model_dir, model_prefix="model", atomic=False,
                  checkpoint_manager=None, keep_n=None, resume=False,
-                 save_trainer=True):
+                 save_trainer=True, async_=False):
         import os
 
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.resume = resume
         self.save_trainer = save_trainer
+        self.async_ = async_
         self.resumed_manifest = None
         self._step_offset = 0
         if checkpoint_manager is None and (atomic or keep_n is not None
-                                           or resume):
+                                           or resume or async_):
             from ...resilience import CheckpointManager
 
             checkpoint_manager = CheckpointManager(
                 model_dir, keep_n=keep_n, prefix=model_prefix)
         self.manager = checkpoint_manager
         os.makedirs(model_dir, exist_ok=True)
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.manager is not None:
+            self.manager.wait_for_async()
 
     def train_begin(self, estimator, *args, **kwargs):
         if self.resume and self.manager is not None:
@@ -177,7 +185,7 @@ class CheckpointHandler(TrainBegin, EpochEnd):
             self.manager.save(
                 step, net=estimator.net,
                 trainer=estimator.trainer if self.save_trainer else None,
-                epoch=step)
+                epoch=step, async_=self.async_)
             return
         path = os.path.join(self.model_dir,
                             f"{self.model_prefix}-epoch{epoch}.params")

@@ -257,6 +257,20 @@ class KVStoreTPU(KVStore):
             _faults.maybe_hang("hang_collective")
             super().push(key, value, priority)
 
+    def excise_dead_peers(self):
+        """Re-admit the store's collectives after dead ranks have been
+        excised from the job — the kvstore-side hook of elastic peer
+        recovery. ``PeerLostError`` bookkeeping is sticky by design (a
+        dead rank must keep failing fast, never block), so once an
+        elastic restart has rebuilt the worker set without the dead
+        ranks (``parallel.ShardedTrainer`` mesh-shrink resume does this
+        automatically; an operator replacing a worker does it by hand),
+        call this to clear the bookkeeping and let push/pull serve
+        again. Returns the ranks that were cleared."""
+        dead = _watchdog.dead_peers()
+        _watchdog.reset_peers()
+        return dead
+
     def _reduce(self, values):
         if len(values) == 1:
             return values[0]

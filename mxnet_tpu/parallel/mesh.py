@@ -12,7 +12,8 @@ import math
 
 import numpy as np
 
-__all__ = ["create_mesh", "default_mesh", "local_devices", "AXES"]
+__all__ = ["create_mesh", "default_mesh", "local_devices", "shrink_mesh",
+           "MeshShrinkError", "AXES"]
 
 AXES = ("dp", "tp", "pp", "sp", "ep")
 
@@ -46,6 +47,53 @@ def create_mesh(axes=None, devices=None):
         f"got {len(devices)}"
     arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+class MeshShrinkError(RuntimeError):
+    """No viable smaller mesh exists after excising the dead ranks."""
+
+
+def shrink_mesh(mesh, dead_ranks, batch_axis="dp"):
+    """The largest viable mesh buildable from the survivors after losing
+    ``dead_ranks`` along ``batch_axis`` — the topology half of elastic
+    peer-loss recovery (resilience/elastic.py; the state half is the
+    reshardable checkpoint restore).
+
+    Ranks map onto ``batch_axis`` slots (on a one-device-per-process dp
+    mesh a rank IS its dp coordinate; ranks outside the axis still cost
+    a slot each, dropped from the tail). Every non-batch axis keeps its
+    full extent — losing a dp peer must not silently shrink tp/pp — and
+    the new batch extent is the largest power of two that fits the
+    survivors, so dp=8 degrades 8 -> 4 -> 2 -> 1 and batch divisibility
+    (rows % dp) is preserved for power-of-two batches. Raises
+    MeshShrinkError when nothing viable remains.
+    """
+    from jax.sharding import Mesh
+
+    names = list(mesh.axis_names)
+    if batch_axis not in names:
+        raise MeshShrinkError(
+            f"mesh {names} has no '{batch_axis}' axis to shrink")
+    axis = names.index(batch_axis)
+    size = int(mesh.devices.shape[axis])
+    dead = {int(r) for r in dead_ranks}
+    if not dead:
+        raise MeshShrinkError("no dead ranks to excise")
+    in_range = sorted(r for r in dead if 0 <= r < size)
+    extra = len(dead) - len(in_range)
+    slots = [i for i in range(size) if i not in in_range]
+    if extra:  # ranks we can't map onto the axis still each cost a slot
+        slots = slots[:max(0, len(slots) - extra)]
+    if not slots:
+        raise MeshShrinkError(
+            f"all {size} '{batch_axis}' slots lost ranks; no survivors "
+            "to rebuild a mesh from")
+    new_size = 1 << (len(slots).bit_length() - 1)
+    if new_size >= size:
+        raise MeshShrinkError(
+            f"'{batch_axis}' cannot shrink below its current size {size}")
+    devices = np.take(mesh.devices, slots[:new_size], axis=axis)
+    return Mesh(devices, tuple(names))
 
 
 def default_mesh(n_devices=None):

@@ -37,18 +37,22 @@ class ShardedTrainer:
         (or 'float16') casts params/activations for forward+backward —
         fp32 master weights and optimizer state, bf16 MXU math — the TPU
         counterpart of the reference's AMP (contrib/amp/amp.py:251).
+    checkpoint_manager : resilience.CheckpointManager, optional — arms
+        the elastic mesh-shrink resume: a PeerLostError raised inside
+        ``step`` is survived by rebuilding a smaller mesh from the
+        surviving ranks and reloading the latest reshardable checkpoint
+        onto it (docs/resilience.md). Without one, a dead peer stays
+        terminal. ``enable_recovery`` attaches it after construction.
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_rules=(), batch_axis_name="dp",
-                 dtype=None, remat=None):
+                 dtype=None, remat=None, checkpoint_manager=None):
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..remat import mirror_enabled, resolve_policy
 
         self.net = net
-        self.mesh = mesh if mesh is not None else create_mesh()
         self.loss_fn = loss_fn
         self._fwd = functional_call(net, train=True)
         # remat: False disables, None follows MXNET_BACKWARD_DO_MIRROR,
@@ -70,28 +74,53 @@ class ShardedTrainer:
         self._update = update
         self._rules = [(re.compile(pat), spec) for pat, spec in param_rules]
         self._batch_axis = batch_axis_name
-
-        def spec_for(name):
-            for pat, spec in self._rules:
-                if pat.match(name):
-                    return spec
-            return P()
-
-        self._param_sharding = {
-            k: NamedSharding(self.mesh, spec_for(k)) for k in self.params}
-        repl = NamedSharding(self.mesh, P())
-        self._aux_sharding = {k: repl for k in self.aux}
-        self._batch_sharding = NamedSharding(self.mesh, P(batch_axis_name))
-        self._multiproc = self._is_multiprocess()
+        # elastic recovery (resilience.elastic): the manager the
+        # mesh-shrink resume reloads state from on PeerLostError; without
+        # one, a dead peer stays terminal (enable_recovery attaches late)
+        self._ckpt_mgr = checkpoint_manager
+        self.last_recovery = None
+        self._bind_mesh(mesh if mesh is not None else create_mesh())
         self._place()
-        self._step = None
         # elastic execution state (resilience.elastic): current sticky
-        # accumulation count, the grad/apply executables it uses, and a
-        # monotonically increasing step counter for crash reports
+        # accumulation count and a monotonically increasing step counter
+        # for crash reports (the executables live in _bind_mesh state)
         self._elastic_n = 1
+        self._step_count = 0
+
+    def _spec_for(self, name):
+        from jax.sharding import PartitionSpec as P
+
+        for pat, spec in self._rules:
+            if pat.match(name):
+                return spec
+        return P()
+
+    def _bind_mesh(self, mesh):
+        """(Re)derive every mesh-dependent binding — NamedShardings for
+        params/aux/batch, the multi-process flag, and the compiled step/
+        elastic executables (invalidated: they bake the old mesh in).
+        Used at construction and by the peer-loss mesh-shrink resume;
+        does NOT move any arrays (placement is _place or a restore)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self._param_sharding = {
+            k: NamedSharding(mesh, self._spec_for(k)) for k in self.params}
+        repl = NamedSharding(mesh, P())
+        self._aux_sharding = {k: repl for k in self.aux}
+        self._batch_sharding = NamedSharding(mesh, P(self._batch_axis))
+        self._multiproc = self._is_multiprocess()
+        self._step = None
         self._grads_fn = None
         self._apply_fn = None
-        self._step_count = 0
+
+    def enable_recovery(self, checkpoint_manager):
+        """Attach the CheckpointManager the elastic mesh-shrink resume
+        reloads state from when a peer dies (docs/resilience.md). The
+        manager should already hold (or be about to receive) reshardable
+        v2 checkpoints of THIS trainer. Returns self for chaining."""
+        self._ckpt_mgr = checkpoint_manager
+        return self
 
     def _place(self):
         import numpy as np
@@ -302,6 +331,16 @@ class ShardedTrainer:
         accumulation until it fits; the shrink is sticky for subsequent
         steps. The whole step runs under the step watchdog
         (MXNET_TPU_WATCHDOG_STEP_TIMEOUT).
+
+        With a checkpoint manager attached (``checkpoint_manager=`` /
+        ``enable_recovery``), a ``PeerLostError`` raised here — the
+        ``peer_death`` fault, ``watchdog.mark_peer_dead``, or a
+        collective stall with known-dead ranks — is survived in place:
+        the mesh shrinks to the survivors, the latest reshardable
+        checkpoint reloads onto it, sticky accumulation re-arms, and
+        THIS batch re-runs (``last_recovery`` carries the restored
+        manifest so schedule-aware drivers can rewind their data
+        pipeline when the checkpoint cadence is coarser than one step).
         """
         import warnings
 
@@ -348,6 +387,16 @@ class ShardedTrainer:
         _watchdog.note_step(self._step_count)
         rows = int(x.shape[0])
         shards = int(self.mesh.shape.get(self._batch_axis, 1))
+
+        def fit_count(k):
+            # largest accumulation count <= k that divides the batch into
+            # whole microbatches splittable over the CURRENT dp shards
+            # (a short tail batch, or a just-shrunk mesh, must fall back,
+            # never drop rows)
+            while k > 1 and (rows % k or (rows // k) % max(1, shards)):
+                k //= 2
+            return max(1, k)
+
         if microbatches is not None:
             n = int(microbatches)
             if n < 1 or rows % n or (rows // n) % max(1, shards):
@@ -357,12 +406,8 @@ class ShardedTrainer:
                     f"{shards} dp shard(s); accumulation must never "
                     "silently drop tail rows")
         else:
-            # sticky n was validated against the batch size that OOMed;
-            # a different batch (e.g. the epoch's short tail) must fall
-            # back to the largest compatible count, never drop rows
-            n = self._elastic_n
-            while n > 1 and (rows % n or (rows // n) % max(1, shards)):
-                n //= 2
+            # sticky n was validated against the batch size that OOMed
+            n = fit_count(self._elastic_n)
         while True:
             try:
                 # one guard per ATTEMPT: a legitimate elastic retry
@@ -372,15 +417,39 @@ class ShardedTrainer:
                 with _watchdog.guard("step",
                                      detail="parallel.ShardedTrainer.step",
                                      step=self._step_count):
+                    _watchdog.check_peers(
+                        detail="parallel.ShardedTrainer.step")
                     _faults.maybe_hang("hang_step")
                     _faults.maybe_oom_step()
                     if n <= 1:
+                        if self._step is None:  # mesh rebound mid-retry
+                            self._build_step()
                         self.params, self.aux, self.opt_state, loss = \
                             self._step(self.params, self.aux,
                                        self.opt_state, x, y)
                     else:
                         loss = self._accum_step(n, x, y)
                 break
+            except _watchdog.PeerLostError as e:
+                # a dead peer is unrecoverable in place — but with a
+                # checkpoint manager attached the run survives it: shrink
+                # the mesh to the survivors, reload the latest
+                # reshardable checkpoint onto it, and re-run this batch
+                if self._ckpt_mgr is None or self._multiproc \
+                        or not _elastic.mesh_shrink_enabled():
+                    raise
+                x, y = self._recover_peer_loss(e, x, y)
+                shards = int(self.mesh.shape.get(self._batch_axis, 1))
+                if microbatches is not None:
+                    if rows % n or (rows // n) % max(1, shards):
+                        raise ValueError(
+                            f"explicit microbatches={n} no longer splits "
+                            f"the {rows}-row batch over the shrunk "
+                            f"{shards}-shard mesh; request a compatible "
+                            "schedule") from e
+                else:
+                    n = fit_count(max(n, self._elastic_n))
+                continue
             except Exception as e:
                 if microbatches is not None \
                         or not (_elastic.enabled()
@@ -432,6 +501,62 @@ class ShardedTrainer:
                     "restore from the last checkpoint "
                     "(resilience.CheckpointManager.restore_latest)"
                 ) from cause
+
+    def _recover_peer_loss(self, err, x, y):
+        """Mesh-shrink resume: rebuild a smaller mesh from the surviving
+        ranks, reload the latest (reshardable, v2) checkpoint onto it,
+        re-arm the sticky elastic accumulation so the per-device
+        microbatch stays where it last fit, and return the batch
+        re-placed for the new mesh so the caller retries this step.
+        The recovery is logged, counted (``watchdog_peer_recoveries``,
+        ``elastic_mesh_shrinks``), and stamped into the crash report
+        (``watchdog.note_peer_recovery``). Raises if no viable smaller
+        mesh or no valid checkpoint exists — then the PeerLostError was
+        genuinely terminal."""
+        import warnings
+
+        import jax
+
+        from ..resilience import elastic as _elastic
+        from ..resilience import watchdog as _watchdog
+        from .mesh import MeshShrinkError, shrink_mesh
+
+        dead = _watchdog.dead_peers() or list(getattr(err, "ranks", ()))
+        old_axes = {str(a): int(s) for a, s in
+                    zip(self.mesh.axis_names, self.mesh.devices.shape)}
+        try:
+            new_mesh = shrink_mesh(self.mesh, dead,
+                                   batch_axis=self._batch_axis)
+        except MeshShrinkError:
+            raise err  # nothing viable left: the loss really is terminal
+        old_dp = int(old_axes.get(self._batch_axis, 1))
+        new_axes = {str(a): int(s) for a, s in
+                    zip(new_mesh.axis_names, new_mesh.devices.shape)}
+        new_dp = int(new_axes.get(self._batch_axis, 1))
+        self._bind_mesh(new_mesh)
+        # the excised ranks are no longer part of the job: re-admit the
+        # collectives (kvstore guards included) before the restore's
+        # device_puts and the retried step
+        _watchdog.reset_peers()
+        manifest = self._ckpt_mgr.restore_latest(trainer=self)
+        if manifest is None:
+            raise RuntimeError(
+                f"peer rank(s) {dead} lost and no valid checkpoint exists "
+                f"to reload onto the shrunk {new_dp}-shard mesh; cannot "
+                "recover") from err
+        self._elastic_n = _elastic.rearm_microbatches(
+            self._elastic_n, old_dp, new_dp)
+        _elastic._STATS["elastic_mesh_shrinks"] += 1
+        _watchdog.note_peer_recovery(err, manifest, old_axes, new_axes)
+        self.last_recovery = manifest
+        warnings.warn(
+            f"peer rank(s) {dead} lost: resumed from checkpoint step "
+            f"{manifest.get('step')} on a mesh shrunk "
+            f"{old_dp} -> {new_dp} '{self._batch_axis}' shard(s); "
+            "this step re-runs on the survivors (capacity is reduced — "
+            "see the crash report)")
+        bs = self._batch_sharding
+        return jax.device_put(x, bs), jax.device_put(y, bs)
 
     def _build_elastic(self):
         """Two executables for the accumulated path: a NON-donating
@@ -527,11 +652,22 @@ class ShardedTrainer:
 
         import numpy as np
 
+        f = np.load(io.BytesIO(data), allow_pickle=False)
+        self.set_states_arrays({k: f[k] for k in f.files})
+
+    def set_states_arrays(self, mapping):
+        """Restore opt_state from a {keystr: host array} mapping (the
+        form v2 reshardable checkpoints reassemble shard payloads into).
+        Each leaf is re-placed with THIS trainer's NamedSharding on its
+        CURRENT mesh — which is exactly how checkpoint state saved on a
+        different dp-shard count lands correctly after a mesh shrink.
+        Validates the mapping covers the opt_state tree exactly."""
+        import numpy as np
+
         import jax
         import jax.numpy as jnp
 
-        f = np.load(io.BytesIO(data), allow_pickle=False)
-        stored = {k: f[k] for k in f.files}
+        stored = dict(mapping)
         shardings = self._opt_sharding()
 
         def restore(path, leaf, sh):

@@ -166,8 +166,12 @@ def dispatch_stats(reset=False):
       sentinel_nonfinite/sentinel_grad_norm_trips/sentinel_rollbacks,
       health_skipped_steps (sentinel skips + AMP overflow skips, one
       shared series), ckpt_saves/ckpt_restores/ckpt_restore_skipped,
-      faults_armed/faults_fired, watchdog_guards/stalls/crash_reports/
-      rollbacks/peer_lost, elastic_oom_events/shrinks/accum_steps
+      ckpt_async_saves/ckpt_async_waits/ckpt_async_failures (background
+      checkpoint writer: launches, next-save barrier waits, dropped
+      writes), faults_armed/faults_fired, watchdog_guards/stalls/
+      crash_reports/rollbacks/peer_lost, watchdog_peer_recoveries (peer
+      losses survived by mesh shrink), elastic_oom_events/shrinks/
+      accum_steps, elastic_mesh_shrinks
     - serving counters (docs/serving.md): serving_requests/batches/
       batch_samples/padded_samples (pad waste), bucket hits/misses/
       compiles, shed_deadline/shed_overload, poisoned_batches,

@@ -2,16 +2,20 @@
 
 Five cooperating pieces (docs/resilience.md):
 
-- :class:`CheckpointManager` — atomic, versioned, CRC-verified
-  checkpoints with retention and verified fall-back restore;
+- :class:`CheckpointManager` — atomic, versioned, CRC-verified,
+  *reshardable* (format v2) checkpoints with async background publish,
+  retention, and verified fall-back restore — state saved on one mesh
+  topology restores onto another;
 - :class:`HealthSentinel` — per-step NaN/Inf + grad-norm watchdog with
   ``raise | skip_batch | rollback`` policies;
 - :mod:`watchdog` — stall watchdog ("no step may block forever"):
   per-phase deadlines around step/collective/batch execution, crash
   reports, peer-liveness bookkeeping (:class:`StallError`,
   :class:`PeerLostError`);
-- :mod:`elastic` — elastic step retry: a ``RESOURCE_EXHAUSTED`` step
-  transparently re-executes as N accumulated microbatches;
+- :mod:`elastic` — elastic step retry and elastic topology: a
+  ``RESOURCE_EXHAUSTED`` step transparently re-executes as N
+  accumulated microbatches, and a lost peer is survived by the
+  mesh-shrink resume (smaller mesh + reshardable checkpoint reload);
 - :mod:`faults` — deterministic fault-injection harness used by the
   test suite (and ``tools/chaos_run.py`` drills) to prove the above
   actually work.

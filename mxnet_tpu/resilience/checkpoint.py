@@ -1,4 +1,4 @@
-"""Atomic, versioned training checkpoints.
+"""Atomic, versioned, *reshardable* training checkpoints.
 
 The durability contract (the property MXNet's multi-day training runs
 leaned on via checkpoint callbacks, and TensorFlow formalized in its
@@ -7,35 +7,72 @@ fault-tolerance design):
 - a checkpoint is either fully present and internally consistent, or it
   does not exist — payloads are written into a hidden temp directory,
   fsynced, stamped with CRC32s in a manifest written last, and published
-  with a single directory rename;
+  with a single directory rename (followed by a parent-directory fsync,
+  so the publish survives power loss, not just process death);
 - ``restore_latest`` never trusts a checkpoint it cannot verify: missing
-  manifest, size or CRC mismatch, or unreadable payload makes it fall
-  back to the next older checkpoint;
+  manifest, size or CRC mismatch, or unreadable payload — of the
+  manifest OR of any individual shard file — makes it fall back to the
+  next older checkpoint;
 - a restore is bitwise: parameters, optimizer/trainer state, the global
   RNG key, and the AMP loss-scaler state all round-trip exactly, so a
   killed job resumes as if it never died.
 
-Layout under ``directory``::
+Format v2 (this module's writer; v1 ``params.npz`` checkpoints still
+restore) decouples the saved state from the topology that saved it::
 
     ckpt-00000042/
-        manifest.json      # step/epoch/rng/scaler + per-file crc32/size
-        params.npz         # parameters (+ aux state for sharded trainers)
-        trainer.state      # optimizer state (Updater pickle or opt_state npz)
+        manifest.json      # step/epoch/rng/scaler + per-ARRAY records:
+                           #   logical shape, dtype, sharding spec, and
+                           #   per-shard-file {index, crc32, size}
+        arrays/00000-000.bin   # one raw-bytes payload per unique shard
+        trainer.state      # gluon Updater pickle (eager trainer only —
+                           #   sharded opt_state lives in arrays/)
 
-Works with both trainer flavors: the eager ``gluon.Trainer`` (sharded or
-not — via its states-bytes API) and the pjit-ed ``parallel.ShardedTrainer``
-(params/aux/opt_state pytrees re-placed onto the mesh with their original
-NamedShardings on restore). Multi-host note: the manager is a per-process
-writer; on a multi-process mesh have rank 0 save (replicated state) or
-point each rank at its own directory.
+Because the manifest records each array's LOGICAL shape plus the index
+range every shard file covers, ``restore()`` reassembles the full value
+on the host and re-places it through the *restoring* trainer's
+``NamedSharding`` — so state saved on a dp=8 mesh restores onto dp=4,
+dp=2, or back onto dp=8 without assuming the saved topology (the
+elastic mesh-shrink resume in parallel/trainer.py is built on this).
+
+``save(..., async_=True)`` snapshots device arrays to host and
+publishes through the same temp-dir+rename protocol on a background
+writer; the next save (or any restore) barriers on the in-flight
+write. Two writer modes (``MXNET_TPU_CKPT_ASYNC_MODE``):
+
+- ``fork`` (auto-selected on a CPU backend): the BGSAVE trick — device
+  buffers on the CPU backend are plain host memory, so the snapshot is
+  zero-copy numpy views plus one ``fork()``; kernel copy-on-write
+  isolates the child writer from every subsequent (donating) training
+  step, and the step loop stalls only for the fork itself;
+- ``thread`` (auto-selected on real accelerators, where fork would
+  orphan the runtime's threads): the snapshot is an explicit host copy
+  (chunked parallel memcpy — on TPU this is the unavoidable d2h
+  transfer), then a daemon thread serializes and publishes.
+
+Either way a writer killed mid-flight leaves only temp-dir debris the
+startup/next-save GC already removes — never a half-published
+checkpoint — and ``keep_n`` retention never deletes a checkpoint that
+an active restore or in-flight async publish holds pinned.
+``tools/ckpt_bench.py`` gates the async step stall at <= 10% of the
+sync save cost at 25M params.
+
+Works with both trainer flavors: the eager ``gluon.Trainer`` (via its
+states-bytes API) and the pjit-ed ``parallel.ShardedTrainer``
+(params/aux/opt_state re-placed onto the mesh with the trainer's own
+NamedShardings on restore). Multi-host note: the manager is a
+per-process writer; on a multi-process mesh have rank 0 save
+(replicated state) or point each rank at its own directory.
 """
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import os
 import re
 import shutil
+import threading
 import zlib
 
 import numpy as _np
@@ -45,12 +82,42 @@ from . import faults
 __all__ = ["CheckpointManager", "CheckpointCorruptError", "atomic_write_bytes"]
 
 _MANIFEST = "manifest.json"
-_PARAMS = "params.npz"
+_PARAMS = "params.npz"      # v1 payload name (read-side compatibility)
 _TRAINER = "trainer.state"
-_FORMAT_VERSION = 1
+_ARRAYS_DIR = "arrays"
+_FORMAT_VERSION = 2
 
 _STATS = {"ckpt_saves": 0, "ckpt_save_failures": 0, "ckpt_restores": 0,
-          "ckpt_restore_skipped": 0, "ckpt_pruned": 0}
+          "ckpt_restore_skipped": 0, "ckpt_pruned": 0,
+          "ckpt_async_saves": 0, "ckpt_async_waits": 0,
+          "ckpt_async_failures": 0}
+
+# Managers with a possibly-in-flight async writer. A daemon writer
+# thread would be killed mid-write by normal interpreter exit, silently
+# losing the run's FINAL checkpoint (its temp debris then looks like any
+# dead writer's and is GC'd) — so process exit barriers on every
+# in-flight async save. Fork-mode children are separate processes and
+# finish on their own; the barrier just reaps + reports them.
+_LIVE_MANAGERS = None
+
+
+def _barrier_all_at_exit():
+    for mgr in list(_LIVE_MANAGERS or ()):
+        try:
+            mgr.wait_for_async()
+        except Exception:
+            pass
+
+
+def _track_manager(mgr):
+    global _LIVE_MANAGERS
+    if _LIVE_MANAGERS is None:
+        import atexit
+        import weakref
+
+        _LIVE_MANAGERS = weakref.WeakSet()
+        atexit.register(_barrier_all_at_exit)
+    _LIVE_MANAGERS.add(mgr)
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -69,8 +136,8 @@ def reset_stats():
 def atomic_write_bytes(path, data, _fsync=True):
     """Crash-safe byte write: temp file in the same directory + fsync +
     rename. All checkpoint payloads (and Trainer.save_states) route
-    through here, which is also the fault-injection point for ENOSPC and
-    partial-write simulation."""
+    through here, which is also the fault-injection point for ENOSPC,
+    partial-write, and shard-corruption simulation."""
     path = os.fspath(path)
     data = faults.checkpoint_write_filter(path, data)
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -112,12 +179,6 @@ def _fsync_dir(path):
         pass
     finally:
         os.close(fd)
-
-
-def _npz_bytes(entries):
-    buf = io.BytesIO()
-    _np.savez(buf, **entries)
-    return buf.getvalue()
 
 
 def _is_sharded_trainer(trainer):
@@ -173,15 +234,115 @@ def _restore_scaler(trainer, state):
     scaler._unskipped = state["unskipped"]
 
 
+# --------------------------------------------------------- array <-> shards
+
+def _host_copy(view):
+    """Owned host copy of an array(-like). Device arrays must be COPIED at
+    snapshot time — np.asarray of a CPU jax buffer is a zero-copy view,
+    and the next training step may donate (delete) the buffer under it.
+    Large copies split across two threads (numpy releases the GIL for
+    contiguous memcpy), roughly halving the stall an async save imposes
+    on the step loop."""
+    view = _np.asarray(view)
+    if view.nbytes < (1 << 23) or view.ndim == 0 \
+            or not view.flags.c_contiguous:
+        return _np.array(view, copy=True)
+    dst = _np.empty_like(view)
+    mid = view.shape[0] // 2
+    if mid == 0:
+        return _np.array(view, copy=True)
+    t = threading.Thread(target=_np.copyto, args=(dst[mid:], view[mid:]))
+    t.start()
+    _np.copyto(dst[:mid], view[:mid])
+    t.join()
+    return dst
+
+
+def _norm_index(index, shape):
+    """Normalize a jax shard index (tuple of slices) to nested
+    ((start, stop), ...) pairs covering the shard's extent."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _full_index(shape):
+    return tuple((0, int(d)) for d in shape)
+
+
+def _spec_to_json(sharding):
+    """PartitionSpec -> JSON (entry: null | axis | [axes...]); None for
+    host arrays with no sharding. Recorded for forensics/tooling — the
+    restore path re-places through the restoring trainer's shardings."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, (tuple, list)) else e
+            for e in tuple(spec)]
+
+
+def _unique_shards(value, copy=True):
+    """[(index, host-array)] covering ``value`` — one entry per UNIQUE
+    shard (a replicated array yields a single full-extent entry), so the
+    payload bytes scale with the logical array, not the device count.
+    Host/numpy values yield one full-extent entry.
+
+    ``copy=True`` returns owned copies (required whenever the arrays
+    outlive this snapshot in the same address space — a later step may
+    donate the buffers under a zero-copy view). ``copy=False`` returns
+    views — only safe when copy-on-write isolation follows immediately
+    (the fork-mode async writer)."""
+    import jax
+
+    take = _host_copy if copy else _np.asarray
+    if isinstance(value, jax.Array) and hasattr(value, "addressable_shards"):
+        seen = {}
+        for s in value.addressable_shards:
+            idx = _norm_index(s.index, value.shape)
+            if idx not in seen:
+                seen[idx] = take(s.data)
+        return sorted(seen.items())
+    arr = take(value)
+    return [(_full_index(arr.shape), arr)]
+
+
+def _async_mode():
+    """Resolve the async writer mode (``MXNET_TPU_CKPT_ASYNC_MODE``:
+    ``fork`` | ``thread`` | ``auto``). Auto picks fork exactly where it
+    is both safe and free: POSIX with a pure-CPU jax backend (device
+    buffers are host memory, so the snapshot is zero-copy views + COW;
+    forking a real TPU/GPU runtime would orphan its driver threads)."""
+    mode = os.environ.get("MXNET_TPU_CKPT_ASYNC_MODE", "auto").strip().lower()
+    if mode in ("fork", "thread"):
+        return mode
+    if not hasattr(os, "fork"):
+        return "thread"
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            return "thread"
+    except Exception:
+        return "thread"
+    return "fork"
+
+
 class CheckpointManager:
-    """Atomic versioned checkpoints with retention and verified restore.
+    """Atomic versioned checkpoints with retention, verified restore,
+    cross-topology (reshardable) state, and async publish.
 
     Parameters
     ----------
-    directory : str — checkpoint root (created on first save)
+    directory : str — checkpoint root (created on first save; orphaned
+        temp dirs from dead writers are GC'd at construction).
     keep_n : int — retain at most this many published checkpoints
         (oldest pruned after each successful save; env default
         ``MXNET_TPU_CKPT_KEEP``, fallback 5). ``keep_n <= 0`` keeps all.
+        Checkpoints pinned by an active restore or an in-flight async
+        publish are never pruned.
     prefix : str — checkpoint directory name prefix.
     """
 
@@ -191,6 +352,14 @@ class CheckpointManager:
             keep_n = int(os.environ.get("MXNET_TPU_CKPT_KEEP", "5"))
         self.keep_n = int(keep_n)
         self.prefix = prefix
+        self._async = None           # in-flight async save bookkeeping
+        self._pins = {}              # path -> refcount (prune exclusion)
+        self._pin_lock = threading.Lock()
+        if os.path.isdir(self.directory):
+            try:
+                self._gc_debris()    # startup GC: orphaned (a)sync temp
+            except OSError:          # dirs from a previous dead process
+                pass
 
     # ------------------------------------------------------------- listing
 
@@ -230,12 +399,13 @@ class CheckpointManager:
         except (OSError, ValueError) as e:
             raise CheckpointCorruptError(
                 f"{path}: unreadable manifest ({e})") from e
-        if manifest.get("format_version") != _FORMAT_VERSION:
+        version = manifest.get("format_version")
+        if version not in (1, _FORMAT_VERSION):
             raise CheckpointCorruptError(
-                f"{path}: unsupported format_version "
-                f"{manifest.get('format_version')!r}")
+                f"{path}: unsupported format_version {version!r}")
         payloads = {}
-        for fname, meta in manifest.get("files", {}).items():
+
+        def check_file(fname, meta):
             fpath = os.path.join(path, fname)
             try:
                 with open(fpath, "rb") as f:
@@ -251,67 +421,326 @@ class CheckpointManager:
                 raise CheckpointCorruptError(
                     f"{path}: {fname} failed CRC32 integrity check")
             payloads[fname] = data
+
+        # field-level manifest damage (bitrot that still parses as JSON)
+        # must fall back like every other corruption, not crash restore
+        try:
+            for fname, meta in manifest.get("files", {}).items():
+                check_file(fname, meta)
+            for key, rec in manifest.get("arrays", {}).items():
+                dtype = _np.dtype(rec["dtype"])
+                for shard in rec["shards"]:
+                    extent = 1
+                    for a, b in shard["index"]:
+                        extent *= max(0, int(b) - int(a))
+                    if extent * dtype.itemsize != shard["size"]:
+                        raise CheckpointCorruptError(
+                            f"{path}: array '{key}' shard {shard['file']} "
+                            f"covers {extent} x {dtype.itemsize}B but "
+                            f"records {shard['size']} bytes")
+                    check_file(shard["file"], shard)
+        except (KeyError, TypeError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{path}: malformed manifest record "
+                f"({type(e).__name__}: {e})") from e
         return manifest, payloads
 
     def latest_valid(self):
         """(step, path, manifest) of the newest checkpoint that passes
         verification, or None. Corrupt/partial checkpoints are skipped
-        with a warning (counted in ``ckpt_restore_skipped``)."""
+        with a warning (counted in ``ckpt_restore_skipped``). Barriers
+        on any in-flight async save first."""
         import warnings
 
+        self.wait_for_async()
         for step, path in reversed(self.list_checkpoints()):
             try:
-                return step, path, self.verify(path)
+                with self._pin(path):
+                    return step, path, self.verify(path)
             except CheckpointCorruptError as e:
                 _STATS["ckpt_restore_skipped"] += 1
                 warnings.warn(f"skipping corrupt checkpoint: {e}")
         return None
 
+    # ---------------------------------------------------------------- pins
+
+    @contextlib.contextmanager
+    def _pin(self, path):
+        """Exclude ``path`` from retention pruning for the duration of
+        the block (active restores and in-flight async publishes must
+        never have the directory deleted under them)."""
+        with self._pin_lock:
+            self._pins[path] = self._pins.get(path, 0) + 1
+        try:
+            yield
+        finally:
+            with self._pin_lock:
+                n = self._pins.get(path, 1) - 1
+                if n <= 0:
+                    self._pins.pop(path, None)
+                else:
+                    self._pins[path] = n
+
     # ---------------------------------------------------------------- save
 
-    def save(self, step, net=None, trainer=None, epoch=None, extra=None):
+    def save(self, step, net=None, trainer=None, epoch=None, extra=None,
+             async_=False):
         """Write one checkpoint atomically; returns its published path.
 
         Snapshots, as available: ``net`` parameters (or the sharded
-        trainer's params+aux), ``trainer`` optimizer state (gluon Trainer
-        or parallel ShardedTrainer), the global RNG key, and the attached
-        AMP loss-scaler state. On any failure the previous checkpoints
-        are untouched.
+        trainer's params+aux+opt_state), ``trainer`` optimizer state
+        (gluon Trainer or parallel ShardedTrainer), the global RNG key,
+        and the attached AMP loss-scaler state. On any failure the
+        previous checkpoints are untouched.
+
+        ``async_=True`` returns as soon as device state is snapshotted
+        (fork mode: zero-copy views + a COW ``fork()``; thread mode: an
+        explicit host copy — the stall is gated at <= 10% of the sync
+        save cost by tools/ckpt_bench.py); CRC stamping, disk writes,
+        fsync, and the atomic publish run on the background writer. The
+        next ``save``/``restore_latest`` barriers on the in-flight write
+        (``wait_for_async``); a failed or crashed writer is reported
+        there as a warning plus the ``ckpt_async_failures`` counter — it
+        never corrupts previous checkpoints.
         """
         if net is None and trainer is None:
             raise ValueError("save() needs a net and/or a trainer")
+        self.wait_for_async()
         os.makedirs(self.directory, exist_ok=True)
         self._gc_debris()
         tag = self._tag(step)
         final = os.path.join(self.directory, tag)
+        if not async_:
+            # a synchronous save completes before the caller can run
+            # another (donating) step, so zero-copy views are safe —
+            # the writer's tobytes() is the one unavoidable copy
+            snap = self._snapshot(step, net, trainer, epoch, extra, tag,
+                                  copy=False)
+            return self._write_snapshot(snap, tag, final)
+        mode = _async_mode()
+        snap = self._snapshot(step, net, trainer, epoch, extra, tag,
+                              copy=(mode != "fork"))
+        _STATS["ckpt_async_saves"] += 1
+        _track_manager(self)  # exit barrier: never lose the final save
+        if mode == "fork":
+            self._fork_writer(snap, tag, final)
+        else:
+            info = {"tag": tag, "final": final, "error": None,
+                    "pid": None, "fd": None, "thread": None}
+            thread = threading.Thread(
+                target=self._thread_write, args=(snap, tag, final, info),
+                name="mxnet-tpu-ckpt-writer", daemon=True)
+            info["thread"] = thread
+            self._async = info
+            thread.start()
+        return final
+
+    def _fork_writer(self, snap, tag, final):
+        """BGSAVE-style writer: fork, let kernel copy-on-write isolate
+        the child's view of every buffer from the parent's subsequent
+        (donating) steps, and serialize+publish in the child. The child
+        NEVER touches jax (its runtime threads don't survive a fork) —
+        the snapshot is already plain numpy views — and reports through
+        a pipe, exiting via ``os._exit`` so no parent-side teardown
+        (atexit, buffered stdio) runs twice."""
+        import warnings
+
+        rfd, wfd = os.pipe()
+        with warnings.catch_warnings():
+            # jax warns that fork + its runtime threads may deadlock —
+            # true for a child that re-enters jax, which this one never
+            # does: the snapshot is plain numpy views and the child only
+            # runs zlib/os/json before _exit. Thread mode remains the
+            # fallback for anyone who disagrees
+            # (MXNET_TPU_CKPT_ASYNC_MODE=thread).
+            warnings.filterwarnings("ignore", category=RuntimeWarning,
+                                    message=".*fork.*")
+            pid = os.fork()
+        if pid == 0:
+            status = b"err:unknown"
+            try:
+                os.close(rfd)
+                try:
+                    self._write_snapshot(snap, tag, final, is_async=True,
+                                         in_child=True)
+                    status = b"ok"
+                except faults.SimulatedCrash as e:
+                    status = f"crash:{e}".encode()  # debris stays for GC
+                except BaseException as e:
+                    status = f"err:{type(e).__name__}: {e}".encode()
+                try:
+                    os.write(wfd, status[:4096])
+                    os.close(wfd)
+                except OSError:
+                    pass
+            finally:
+                os._exit(0)
+        os.close(wfd)
+        self._async = {"tag": tag, "final": final, "error": None,
+                       "pid": pid, "fd": rfd, "thread": None}
+
+    def wait_for_async(self, timeout=None):
+        """Barrier on the in-flight async save, if any. Returns True when
+        there was nothing pending or the write published successfully;
+        False (plus a warning and ``ckpt_async_failures``) when the
+        writer failed or crashed — its debris is left for the GC exactly
+        like a killed process's."""
+        import time as _time
+        import warnings
+
+        info = self._async
+        if info is None:
+            return True
+        error = None
+        if info["pid"] is not None:
+            _STATS["ckpt_async_waits"] += 1
+            if timeout is None:
+                os.waitpid(info["pid"], 0)
+            else:
+                deadline = _time.monotonic() + timeout
+                while True:
+                    pid, _ = os.waitpid(info["pid"], os.WNOHANG)
+                    if pid:
+                        break
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"async checkpoint {info['tag']} still "
+                            f"writing after {timeout}s")
+                    _time.sleep(0.005)
+            try:
+                status = os.read(info["fd"], 4096)
+            except OSError:
+                status = b""
+            finally:
+                os.close(info["fd"])
+            if status == b"ok":
+                # the child's counters/pins died with it: account for the
+                # publish and apply retention in the parent
+                _STATS["ckpt_saves"] += 1
+                self._prune()
+            else:
+                # empty status == the writer was killed outright (the
+                # real SIGKILL case the debris GC exists for)
+                _STATS["ckpt_save_failures"] += 1
+                error = (status.decode(errors="replace")
+                         or "writer process killed before publishing")
+        else:
+            thread = info["thread"]
+            if thread is not None and thread.is_alive():
+                _STATS["ckpt_async_waits"] += 1
+                thread.join(timeout)
+                if thread.is_alive():
+                    raise TimeoutError(
+                        f"async checkpoint {info['tag']} still writing "
+                        f"after {timeout}s")
+            if info.get("error") is not None:
+                error = repr(info["error"])
+        self._async = None
+        if error is not None:
+            _STATS["ckpt_async_failures"] += 1
+            warnings.warn(
+                f"async checkpoint {info['tag']} failed and was dropped "
+                f"({error}); previous checkpoints are intact")
+            return False
+        return True
+
+    def _thread_write(self, snap, tag, final, info):
+        try:
+            self._write_snapshot(snap, tag, final, is_async=True)
+        except BaseException as e:  # incl. SimulatedCrash: debris stays
+            info["error"] = e
+
+    def _snapshot(self, step, net, trainer, epoch, extra, tag, copy=True):
+        """Host-side snapshot of everything the checkpoint will persist
+        — after this returns, the writer never touches device state, so
+        an async publish is isolated from subsequent (donating) steps.
+        ``copy=False`` (fork mode) takes zero-copy views instead of
+        owned copies; the fork's COW provides the isolation."""
+        kind = "sharded" if _is_sharded_trainer(trainer) else "gluon"
+        arrays = []  # [(key, dtype_str, shape, spec_json, [(index, np)])]
+
+        def add(key, value, sharding=None):
+            shards = _unique_shards(value, copy=copy)
+            first = shards[0][1]
+            arrays.append((key, _np.dtype(first.dtype).str,
+                           tuple(int(d) for d in _np.shape(value)),
+                           _spec_to_json(sharding), shards))
+
+        trainer_bytes = None
+        mesh_axes = None
+        if kind == "sharded":
+            import jax
+
+            for name, v in trainer.params.items():
+                add(f"param:{name}", v, trainer._param_sharding.get(name))
+            for name, v in trainer.aux.items():
+                add(f"aux:{name}", v, trainer._aux_sharding.get(name))
+            flat_state = jax.tree_util.tree_flatten_with_path(
+                trainer.opt_state)[0]
+            flat_shard = jax.tree_util.tree_flatten_with_path(
+                trainer._opt_sharding())[0]
+            for (pth, leaf), (_, sh) in zip(flat_state, flat_shard):
+                add(f"opt:{jax.tree_util.keystr(pth)}", leaf, sh)
+            mesh = trainer.mesh
+            mesh_axes = {str(n): int(s) for n, s in
+                         zip(mesh.axis_names, mesh.devices.shape)}
+        else:
+            if net is not None:
+                for name, p in _net_param_map(net).items():
+                    v = p.data().data_ if hasattr(p, "data") else p
+                    add(f"param:{name}", v)
+            if trainer is not None:
+                trainer_bytes = trainer.get_states_bytes()
+        return {"kind": kind, "arrays": arrays,
+                "trainer_bytes": trainer_bytes,
+                "manifest": {"format_version": _FORMAT_VERSION,
+                             "kind": kind,
+                             "step": int(step),
+                             "epoch": None if epoch is None else int(epoch),
+                             "tag": tag,
+                             "rng_key": _rng_state(),
+                             "loss_scaler": _scaler_state(trainer),
+                             "mesh_axes": mesh_axes,
+                             "extra": extra or {}}}
+
+    def _write_snapshot(self, snap, tag, final, is_async=False,
+                        in_child=False):
+        """Serialize an already-snapshotted state to disk and publish it
+        atomically (runs on the caller thread for sync saves, on the
+        background writer thread/process for async ones; ``in_child``
+        skips counters and retention — the forked child's memory dies
+        with it, so the parent accounts at the barrier instead)."""
         tmpdir = os.path.join(self.directory, f".{tag}.tmp.{os.getpid()}")
         if os.path.isdir(tmpdir):
             shutil.rmtree(tmpdir)
-        os.makedirs(tmpdir)
+        os.makedirs(os.path.join(tmpdir, _ARRAYS_DIR))
         try:
             files = {}
-
-            def write(fname, data):
-                atomic_write_bytes(os.path.join(tmpdir, fname), data)
-                files[fname] = {"crc32": zlib.crc32(data) & 0xFFFFFFFF,
-                                "size": len(data)}
-
-            kind = "sharded" if _is_sharded_trainer(trainer) else "gluon"
-            params = self._param_entries(net, trainer, kind)
-            if params is not None:
-                write(_PARAMS, _npz_bytes(params))
-            if trainer is not None:
-                write(_TRAINER, trainer.get_states_bytes())
+            arrays_meta = {}
+            for i, (key, dtype, shape, spec, shards) in \
+                    enumerate(snap["arrays"]):
+                recs = []
+                for j, (index, arr) in enumerate(shards):
+                    fname = f"{_ARRAYS_DIR}/{i:05d}-{j:03d}.bin"
+                    data = _np.ascontiguousarray(arr).tobytes()
+                    atomic_write_bytes(os.path.join(tmpdir, fname), data)
+                    recs.append({"file": fname,
+                                 "index": [[a, b] for a, b in index],
+                                 "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                                 "size": len(data)})
+                arrays_meta[key] = {"shape": list(shape), "dtype": dtype,
+                                    "spec": spec, "shards": recs}
+            if snap["trainer_bytes"] is not None:
+                data = snap["trainer_bytes"]
+                atomic_write_bytes(os.path.join(tmpdir, _TRAINER), data)
+                files[_TRAINER] = {"crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                                   "size": len(data)}
             faults.maybe_crash("ckpt_crash_before_manifest")
-            manifest = {"format_version": _FORMAT_VERSION,
-                        "kind": kind,
-                        "step": int(step),
-                        "epoch": None if epoch is None else int(epoch),
-                        "tag": tag,
-                        "rng_key": _rng_state(),
-                        "loss_scaler": _scaler_state(trainer),
-                        "files": files,
-                        "extra": extra or {}}
+            if is_async:
+                faults.maybe_crash("ckpt_async_crash")
+            manifest = dict(snap["manifest"])
+            manifest["arrays"] = arrays_meta
+            manifest["files"] = files
             atomic_write_bytes(os.path.join(tmpdir, _MANIFEST),
                                json.dumps(manifest, indent=1).encode())
             # re-saving an existing step: move the old dir aside (rename,
@@ -325,42 +754,33 @@ class CheckpointManager:
                 if os.path.isdir(old):
                     shutil.rmtree(old)
                 os.replace(final, old)
-            os.replace(tmpdir, final)
-            _fsync_dir(self.directory)
-            if old is not None:
-                shutil.rmtree(old, ignore_errors=True)
+            with self._pin(final):
+                os.replace(tmpdir, final)
+                _fsync_dir(self.directory)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+                if not in_child:
+                    _STATS["ckpt_saves"] += 1
+                    self._prune()
         except faults.SimulatedCrash:
             # leave the partial temp dir behind, like a real SIGKILL would
-            _STATS["ckpt_save_failures"] += 1
+            if not in_child:
+                _STATS["ckpt_save_failures"] += 1
             raise
         except BaseException:
-            _STATS["ckpt_save_failures"] += 1
+            if not in_child:
+                _STATS["ckpt_save_failures"] += 1
             shutil.rmtree(tmpdir, ignore_errors=True)
             raise
-        _STATS["ckpt_saves"] += 1
-        self._prune()
         return final
-
-    def _param_entries(self, net, trainer, kind):
-        if kind == "sharded":
-            entries = {f"param:{k}": _np.asarray(v)
-                       for k, v in trainer.params.items()}
-            entries.update({f"aux:{k}": _np.asarray(v)
-                            for k, v in trainer.aux.items()})
-            return entries
-        if net is None:
-            return None
-        return {name: p.data().asnumpy() if hasattr(p, "data") else
-                _np.asarray(p)
-                for name, p in _net_param_map(net).items()}
 
     def _gc_debris(self):
         """Clean up after dead writers: remove stale ``.{tag}.tmp.{pid}``
-        dirs (a kill mid-save) and handle ``.{tag}.old.{pid}`` dirs — if
-        the kill landed between move-aside and publish, the moved-aside
-        dir is the only copy of that step, so it is renamed back;
-        otherwise it is deleted. Live pids (concurrent writers into the
-        same directory) are left alone."""
+        dirs (a kill mid-save — sync or async) and handle
+        ``.{tag}.old.{pid}`` dirs — if the kill landed between move-aside
+        and publish, the moved-aside dir is the only copy of that step,
+        so it is renamed back; otherwise it is deleted. Live pids
+        (concurrent writers into the same directory) are left alone."""
         pat = re.compile(
             rf"^\.({re.escape(self.prefix)}-\d+)\.(tmp|old)\.(\d+)$")
         for name in os.listdir(self.directory):
@@ -381,41 +801,74 @@ class CheckpointManager:
         if self.keep_n <= 0:
             return
         ckpts = self.list_checkpoints()
+        with self._pin_lock:
+            pinned = set(self._pins)
+        removed = 0
         for _, path in ckpts[:max(0, len(ckpts) - self.keep_n)]:
+            if path in pinned:
+                continue  # held open by a restore or async publish
             shutil.rmtree(path, ignore_errors=True)
             _STATS["ckpt_pruned"] += 1
+            removed += 1
+        if removed:
+            # make the deletions durable too: a power loss must not
+            # resurrect pruned steps next to (or instead of) newer ones
+            _fsync_dir(self.directory)
 
     # ------------------------------------------------------------- restore
 
     def restore_latest(self, net=None, trainer=None):
         """Restore the newest *valid* checkpoint into ``net``/``trainer``;
         returns its manifest, or None if no valid checkpoint exists.
-        Corrupt or partially-written checkpoints are skipped in favor of
-        the previous valid one."""
+        Corrupt or partially-written checkpoints — a bad manifest OR any
+        shard file failing its CRC — are skipped in favor of the previous
+        valid one. Barriers on an in-flight async save first, so the
+        freshest published state is always considered."""
         import warnings
 
+        self.wait_for_async()
         if os.path.isdir(self.directory):
             self._gc_debris()  # resurrect a step lost mid-publish
         for _, path in reversed(self.list_checkpoints()):
-            try:
-                manifest, payloads = self._verify(path)
-            except CheckpointCorruptError as e:
-                _STATS["ckpt_restore_skipped"] += 1
-                warnings.warn(f"skipping corrupt checkpoint: {e}")
-                continue
-            return self._apply(manifest, payloads, net, trainer)
+            with self._pin(path):
+                try:
+                    manifest, payloads = self._verify(path)
+                except CheckpointCorruptError as e:
+                    _STATS["ckpt_restore_skipped"] += 1
+                    warnings.warn(f"skipping corrupt checkpoint: {e}")
+                    continue
+                return self._apply(manifest, payloads, net, trainer)
         return None
 
     def restore(self, path, net=None, trainer=None):
-        """Restore one specific checkpoint (verified, bitwise) and return
-        its manifest."""
-        manifest, payloads = self._verify(path)
-        return self._apply(manifest, payloads, net, trainer)
+        """Restore one specific checkpoint (verified, bitwise — onto the
+        CURRENT mesh topology for sharded trainers) and return its
+        manifest."""
+        self.wait_for_async()
+        with self._pin(path):
+            manifest, payloads = self._verify(path)
+            return self._apply(manifest, payloads, net, trainer)
 
     def _apply(self, manifest, payloads, net, trainer):
         """Apply already-verified payload bytes (one disk read total)."""
         kind = manifest.get("kind", "gluon")
-        if _PARAMS in payloads:
+        version = manifest.get("format_version", 1)
+        if version >= 2:
+            entries = self._assemble_arrays(manifest, payloads)
+            params = {k: v for k, v in entries.items()
+                      if k.startswith(("param:", "aux:"))}
+            opt = {k[len("opt:"):]: v for k, v in entries.items()
+                   if k.startswith("opt:")}
+            if kind == "sharded":
+                if trainer is None:
+                    raise ValueError(
+                        "sharded checkpoint requires trainer= to restore")
+                self._restore_sharded_arrays(trainer, params)
+                trainer.set_states_arrays(opt)
+            elif net is not None:
+                self._restore_net(
+                    net, {k[len("param:"):]: v for k, v in params.items()})
+        elif _PARAMS in payloads:
             f = _np.load(io.BytesIO(payloads[_PARAMS]), allow_pickle=False)
             entries = {k: f[k] for k in f.files}
             if kind == "sharded":
@@ -431,6 +884,34 @@ class CheckpointManager:
         _restore_scaler(trainer, manifest.get("loss_scaler"))
         _STATS["ckpt_restores"] += 1
         return manifest
+
+    def _assemble_arrays(self, manifest, payloads):
+        """Reassemble each v2 array to its full LOGICAL value on the host
+        from its (already CRC-verified) shard payloads — the half of
+        resharding that undoes the saved topology; re-placement through
+        the restoring trainer's NamedShardings does the other half."""
+        out = {}
+        for key, rec in manifest.get("arrays", {}).items():
+            dtype = _np.dtype(rec["dtype"])
+            shape = tuple(int(d) for d in rec["shape"])
+            arr = _np.empty(shape, dtype)
+            covered = 0
+            for shard in rec["shards"]:
+                idx = tuple(slice(int(a), int(b)) for a, b in shard["index"])
+                extent = tuple(int(b) - int(a) for a, b in shard["index"])
+                chunk = _np.frombuffer(payloads[shard["file"]],
+                                       dtype=dtype).reshape(extent)
+                arr[idx] = chunk
+                n = 1
+                for e in extent:
+                    n *= e
+                covered += n
+            if covered < arr.size:
+                raise CheckpointCorruptError(
+                    f"array '{key}' shards cover {covered} of {arr.size} "
+                    "elements (manifest lost a shard record)")
+            out[key] = arr
+        return out
 
     def _restore_net(self, net, entries):
         from ..ndarray import ndarray as _nd

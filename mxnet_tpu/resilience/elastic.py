@@ -29,14 +29,23 @@ Env knobs:
 
 - ``MXNET_TPU_ELASTIC`` — ``0`` disables the retry (the OOM surfaces);
 - ``MXNET_TPU_ELASTIC_MIN_MICROBATCH`` — smallest rows-per-microbatch
-  the halving may reach (default 1).
+  the halving may reach (default 1);
+- ``MXNET_TPU_MESH_SHRINK`` — ``0`` disables peer-loss recovery by mesh
+  shrink (a ``PeerLostError`` then surfaces as before).
+
+This module also owns the *topology* half of elasticity: when a peer
+dies mid-run, ``parallel.ShardedTrainer`` rebuilds a smaller mesh
+(``parallel.mesh.shrink_mesh``), reloads the latest reshardable
+checkpoint onto it, and re-arms the sticky accumulation count
+(``rearm_microbatches``) so the per-device microbatch stays where it
+last fit — ``elastic_mesh_shrinks`` counts these recoveries.
 
 The ``oom_step[@step[:times]]`` fault kind raises an injected
 ``RESOURCE_EXHAUSTED`` before the step launches (times = how many
 attempts fail, so ``times=2`` forces two halvings), making the whole
 path deterministic on CPU. Counters (``elastic_oom_events``,
-``elastic_shrinks``, ``elastic_accum_steps``) surface in
-``profiler.dispatch_stats()``.
+``elastic_shrinks``, ``elastic_accum_steps``, ``elastic_mesh_shrinks``)
+surface in ``profiler.dispatch_stats()``.
 """
 from __future__ import annotations
 
@@ -45,12 +54,14 @@ import os
 from . import faults
 
 __all__ = ["enabled", "min_microbatch", "is_oom_error",
-           "next_microbatches", "stats", "reset_stats"]
+           "next_microbatches", "mesh_shrink_enabled",
+           "rearm_microbatches", "stats", "reset_stats"]
 
 _STATS = {
     "elastic_oom_events": 0,   # RESOURCE_EXHAUSTED caught from a step
     "elastic_shrinks": 0,      # microbatch halvings performed
     "elastic_accum_steps": 0,  # steps executed via accumulation (N > 1)
+    "elastic_mesh_shrinks": 0,  # peer losses recovered by mesh shrink
 }
 
 
@@ -89,6 +100,29 @@ def is_oom_error(err):
         return True
     msg = str(err).lower()
     return any(m in msg for m in _OOM_MARKERS)
+
+
+def mesh_shrink_enabled():
+    """Is peer-loss recovery by mesh shrink on?
+    (``MXNET_TPU_MESH_SHRINK``, default on — only consulted when the
+    trainer also has a CheckpointManager to reload state from.)"""
+    return os.environ.get("MXNET_TPU_MESH_SHRINK", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def rearm_microbatches(n, old_dp, new_dp):
+    """Sticky accumulation count after a dp shrink from ``old_dp`` to
+    ``new_dp`` shards. A run that had already shrunk to N microbatches
+    had proven only rows/(N*old_dp) rows fit one device; fewer shards
+    mean more rows per device, so N scales by the shard ratio to keep
+    the per-device microbatch where it last fit. A run still on the
+    fused path (n == 1) is left fused — nothing has OOMed, and the
+    ordinary elastic retry catches it if the wider per-device batch
+    doesn't fit the survivors."""
+    n = max(1, int(n))
+    if n == 1 or int(new_dp) >= int(old_dp):
+        return n
+    return n * max(1, int(old_dp) // int(new_dp))
 
 
 def next_microbatches(n, rows, shards=1):

@@ -15,8 +15,16 @@ Supported fault kinds (the hook that honours each is noted):
                                   (``resilience.checkpoint.atomic_write_bytes``)
 - ``ckpt_partial_write``        — checkpoint byte-write silently truncates
                                   (same hook; caught later by CRC verify)
+- ``ckpt_shard_corrupt``        — one v2 shard payload write flips a byte
+                                  (same size, so only the per-shard CRC in
+                                  the manifest catches it on restore)
 - ``ckpt_crash_before_manifest``— simulated process death between payload
                                   and manifest write (``CheckpointManager.save``)
+- ``ckpt_async_crash``          — simulated death of the BACKGROUND async
+                                  checkpoint writer before it publishes
+                                  (``CheckpointManager.save(async_=True)``;
+                                  leaves temp-dir debris for the GC, the
+                                  next save's barrier reports the loss)
 - ``dist_connect_timeout``      — coordinator connect raises TimeoutError
                                   (``kvstore.dist.init_distributed``)
 - ``nan_serving``               — poison one inference input batch with NaN
@@ -206,8 +214,9 @@ def maybe_nan_grads(params):
 
 def checkpoint_write_filter(path, data):
     """Filter applied to every checkpoint byte-write. May raise ENOSPC
-    (``ckpt_enospc``) or return a truncated payload
-    (``ckpt_partial_write``)."""
+    (``ckpt_enospc``), return a truncated payload (``ckpt_partial_write``),
+    or flip one byte of a v2 shard payload (``ckpt_shard_corrupt`` —
+    same length, so size checks pass and only the CRC catches it)."""
     if not _ACTIVE:
         return data
     fault = _ACTIVE.get("ckpt_enospc")
@@ -217,6 +226,15 @@ def checkpoint_write_filter(path, data):
     fault = _ACTIVE.get("ckpt_partial_write")
     if fault is not None and fault.should_fire():
         return data[:max(1, len(data) // 2)]
+    fault = _ACTIVE.get("ckpt_shard_corrupt")
+    if fault is not None and data:
+        # only shard payload files count: the fire window must not be
+        # burnt on a manifest or trainer.state write the kind can't touch
+        parts = str(path).replace(os.sep, "/").split("/")
+        if "arrays" in parts and fault.should_fire():
+            out = bytearray(data)
+            out[len(out) // 2] ^= 0xFF
+            return bytes(out)
     return data
 
 

@@ -29,7 +29,11 @@ Collectives additionally keep **peer-liveness bookkeeping**: a rank
 marked dead (``mark_peer_dead``, or the ``peer_death`` fault) makes the
 next collective fail fast with :class:`PeerLostError` naming the rank,
 and a collective that *stalls* while peers are known dead raises
-PeerLostError instead of a bare StallError.
+PeerLostError instead of a bare StallError. A
+``parallel.ShardedTrainer`` with a CheckpointManager attached catches
+that PeerLostError and *survives* it — smaller mesh, reshardable
+checkpoint reload, ``note_peer_recovery`` crash-report amendment —
+instead of dying (docs/resilience.md, "mesh-shrink resume").
 
 The async raise lands at a Python bytecode boundary, so it interrupts
 Python-level waits (locks, short sleeps, retry loops) but not a thread
@@ -56,9 +60,9 @@ import time
 from . import faults as _faults
 
 __all__ = ["StallError", "PeerLostError", "guard", "collective_guard",
-           "timeout_for", "crash_dir", "note_step", "note_rollback",
-           "mark_peer_dead", "dead_peers", "reset_peers", "stats",
-           "reset_stats", "PHASES"]
+           "check_peers", "timeout_for", "crash_dir", "note_step",
+           "note_rollback", "note_peer_recovery", "mark_peer_dead",
+           "dead_peers", "reset_peers", "stats", "reset_stats", "PHASES"]
 
 PHASES = ("step", "collective", "batch")
 
@@ -68,6 +72,7 @@ _STATS = {
     "watchdog_crash_reports": 0,  # reports successfully written
     "watchdog_rollbacks": 0,      # stalls recovered via checkpoint rollback
     "watchdog_peer_lost": 0,      # ranks declared dead
+    "watchdog_peer_recoveries": 0,  # peer losses survived by mesh shrink
 }
 
 
@@ -287,18 +292,28 @@ def _absorb_stall(g):
     raise err
 
 
-@contextlib.contextmanager
-def collective_guard(detail=None, timeout=None):
-    """`guard('collective')` plus peer-liveness bookkeeping: consult the
-    ``peer_death`` fault hook, refuse to enter the collective when any
-    peer is already known dead (PeerLostError naming the rank — not an
-    infinite block), and arm the collective deadline around the body."""
+def check_peers(detail=None):
+    """One peer-liveness consultation: poll the ``peer_death`` fault
+    hook, record any newly-dead rank, and raise PeerLostError (naming
+    every dead rank) when the caller is about to enter an operation that
+    would block forever on them. Called by ``collective_guard`` and at
+    the top of every ``parallel.ShardedTrainer.step`` attempt — the
+    hook the elastic mesh-shrink recovery catches."""
     rank = _faults.maybe_peer_death()
     if rank is not None:
         mark_peer_dead(rank)
     dead = dead_peers()
     if dead:
         raise _peer_lost_error(dead, detail)
+
+
+@contextlib.contextmanager
+def collective_guard(detail=None, timeout=None):
+    """`guard('collective')` plus peer-liveness bookkeeping: consult the
+    ``peer_death`` fault hook, refuse to enter the collective when any
+    peer is already known dead (PeerLostError naming the rank — not an
+    infinite block), and arm the collective deadline around the body."""
+    check_peers(detail)
     with guard("collective", timeout=timeout, detail=detail) as g:
         yield g
 
@@ -310,6 +325,22 @@ def note_step(step):
     _LAST_STEP = int(step)
 
 
+def _amend_report(path, key, value):
+    """Merge one key into an existing crash report (atomic rewrite);
+    silent best-effort — the report is forensics, never control flow."""
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        report[key] = value
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, path)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
 def note_rollback(err, manifest):
     """Record that a stall was recovered by restoring a checkpoint:
     bumps ``watchdog_rollbacks`` and amends the stall's crash report
@@ -319,19 +350,60 @@ def note_rollback(err, manifest):
     path = getattr(err, "report_path", None)
     if not path:
         return
+    _amend_report(path, "rollback", {
+        "restored_step": manifest.get("step"),
+        "restored_tag": manifest.get("tag"),
+    })
+
+
+def note_peer_recovery(err, manifest=None, old_axes=None, new_axes=None):
+    """Record that a peer loss was survived by an elastic mesh shrink:
+    bumps ``watchdog_peer_recoveries`` and amends the PeerLostError's
+    crash report — or, for the fail-fast path that never wrote one,
+    writes a fresh ``peer_recovery`` report — with the dead ranks, the
+    old and new mesh axes, and the checkpoint the run resumed from. The
+    report is the operator's record that the job kept going on fewer
+    chips (capacity silently halved is an incident too)."""
+    _STATS["watchdog_peer_recoveries"] += 1
+    info = {
+        "ranks": list(getattr(err, "ranks", ()) or ()),
+        "old_mesh_axes": old_axes,
+        "new_mesh_axes": new_axes,
+        "restored_step": None if manifest is None else manifest.get("step"),
+        "restored_tag": None if manifest is None else manifest.get("tag"),
+    }
+    path = getattr(err, "report_path", None)
+    if path and os.path.isfile(path) and \
+            _amend_report(path, "peer_recovery", info):
+        return path
     try:
-        with open(path) as f:
-            report = json.load(f)
-        report["rollback"] = {
-            "restored_step": manifest.get("step"),
-            "restored_tag": manifest.get("tag"),
+        d = crash_dir()
+        os.makedirs(d, exist_ok=True)
+        report = {
+            "schema_version": 1,
+            "kind": "peer_recovery",
+            "step": _LAST_STEP,
+            "pid": os.getpid(),
+            "wallclock": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "error": str(err),
+            "peer_recovery": info,
+            "env": _env_snapshot(),
         }
-        tmp = f"{path}.tmp.{os.getpid()}"
+        name = (f"crash-{time.strftime('%Y%m%d-%H%M%S')}-peer-recovery"
+                f"-pid{os.getpid()}-{next(_TOKENS)}.json")
+        path = os.path.join(d, name)
+        tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            json.dump(report, f, indent=1)
+            json.dump(report, f, indent=1, default=str)
         os.replace(tmp, path)
-    except (OSError, ValueError):
-        pass
+        _STATS["watchdog_crash_reports"] += 1
+        try:
+            err.report_path = path
+        except Exception:
+            pass
+        return path
+    except Exception:
+        return None
 
 
 # -------------------------------------------------------------------- monitor

@@ -1,0 +1,733 @@
+"""Elastic recovery runtime: reshardable v2 checkpoints, async saves,
+and mesh-shrink resume after peer loss (docs/resilience.md).
+
+The bitwise contract these tests pin down:
+
+- a v2 restore is VALUE-EXACT on any topology: state saved on dp=8
+  reassembles and re-places bitwise onto dp=4, dp=2, or back onto dp=8;
+- continuation on the SAME dp width after a kill+restore is bitwise
+  identical to the uninterrupted run;
+- continuation on a DIFFERENT width is bitwise identical to an
+  independently hand-seeded oracle at that width — the checkpoint
+  machinery adds zero perturbation; the width change itself legitimately
+  regroups float reductions (~1 ulp vs the old width), which is a
+  schedule property, not a checkpoint defect.
+
+All tier-1 (CPU, 8 virtual devices) except the ckpt_bench gate.
+"""
+import glob
+import json
+import os
+import sys
+import time
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.resilience import (CheckpointManager, PeerLostError, elastic,
+                                  faults, watchdog)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    from mxnet_tpu import resilience
+
+    faults.reset()
+    resilience.reset_stats()
+    watchdog.reset_peers()
+    monkeypatch.setenv("MXNET_TPU_CRASH_DIR", str(tmp_path / "crash"))
+    yield
+    faults.reset()
+    watchdog.reset_peers()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _sharded(dp, seed=0, momentum=0.9, prefix="ert_net_", mgr=None):
+    import jax
+
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mx.random.seed(seed)
+    # a FIXED prefix pins param names, like a fresh process would see —
+    # cross-trainer restores must match state by name, not by counter
+    net = mx.gluon.nn.Dense(4, in_units=4, prefix=prefix)
+    net.initialize()
+    mesh = create_mesh({"dp": dp}, jax.devices()[:dp])
+    return ShardedTrainer(net, lambda p, l: ((p - l) ** 2), optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1,
+                                            "momentum": momentum},
+                          mesh=mesh, checkpoint_manager=mgr)
+
+
+def _batch(k):
+    x = (np.arange(32, dtype=np.float32).reshape(8, 4) / 32) + k * 0.01
+    y = np.ones((8, 4), np.float32)
+    return x, y
+
+
+def _host_state(trainer):
+    """(params, aux, opt) as host numpy, keyed by name / opt keystr."""
+    import jax
+
+    params = {k: np.asarray(v).copy() for k, v in trainer.params.items()}
+    aux = {k: np.asarray(v).copy() for k, v in trainer.aux.items()}
+    opt = {jax.tree_util.keystr(p): np.asarray(leaf).copy()
+           for p, leaf in
+           jax.tree_util.tree_flatten_with_path(trainer.opt_state)[0]}
+    return params, aux, opt
+
+
+def _assert_state_equal(a, b):
+    for da, db in zip(a, b):
+        assert set(da) == set(db)
+        for k in da:
+            np.testing.assert_array_equal(da[k], db[k], err_msg=k)
+
+
+def _seed_trainer(trainer, state):
+    """Hand-place a (params, aux, opt) host snapshot onto ``trainer``'s
+    mesh WITHOUT going through checkpoint code — the independent oracle
+    for 'resharding adds zero perturbation'."""
+    import jax
+    import jax.numpy as jnp
+
+    params, aux, opt = state
+    trainer.params = {k: jax.device_put(jnp.asarray(v),
+                                        trainer._param_sharding[k])
+                      for k, v in params.items()}
+    trainer.aux = {k: jax.device_put(jnp.asarray(v),
+                                     trainer._aux_sharding[k])
+                   for k, v in aux.items()}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(trainer.opt_state)
+    shard_flat = jax.tree_util.tree_flatten_with_path(
+        trainer._opt_sharding())[0]
+    leaves = [jax.device_put(jnp.asarray(opt[jax.tree_util.keystr(p)]), sh)
+              for (p, _), (_, sh) in zip(flat, shard_flat)]
+    trainer.opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _gluon_net(seed=0):
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize(init=mx.initializer.Xavier())
+    return net
+
+
+def _gluon_step(net, trainer, k=0):
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3) + k)
+    y = mx.nd.ones((2, 4))
+    with mx.autograd.record():
+        loss = ((net(x) - y) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+
+
+def _gluon_params(net):
+    return {k: v.asnumpy().copy()
+            for k, v in net._collect_params_with_prefix().items()}
+
+
+# ---------------------------------------------------------------------------
+# v2 format: layout, integrity, reassembly
+# ---------------------------------------------------------------------------
+
+def test_v2_manifest_records_topology_and_shards(tmp_path):
+    t = _sharded(8)
+    t.step(*_batch(0))
+    mgr = CheckpointManager(tmp_path, keep_n=3)
+    path = mgr.save(1, trainer=t, epoch=0)
+    with open(os.path.join(path, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["format_version"] == 2
+    assert man["kind"] == "sharded"
+    assert man["mesh_axes"] == {"dp": 8}
+    # params + aux (incl. rng key) + opt leaves all recorded as arrays
+    keys = set(man["arrays"])
+    assert any(k.startswith("param:") for k in keys)
+    assert any(k.startswith("aux:") for k in keys)
+    assert any(k.startswith("opt:") for k in keys)
+    for key, rec in man["arrays"].items():
+        assert tuple(rec["shape"]) is not None and rec["dtype"]
+        total = int(np.prod([max(1, d) for d in rec["shape"]] or [1]))
+        covered = 0
+        for shard in rec["shards"]:
+            fpath = os.path.join(path, shard["file"])
+            data = open(fpath, "rb").read()
+            assert len(data) == shard["size"]
+            assert zlib.crc32(data) & 0xFFFFFFFF == shard["crc32"]
+            ext = 1
+            for a, b in shard["index"]:
+                ext *= b - a
+            covered += ext if shard["index"] else 1
+        assert covered == total, key
+    # replicated arrays store ONE shard, not one per device
+    wkey = next(k for k in keys if k.endswith("weight")
+                and k.startswith("param:"))
+    assert len(man["arrays"][wkey]["shards"]) == 1
+
+
+def test_v2_shard_file_corruption_falls_back(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=5)
+    mgr.save(1, net=net, trainer=tr)
+    good = _gluon_params(net)
+    _gluon_step(net, tr, 1)
+    path2 = mgr.save(2, net=net, trainer=tr)
+    # flip one byte inside one shard payload: size (and manifest) stay
+    # valid, only the per-shard CRC can catch it
+    shard = sorted(glob.glob(os.path.join(path2, "arrays", "*.bin")))[0]
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(blob))
+    with pytest.warns(UserWarning, match="CRC32"):
+        manifest = mgr.restore_latest(net=net, trainer=tr)
+    assert manifest["step"] == 1
+    for k, v in _gluon_params(net).items():
+        np.testing.assert_array_equal(good[k], v, err_msg=k)
+    from mxnet_tpu import resilience
+
+    assert resilience.stats()["ckpt_restore_skipped"] == 1
+
+
+def test_v2_malformed_manifest_record_falls_back(tmp_path):
+    """Field-level manifest bitrot that still parses as JSON (an array
+    record losing its dtype) must fall back like any other corruption,
+    never crash the restore path."""
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=5)
+    mgr.save(1, net=net, trainer=tr)
+    p2 = mgr.save(2, net=net, trainer=tr)
+    mpath = os.path.join(p2, "manifest.json")
+    with open(mpath) as f:
+        man = json.load(f)
+    del man["arrays"][next(iter(man["arrays"]))]["dtype"]
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    with pytest.warns(UserWarning, match="malformed manifest"):
+        manifest = mgr.restore_latest(net=net, trainer=tr)
+    assert manifest["step"] == 1
+
+
+def test_thread_async_save_survives_interpreter_exit(tmp_path):
+    """The atexit barrier publishes a thread-mode async save launched
+    right before normal process exit — the run's FINAL checkpoint must
+    never be lost to daemon-thread teardown."""
+    import subprocess
+
+    script = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['MXNET_TPU_CKPT_ASYNC_MODE'] = 'thread'\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.resilience import CheckpointManager\n"
+        "net = mx.gluon.nn.Dense(64, in_units=4096)\n"
+        "net.initialize()\n"
+        f"CheckpointManager({str(tmp_path)!r}, keep_n=3).save(1, net=net, async_=True)\n"
+    )
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    v = CheckpointManager(tmp_path).latest_valid()
+    assert v is not None and v[0] == 1
+
+
+def test_v2_shard_corrupt_fault_injected(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=5)
+    mgr.save(1, net=net, trainer=tr)
+    with faults.inject("ckpt_shard_corrupt") as f:
+        mgr.save(2, net=net, trainer=tr)  # publishes a poisoned ckpt
+    assert f.fired == 1
+    with pytest.warns(UserWarning, match="CRC32"):
+        step, _, _ = mgr.latest_valid()
+    assert step == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-topology restore + resume (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def test_cross_topology_restore_is_value_exact(tmp_path):
+    """State saved on dp=8 restores bitwise onto dp=4, dp=2, and back
+    onto dp=8 — reassembled from shard payloads and re-placed through
+    the restoring trainer's own NamedShardings."""
+    import jax
+
+    t8 = _sharded(8)
+    for k in range(2):
+        t8.step(*_batch(k))
+    saved = _host_state(t8)
+    mgr = CheckpointManager(tmp_path, keep_n=3)
+    mgr.save(2, trainer=t8)
+
+    for dp in (4, 2, 8):
+        t = _sharded(dp, seed=123 + dp)  # different init: restore must win
+        manifest = mgr.restore_latest(trainer=t)
+        assert manifest["step"] == 2
+        assert manifest["mesh_axes"] == {"dp": 8}  # saved topology
+        _assert_state_equal(saved, _host_state(t))
+        # every restored leaf actually lives on the restoring mesh with
+        # the trainer's own sharding (not the saved topology's)
+        for k, v in t.params.items():
+            assert v.sharding.is_equivalent_to(t._param_sharding[k], v.ndim)
+        assert all(
+            leaf.sharding.is_equivalent_to(sh, leaf.ndim)
+            for leaf, sh in zip(jax.tree.leaves(t.opt_state),
+                                jax.tree.leaves(t._opt_sharding()))
+            if hasattr(leaf, "sharding"))
+
+
+def test_kill_resume_same_width_bitwise(tmp_path):
+    """dp=8 killed mid-run, resumed on dp=8: params + opt_state bitwise
+    identical to the uninterrupted schedule."""
+    total = 4
+    ref = _sharded(8)
+    for k in range(total):
+        ref.step(*_batch(k))
+    ref_state = _host_state(ref)
+
+    t = _sharded(8)
+    mgr = CheckpointManager(tmp_path, keep_n=2)
+    for k in range(2):
+        t.step(*_batch(k))
+    mgr.save(2, trainer=t)
+    del t  # the "kill"
+
+    resumed = _sharded(8, seed=999)
+    manifest = mgr.restore_latest(trainer=resumed)
+    for k in range(manifest["step"], total):
+        resumed.step(*_batch(k))
+    _assert_state_equal(ref_state, _host_state(resumed))
+
+
+def test_kill_resume_shrunk_width_matches_oracle(tmp_path):
+    """dp=8 killed mid-run, resumed on dp=4 from the v2 checkpoint:
+    bitwise identical to a hand-seeded dp=4 oracle (the checkpoint adds
+    zero perturbation) and allclose to the dp=8 schedule (the width
+    change only regroups float reductions)."""
+    total = 4
+    ref = _sharded(8)
+    for k in range(total):
+        ref.step(*_batch(k))
+    ref_state = _host_state(ref)
+
+    t = _sharded(8)
+    mgr = CheckpointManager(tmp_path, keep_n=2)
+    for k in range(2):
+        t.step(*_batch(k))
+    saved = _host_state(t)
+    mgr.save(2, trainer=t, async_=True)  # the acceptance path is async
+    del t
+    assert mgr.wait_for_async() is True
+
+    oracle = _sharded(4, seed=555)
+    _seed_trainer(oracle, saved)
+    resumed = _sharded(4, seed=777)
+    manifest = mgr.restore_latest(trainer=resumed)
+    assert manifest["step"] == 2
+    for k in range(2, total):
+        oracle.step(*_batch(k))
+        resumed.step(*_batch(k))
+    _assert_state_equal(_host_state(oracle), _host_state(resumed))
+    for k in ref_state[0]:
+        np.testing.assert_allclose(
+            ref_state[0][k], _host_state(resumed)[0][k],
+            rtol=1e-5, atol=1e-7, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# v1 -> v2 migration
+# ---------------------------------------------------------------------------
+
+def _write_v1_checkpoint(directory, step, entries, trainer_bytes,
+                         kind="gluon", rng_key=None):
+    """Hand-rolled v1-format checkpoint (frozen spec: params.npz +
+    trainer.state + format_version-1 manifest), independent of the
+    current writer."""
+    import io
+
+    tag = f"ckpt-{step:08d}"
+    path = os.path.join(directory, tag)
+    os.makedirs(path)
+    files = {}
+
+    def write(name, data):
+        with open(os.path.join(path, name), "wb") as f:
+            f.write(data)
+        files[name] = {"crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                       "size": len(data)}
+
+    buf = io.BytesIO()
+    np.savez(buf, **entries)
+    write("params.npz", buf.getvalue())
+    if trainer_bytes is not None:
+        write("trainer.state", trainer_bytes)
+    manifest = {"format_version": 1, "kind": kind, "step": step,
+                "epoch": None, "tag": tag, "rng_key": rng_key,
+                "loss_scaler": None, "files": files, "extra": {}}
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def test_v1_gluon_checkpoint_still_restores(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    _gluon_step(net, tr, 0)
+    entries = {k: v.asnumpy() for k, v in
+               net._collect_params_with_prefix().items()}
+    _write_v1_checkpoint(str(tmp_path), 5, entries, tr.get_states_bytes())
+    saved = _gluon_params(net)
+    states = tr.get_states_bytes()
+    _gluon_step(net, tr, 1)  # diverge
+    manifest = CheckpointManager(tmp_path).restore_latest(net=net, trainer=tr)
+    assert manifest["step"] == 5 and manifest["format_version"] == 1
+    for k, v in _gluon_params(net).items():
+        np.testing.assert_array_equal(saved[k], v, err_msg=k)
+    assert tr.get_states_bytes() == states
+
+
+def test_v1_sharded_checkpoint_still_restores(tmp_path):
+    t = _sharded(4)
+    t.step(*_batch(0))
+    entries = {f"param:{k}": np.asarray(v) for k, v in t.params.items()}
+    entries.update({f"aux:{k}": np.asarray(v) for k, v in t.aux.items()})
+    _write_v1_checkpoint(str(tmp_path), 7, entries, t.get_states_bytes(),
+                         kind="sharded")
+    saved = _host_state(t)
+    t.step(*_batch(1))  # diverge
+    manifest = CheckpointManager(tmp_path).restore_latest(trainer=t)
+    assert manifest["step"] == 7
+    _assert_state_equal(saved, _host_state(t))
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["fork", "thread"])
+def test_async_save_snapshot_isolation(tmp_path, monkeypatch, mode):
+    """save(async_=True) captures THIS instant's state even though the
+    params keep training (and donating buffers) while the writer runs —
+    in both writer modes."""
+    monkeypatch.setenv("MXNET_TPU_CKPT_ASYNC_MODE", mode)
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=3)
+    mgr.save(1, net=net, trainer=tr, async_=True)
+    snap = _gluon_params(net)
+    states = tr.get_states_bytes()
+    for k in range(3):
+        _gluon_step(net, tr, k + 1)  # mutate while the writer writes
+    assert mgr.wait_for_async() is True
+    manifest = mgr.restore_latest(net=net, trainer=tr)
+    assert manifest["step"] == 1
+    for k, v in _gluon_params(net).items():
+        np.testing.assert_array_equal(snap[k], v, err_msg=k)
+    assert tr.get_states_bytes() == states
+    stats = profiler.dispatch_stats()
+    assert stats["ckpt_async_saves"] == 1
+    assert stats["ckpt_async_failures"] == 0
+
+
+@pytest.mark.parametrize("mode", ["fork", "thread"])
+def test_async_writer_crash_drops_save_cleanly(tmp_path, monkeypatch, mode):
+    """A writer killed before publishing (ckpt_async_crash) loses ONLY
+    its own checkpoint: the barrier warns + counts, debris is GC-able,
+    restore falls back to the previous step."""
+    monkeypatch.setenv("MXNET_TPU_CKPT_ASYNC_MODE", mode)
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=3)
+    mgr.save(1, net=net, trainer=tr)
+    _gluon_step(net, tr, 1)
+    with faults.inject("ckpt_async_crash"):
+        mgr.save(2, net=net, trainer=tr, async_=True)
+        with pytest.warns(UserWarning, match="dropped"):
+            assert mgr.wait_for_async() is False
+    debris = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert len(debris) == 1  # the half-written temp dir, never published
+    manifest = mgr.restore_latest(net=net, trainer=tr)
+    assert manifest["step"] == 1
+    stats = profiler.dispatch_stats()
+    assert stats["ckpt_async_failures"] == 1
+    # a "rebooted" manager GC's the orphan (fork debris carries the dead
+    # child pid already; thread debris needs the writer pid to die)
+    orphan = os.path.join(tmp_path, debris[0])
+    if os.path.isdir(orphan):
+        dead = orphan.rsplit(".", 1)[0] + ".999999"
+        os.rename(orphan, dead)
+        CheckpointManager(tmp_path)
+        assert not os.path.isdir(dead)
+    assert not [n for n in os.listdir(tmp_path)
+                if ".tmp." in n and not n.endswith(f".{os.getpid()}")]
+
+
+def test_next_save_barriers_on_inflight_async(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=5)
+    mgr.save(1, net=net, trainer=tr, async_=True)
+    mgr.save(2, net=net, trainer=tr)  # must barrier, then publish both
+    assert [s for s, _ in mgr.list_checkpoints()] == [1, 2]
+    assert mgr.latest_valid()[0] == 2
+    assert profiler.dispatch_stats()["ckpt_async_waits"] >= 1
+
+
+def test_retention_never_deletes_pinned_checkpoint(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr, 0)
+    mgr = CheckpointManager(tmp_path, keep_n=1)
+    p1 = mgr.save(1, net=net, trainer=tr)
+    with mgr._pin(p1):  # an "active restore" holds step 1 open
+        mgr.save(2, net=net, trainer=tr)
+        mgr.save(3, net=net, trainer=tr)
+        assert os.path.isdir(p1)  # keep_n=1 pruning skipped the pin
+    mgr.save(4, net=net, trainer=tr)  # pin released: normal retention
+    assert [s for s, _ in mgr.list_checkpoints()] == [4]
+
+
+# ---------------------------------------------------------------------------
+# mesh-shrink resume after peer loss
+# ---------------------------------------------------------------------------
+
+def test_shrink_mesh_unit():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import (MeshShrinkError, create_mesh,
+                                         shrink_mesh)
+
+    m8 = create_mesh({"dp": 8}, jax.devices())
+    m = shrink_mesh(m8, [1])
+    assert dict(zip(m.axis_names, m.devices.shape)) == {"dp": 4}
+    assert jax.devices()[1] not in set(m.devices.flat)
+    m = shrink_mesh(m8, [0, 5])  # 6 survivors -> largest pow2 = 4
+    assert m.devices.shape == (4,)
+    m = shrink_mesh(m8, [99])    # unmappable rank still costs a slot
+    assert m.devices.shape == (4,)
+    # non-batch axes keep their full extent
+    m42 = create_mesh({"dp": 4, "tp": 2}, jax.devices())
+    m = shrink_mesh(m42, [1])
+    assert dict(zip(m.axis_names, m.devices.shape)) == {"dp": 2, "tp": 2}
+    with pytest.raises(MeshShrinkError, match="no dead ranks"):
+        shrink_mesh(m8, [])
+    m2 = create_mesh({"dp": 2}, jax.devices()[:2])
+    m1 = shrink_mesh(m2, [1])
+    assert m1.devices.shape == (1,)
+    with pytest.raises(MeshShrinkError, match="survivors"):
+        shrink_mesh(m1, [0])
+
+
+def test_rearm_microbatches_unit():
+    assert elastic.rearm_microbatches(1, 8, 4) == 1   # fused stays fused
+    assert elastic.rearm_microbatches(2, 8, 4) == 4   # per-device mb kept
+    assert elastic.rearm_microbatches(2, 8, 2) == 8
+    assert elastic.rearm_microbatches(4, 4, 4) == 4   # no shrink, no-op
+
+
+def test_peer_death_recovers_to_shrunk_mesh_bitwise(tmp_path):
+    """Acceptance: injected peer_death mid-run recovers automatically to
+    a shrunk mesh — watchdog counter incremented, crash report amended —
+    and the continued run is bitwise identical to a hand-seeded oracle
+    at the new width (recovery adds zero perturbation)."""
+    mgr = CheckpointManager(tmp_path / "ckpt", keep_n=3)
+    t = _sharded(4, mgr=mgr)
+    for k in range(2):
+        t.step(*_batch(k))
+        mgr.save(k + 1, trainer=t, async_=True)
+    mgr.wait_for_async()
+    state_after_1 = _host_state(t)  # == what checkpoint step 2 holds
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with faults.inject("peer_death") as f:
+            loss = t.step(*_batch(2))  # dies -> shrinks -> re-runs batch 2
+    assert f.fired == 1
+    assert int(t.mesh.shape["dp"]) == 2
+    assert np.isfinite(float(loss))
+    assert any("mesh shrunk 4 -> 2" in str(x.message) for x in w)
+    assert t.last_recovery is not None and t.last_recovery["step"] == 2
+    t.step(*_batch(3))  # and training continues on the survivors
+
+    stats = profiler.dispatch_stats()
+    assert stats["watchdog_peer_lost"] == 1
+    assert stats["watchdog_peer_recoveries"] == 1
+    assert stats["elastic_mesh_shrinks"] == 1
+    # crash report: the recovery is recorded, not just the loss
+    reports = sorted(glob.glob(os.path.join(watchdog.crash_dir(),
+                                            "crash-*.json")))
+    assert reports
+    rec = json.load(open(reports[-1]))["peer_recovery"]
+    assert rec["ranks"] == [1]
+    assert rec["old_mesh_axes"] == {"dp": 4}
+    assert rec["new_mesh_axes"] == {"dp": 2}
+    assert rec["restored_step"] == 2
+
+    # bitwise: a dp=2 oracle hand-seeded from the step-2 checkpoint state
+    # (== state after batches 0,1) replays batches 2,3 identically
+    oracle = _sharded(2, seed=321)
+    _seed_trainer(oracle, state_after_1)
+    oracle.step(*_batch(2))
+    oracle.step(*_batch(3))
+    _assert_state_equal(_host_state(oracle), _host_state(t))
+
+
+def test_peer_death_cascade_8_4_2(tmp_path):
+    """Two successive peer losses: dp=8 -> dp=4 -> dp=2, each recovered
+    from the latest async checkpoint, run still making progress."""
+    mgr = CheckpointManager(tmp_path / "ckpt", keep_n=3)
+    t = _sharded(8, mgr=mgr)
+    t.step(*_batch(0))
+    mgr.save(1, trainer=t, async_=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("peer_death"):
+            t.step(*_batch(1))
+    assert int(t.mesh.shape["dp"]) == 4
+    mgr.save(2, trainer=t, async_=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("peer_death"):
+            t.step(*_batch(2))
+    assert int(t.mesh.shape["dp"]) == 2
+    loss = t.step(*_batch(3))
+    assert np.isfinite(float(loss))
+    assert profiler.dispatch_stats()["watchdog_peer_recoveries"] == 2
+
+
+def test_peer_death_without_manager_stays_terminal():
+    t = _sharded(2)
+    t.step(*_batch(0))
+    with pytest.raises(PeerLostError):
+        with faults.inject("peer_death"):
+            t.step(*_batch(1))
+    assert int(t.mesh.shape["dp"]) == 2  # untouched
+    watchdog.reset_peers()
+
+
+def test_peer_death_recovery_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_MESH_SHRINK", "0")
+    mgr = CheckpointManager(tmp_path / "ckpt", keep_n=2)
+    t = _sharded(2, mgr=mgr)
+    t.step(*_batch(0))
+    mgr.save(1, trainer=t)
+    with pytest.raises(PeerLostError):
+        with faults.inject("peer_death"):
+            t.step(*_batch(1))
+
+
+def test_recovery_rearms_elastic_accumulation(tmp_path):
+    """A run that had already shrunk to N=2 microbatches keeps its
+    per-device microbatch after the mesh halves: sticky N re-arms to 4."""
+    mgr = CheckpointManager(tmp_path / "ckpt", keep_n=2)
+    t = _sharded(4, mgr=mgr)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("oom_step", times=1):
+            t.step(*_batch(0))  # elastic shrink -> sticky n=2
+    assert t._elastic_n == 2
+    mgr.save(1, trainer=t)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("peer_death"):
+            loss = t.step(*_batch(1))
+    assert int(t.mesh.shape["dp"]) == 2
+    assert t._elastic_n == 4
+    assert np.isfinite(float(loss))
+    assert elastic.stats()["elastic_mesh_shrinks"] == 1
+
+
+def test_kvstore_excise_dead_peers_readmits():
+    kv = mx.kvstore.create("tpu")
+    kv.init(0, mx.nd.ones((4,)))
+    with pytest.raises(PeerLostError):
+        with faults.inject("peer_death"):
+            kv.push(0, mx.nd.ones((4,)))
+    assert kv.excise_dead_peers() == [1]
+    kv.push(0, mx.nd.ones((4,)))  # serving again
+    assert watchdog.dead_peers() == []
+
+
+# ---------------------------------------------------------------------------
+# integration satellites: estimator + callback async passthrough
+# ---------------------------------------------------------------------------
+
+def test_estimator_async_checkpoint_handler(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler, Estimator
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    net = _gluon_net()
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 3).astype(np.float32))
+    y = mx.nd.array(np.random.RandomState(1).randint(
+        0, 2, size=(8,)).astype(np.float32))
+    est = Estimator(net, SoftmaxCrossEntropyLoss(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                             {"learning_rate": 0.1}))
+    handler = CheckpointHandler(str(tmp_path), atomic=True, keep_n=2,
+                                async_=True)
+    est.fit([(x, y)] * 3, epochs=3, event_handlers=[handler])
+    # train_end barriered: every epoch's checkpoint is published
+    assert [s for s, _ in handler.manager.list_checkpoints()] == [1, 2]
+    assert profiler.dispatch_stats()["ckpt_async_saves"] == 3
+
+
+def test_resilient_checkpoint_callback_async(tmp_path):
+    net = _gluon_net()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    _gluon_step(net, tr)
+    mgr = CheckpointManager(tmp_path, keep_n=3)
+    cb = mx.callback.resilient_checkpoint(mgr, net, trainer=tr, period=2,
+                                          async_=True)
+    for epoch in range(4):
+        cb(epoch)
+    mgr.wait_for_async()
+    assert [s for s, _ in mgr.list_checkpoints()] == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# bench gate (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ckpt_bench_async_stall_gate():
+    """Acceptance: async-save step stall <= 10% of the sync save cost at
+    25M params (tools/ckpt_bench.py, one-line JSON convention)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "ckpt_bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stderr:\n{r.stderr}\nstdout:\n{r.stdout}"
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "ckpt_async_stall_pct"
+    assert out["value"] <= 10.0, out
+    assert out["extra"]["sync_save_ms"] > 0
+    assert time.monotonic() - t0 < 600

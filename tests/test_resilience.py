@@ -101,8 +101,12 @@ def test_restore_skips_corrupt_falls_back(tmp_path):
     good = _params_np(net)
     _step(net, trainer, 1)
     path2 = mgr.save(2, net=net, trainer=trainer)
-    # corrupt the newest checkpoint's payload on disk (truncate)
-    ppath = os.path.join(path2, "params.npz")
+    # corrupt the newest checkpoint's payload on disk (truncate one of
+    # the v2 per-array shard files)
+    import glob
+
+    ppath = sorted(glob.glob(os.path.join(path2, "arrays", "*.bin")),
+                   key=os.path.getsize)[-1]
     with open(ppath, "r+b") as f:
         f.truncate(os.path.getsize(ppath) // 2)
     with pytest.warns(UserWarning, match="corrupt checkpoint"):

@@ -527,16 +527,19 @@ RESILIENCE_KEYS = frozenset({
     # sentinel (PR 2)
     "sentinel_checks", "sentinel_nonfinite", "sentinel_grad_norm_trips",
     "sentinel_rollbacks", "health_skipped_steps", "amp_overflow_skips",
-    # checkpoints (PR 2)
+    # checkpoints (PR 2; async family PR 5)
     "ckpt_saves", "ckpt_save_failures", "ckpt_restores",
     "ckpt_restore_skipped", "ckpt_pruned",
+    "ckpt_async_saves", "ckpt_async_waits", "ckpt_async_failures",
     # faults
     "faults_armed", "faults_fired",
-    # watchdog (this PR)
+    # watchdog (PR 4; peer recovery PR 5)
     "watchdog_guards", "watchdog_stalls", "watchdog_crash_reports",
     "watchdog_rollbacks", "watchdog_peer_lost",
-    # elastic (this PR)
+    "watchdog_peer_recoveries",
+    # elastic (PR 4; mesh shrink PR 5)
     "elastic_oom_events", "elastic_shrinks", "elastic_accum_steps",
+    "elastic_mesh_shrinks",
     # dataloader (PR 2 counter, surfaced this PR)
     "dataloader_respawns",
 })

@@ -32,6 +32,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The peer_death_recover drill needs a multi-device dp mesh; force the
+# virtual CPU device count (like tests/conftest.py) before jax loads.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 # Every drill must finish fast even when recovery is broken: tight
 # watchdog deadlines, short hang caps.
 _DEADLINE = "0.5"
@@ -43,8 +51,10 @@ _ENV = {
 }
 
 FAST_KINDS = ("nan_grad", "ckpt_enospc", "ckpt_partial_write",
-              "ckpt_crash_before_manifest", "hang_step", "hang_collective",
-              "hang_batch", "peer_death", "oom_step", "dist_connect_timeout")
+              "ckpt_shard_corrupt", "ckpt_crash_before_manifest",
+              "ckpt_async_crash", "hang_step", "hang_collective",
+              "hang_batch", "peer_death", "peer_death_recover", "oom_step",
+              "dist_connect_timeout")
 
 
 def _mx():
@@ -95,6 +105,8 @@ def _drill_nan_grad(mx, workdir):
 
 
 def _drill_ckpt(mx, workdir, kind):
+    import warnings
+
     from mxnet_tpu.resilience import CheckpointManager, faults
 
     net, trainer, step = _trainer(mx)
@@ -107,9 +119,98 @@ def _drill_ckpt(mx, workdir, kind):
             mgr.save(2, net=net, trainer=trainer)
     except (OSError, faults.SimulatedCrash):
         pass  # an announced failure is fine; recovery is what matters
-    manifest = mgr.restore_latest(net=net, trainer=trainer)
-    ok = manifest is not None and manifest["step"] in (1, 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        manifest = mgr.restore_latest(net=net, trainer=trainer)
+    # a silently-corrupting kind must NOT restore the poisoned step 2
+    want = (1,) if kind in ("ckpt_partial_write", "ckpt_shard_corrupt") \
+        else (1, 2)
+    ok = manifest is not None and manifest["step"] in want
     return ok, f"restored step={None if manifest is None else manifest['step']}"
+
+
+def _drill_ckpt_async_crash(mx, workdir):
+    """The background async writer dies before publishing: the barrier
+    on the next save reports the loss (warning + counter), the debris is
+    GC-able, and restore falls back to the previous checkpoint."""
+    import warnings
+
+    from mxnet_tpu.resilience import CheckpointManager, faults
+
+    net, trainer, step = _trainer(mx)
+    step(0)
+    d = os.path.join(workdir, "ckpt")
+    mgr = CheckpointManager(d, keep_n=3)
+    mgr.save(1, net=net, trainer=trainer)
+    step(1)
+    with faults.inject("ckpt_async_crash"):
+        mgr.save(2, net=net, trainer=trainer, async_=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            published = mgr.wait_for_async()
+    debris_before = [n for n in os.listdir(d) if ".tmp." in n]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        manifest = mgr.restore_latest(net=net, trainer=trainer)
+    # fork-mode debris carries the dead child's pid, so the restore's GC
+    # removes it; thread-mode debris (live pid) is cleaned at next save
+    debris_after = [n for n in os.listdir(d)
+                    if ".tmp." in n and f".{os.getpid()}" not in n]
+    ok = (not published and manifest is not None and manifest["step"] == 1
+          and len(debris_before) == 1 and not debris_after)
+    return ok, (f"published={published} restored="
+                f"{None if manifest is None else manifest['step']} "
+                f"debris {len(debris_before)}->{len(debris_after)}")
+
+
+def _drill_peer_death_recover(mx, workdir):
+    """A dp peer dies mid-run and the run SURVIVES: the trainer shrinks
+    the mesh to the survivors, reloads the latest reshardable checkpoint
+    onto it, and keeps training (counted + crash-reported)."""
+    import warnings
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.resilience import (CheckpointManager, elastic, faults,
+                                      watchdog)
+
+    # recovery recompiles the step on the shrunk mesh inside the guarded
+    # scope — the deadline must cover compile time, not just execution
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "120"
+    if len(jax.devices()) < 2:
+        return False, "needs >= 2 devices (xla_force_host_platform_device_count)"
+    dp = min(4, len(jax.devices()))
+    mx.random.seed(13)
+    net = mx.gluon.nn.Dense(4, in_units=4, prefix="chaos_net_")
+    net.initialize()
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+    trainer = ShardedTrainer(net, lambda p, l: ((p - l) ** 2),
+                             optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=create_mesh({"dp": dp},
+                                              jax.devices()[:dp]),
+                             checkpoint_manager=mgr)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    trainer.step(x, y)
+    mgr.save(1, trainer=trainer)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("peer_death"):
+            loss = trainer.step(x, y)     # dies -> shrinks -> re-runs
+    new_dp = int(trainer.mesh.shape.get("dp", 0))
+    trainer.step(x, y)                    # training continues on survivors
+    s = {**watchdog.stats(), **elastic.stats()}
+    ok = (new_dp == dp // 2 and np.isfinite(float(loss))
+          and s["watchdog_peer_recoveries"] >= 1
+          and s["elastic_mesh_shrinks"] >= 1
+          and trainer.last_recovery is not None
+          and trainer.last_recovery["step"] == 1)
+    return ok, (f"dp {dp}->{new_dp} recoveries="
+                f"{s['watchdog_peer_recoveries']}")
 
 
 def _drill_hang_step(mx, workdir):
@@ -257,8 +358,12 @@ def run_kind(kind, workdir=None):
         if kind == "nan_grad":
             return _drill_nan_grad(mx, tmp)
         if kind in ("ckpt_enospc", "ckpt_partial_write",
-                    "ckpt_crash_before_manifest"):
+                    "ckpt_shard_corrupt", "ckpt_crash_before_manifest"):
             return _drill_ckpt(mx, tmp, kind)
+        if kind == "ckpt_async_crash":
+            return _drill_ckpt_async_crash(mx, tmp)
+        if kind == "peer_death_recover":
+            return _drill_peer_death_recover(mx, tmp)
         if kind == "hang_step":
             return _drill_hang_step(mx, tmp)
         if kind == "hang_collective":
