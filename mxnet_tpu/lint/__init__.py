@@ -1,0 +1,22 @@
+"""graftlint — framework-invariant static analysis for mxnet_tpu.
+
+Three AST pass families over the whole package (docs/static_analysis.md):
+
+- **trace-safety** (TS001-TS003): kernel and segment bodies never
+  host-sync; every executable comes from an interned cache; donated
+  buffers are never read after dispatch.
+- **concurrency** (CC001-CC003): module state in threaded subsystems is
+  mutated under its lock, lock acquisition order is acyclic, non-daemon
+  threads are joined.
+- **registry drift** (RD001-RD003): env knobs are documented, counters
+  are declared, fault kinds are chaos-drilled.
+
+Stdlib-only; never imports the code it analyzes. CLI:
+``python tools/graftlint.py [--json]``; tier-1 gate:
+``tests/test_graftlint.py`` (marker ``lint``).
+"""
+from .core import (Finding, Project, RULES, load_baseline, run_all,
+                   save_baseline, split_by_baseline)
+
+__all__ = ["Finding", "Project", "RULES", "load_baseline", "run_all",
+           "save_baseline", "split_by_baseline"]

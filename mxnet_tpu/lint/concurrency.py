@@ -1,0 +1,481 @@
+"""Concurrency-discipline passes (CC001-CC003).
+
+Five subsystems run threads against shared state — the watchdog monitor,
+async checkpoint writers, the serving batcher worker, DataLoader
+prefetchers, and the profiler's collectors — with no runtime enforcement
+of who may touch what. These passes build the module-level lock /
+shared-state graph and flag the three defect classes that survive code
+review: an unlocked mutation of module state (CC001), two locks taken in
+opposite orders on different paths (CC002 — the deadlock no test ever
+times right), and a non-daemon thread nobody joins (CC003 — the hang at
+interpreter exit).
+
+Scope: a module participates when it creates threads or declares a
+module-level lock. Counter dicts named ``_STATS`` (flat str->int
+telemetry, mutated by single GIL-atomic stores, drift-tolerant by
+design, and audited separately by RD002) are exempt from CC001 — see
+docs/static_analysis.md for the rationale.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import ParentedWalk, call_name, emit, qualname_of
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_MUTATORS = {"append", "appendleft", "add", "insert", "extend", "update",
+             "pop", "popleft", "popitem", "remove", "discard", "clear",
+             "setdefault", "sort"}
+_CONTAINER_FACTORIES = {"dict", "list", "set", "deque", "defaultdict",
+                        "OrderedDict", "WeakSet", "WeakValueDictionary",
+                        "WeakKeyDictionary", "Counter"}
+# flat telemetry counter dicts: single-opcode stores under the GIL,
+# read-only consumers tolerate off-by-one — exempt from CC001 by design
+_COUNTER_NAMES = {"_STATS"}
+
+
+def _is_lock_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return name.split(".")[-1] in _LOCK_FACTORIES and \
+        ("threading" in name or "." not in name)
+
+
+def _is_container_value(node):
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return call_name(node).split(".")[-1] in _CONTAINER_FACTORIES
+    return False
+
+
+def _module_key(mod):
+    return mod.relpath[:-3].replace("/", ".")
+
+
+class _ModuleInfo:
+    """Per-module concurrency facts."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.key = _module_key(mod)
+        self.locks = {}        # local name -> qualified lock id
+        self.containers = {}   # name -> assign lineno (module-level mutables)
+        self.creates_threads = False
+        self.import_map = {}   # alias -> imported module key suffix
+        self._scan_toplevel()
+        self._scan_imports()
+
+    def _scan_toplevel(self):
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if _is_lock_call(stmt.value):
+                    self.locks[name] = f"{self.key}:{name}"
+                elif _is_container_value(stmt.value) and \
+                        name not in _COUNTER_NAMES:
+                    self.containers[name] = stmt.lineno
+        # containers created via `global X` rebinds inside functions
+        # (lazy init) count too
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                declared = {n for g in ast.walk(node)
+                            if isinstance(g, ast.Global) for n in g.names}
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1 and \
+                            isinstance(sub.targets[0], ast.Name) and \
+                            sub.targets[0].id in declared and \
+                            sub.targets[0].id not in _COUNTER_NAMES and \
+                            _is_container_value(sub.value):
+                        self.containers.setdefault(sub.targets[0].id,
+                                                   sub.lineno)
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name.endswith("Thread") or \
+                        name.endswith("ThreadPoolExecutor"):
+                    self.creates_threads = True
+
+    def _scan_imports(self):
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    # `from . import faults as _faults` / `from .. import x`
+                    self.import_map[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_map[a.asname or a.name.split(".")[0]] = \
+                        a.name.split(".")[-1]
+
+    @property
+    def in_scope(self):
+        return self.creates_threads or bool(self.locks)
+
+
+def _lock_of_with_item(info, item, class_locks):
+    """Qualified lock id a `with X:` acquires, or None."""
+    ctx = item.context_expr
+    if isinstance(ctx, ast.Name) and ctx.id in info.locks:
+        return info.locks[ctx.id]
+    if isinstance(ctx, ast.Attribute):
+        # self._lock -> class-qualified instance lock
+        if isinstance(ctx.value, ast.Name) and ctx.value.id == "self" and \
+                ctx.attr in class_locks:
+            return class_locks[ctx.attr]
+        # _mod._LOCK -> other module's lock (resolved by basename later)
+        if isinstance(ctx.value, ast.Name):
+            alias = info.import_map.get(ctx.value.id)
+            if alias is not None:
+                return f"@{alias}:{ctx.attr}"
+    return None
+
+
+def _instance_locks(info):
+    """{attr: qualified id} for `self.X = threading.Lock()` in classes."""
+    out = {}
+    for node in ast.walk(info.mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Attribute) and \
+                    isinstance(sub.targets[0].value, ast.Name) and \
+                    sub.targets[0].value.id == "self" and \
+                    _is_lock_call(sub.value):
+                out[sub.targets[0].attr] = \
+                    f"{info.key}:{node.name}.{sub.targets[0].attr}"
+    return out
+
+
+# ------------------------------------------------------------------- CC001
+
+def _check_cc001(info, class_locks, findings):
+    mod = info.mod
+    if not info.in_scope or not info.containers:
+        return
+    for node, parents in ParentedWalk(mod.tree):
+        fn_parents = [p for p in parents if isinstance(p, ast.FunctionDef)]
+        if not fn_parents:
+            continue  # import-time code runs single-threaded
+        target_name = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in info.containers:
+                    target_name = t.value.id
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in info.containers:
+                    target_name = t.value.id
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in info.containers:
+            target_name = node.func.value.id
+        if target_name is None:
+            continue
+        held = False
+        for p in parents:
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    if _lock_of_with_item(info, item, class_locks):
+                        held = True
+        if not held:
+            scope = qualname_of(parents, node)
+            emit(findings, mod, "CC001", node, scope, target_name,
+                 f"module-level mutable `{target_name}` mutated without "
+                 "a declared lock in a threaded module")
+
+
+# ------------------------------------------------------------------- CC002
+
+class _FnSummary:
+    __slots__ = ("key", "acquires", "calls_under", "line_of")
+
+    def __init__(self, key):
+        self.key = key
+        self.acquires = set()       # lock ids taken anywhere in the body
+        self.calls_under = []       # (held_lock_id, callee_key, lineno)
+        self.line_of = {}           # lock id -> first acquisition line
+
+
+def _callee_key(info, call, cls_name):
+    """Resolve a call to a (module_key, func_name) summary key."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return (info.key, f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id == "self" and cls_name:
+            return (info.key, f"{cls_name}.{f.attr}")
+        alias = info.import_map.get(f.value.id)
+        if alias is not None:
+            return (f"@{alias}", f.attr)
+    return None
+
+
+def _summarize_functions(info, class_locks):
+    """Build _FnSummary per function: which locks it takes, and which
+    calls happen while each lock is held (with-context calls like
+    ``with watchdog.guard():`` count as calls)."""
+    summaries = {}
+    for node, parents in ParentedWalk(info.mod.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        cls = next((p.name for p in parents
+                    if isinstance(p, ast.ClassDef)), None)
+        key = (info.key, f"{cls}.{node.name}" if cls else node.name)
+        s = summaries.setdefault(key, _FnSummary(key))
+
+        def walk(body, held):
+            for stmt in body:
+                if isinstance(stmt, ast.With):
+                    new_locks = []
+                    for item in stmt.items:
+                        lock = _lock_of_with_item(info, item, class_locks)
+                        if lock is not None:
+                            s.acquires.add(lock)
+                            s.line_of.setdefault(lock, stmt.lineno)
+                            for h in held:
+                                s.calls_under.append(
+                                    (h, ("<lock>", lock), stmt.lineno))
+                            new_locks.append(lock)
+                        elif isinstance(item.context_expr, ast.Call):
+                            callee = _callee_key(info, item.context_expr,
+                                                 cls)
+                            if callee is not None:
+                                for h in held:
+                                    s.calls_under.append(
+                                        (h, callee, stmt.lineno))
+                                if not held:
+                                    s.calls_under.append(
+                                        (None, callee, stmt.lineno))
+                    walk(stmt.body, held + new_locks)
+                    continue
+                if isinstance(stmt, ast.FunctionDef):
+                    continue  # nested defs summarized separately
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        callee = _callee_key(info, sub, cls)
+                        if callee is not None:
+                            if held:
+                                for h in held:
+                                    s.calls_under.append(
+                                        (h, callee, sub.lineno))
+                            else:
+                                s.calls_under.append(
+                                    (None, callee, sub.lineno))
+                bodies = []
+                for attr in ("body", "orelse", "finalbody"):
+                    bodies.extend(getattr(stmt, attr, ()) or ())
+                for h in getattr(stmt, "handlers", ()) or ():
+                    bodies.extend(h.body)
+                if bodies:
+                    walk(bodies, held)
+
+        walk(node.body, [])
+    return summaries
+
+
+def _resolve(summaries, by_name, key):
+    """Summary for a callee key; '@alias' module refs match by module
+    basename (one level of indirection, best-effort)."""
+    if key in summaries:
+        return summaries[key]
+    mod_key, fn = key
+    if mod_key.startswith("@"):
+        return by_name.get((mod_key[1:].lstrip("."), fn))
+    return None
+
+
+def _locks_eventually(summary, summaries, by_name, memo, stack):
+    """All lock ids a call into ``summary`` may acquire (transitively)."""
+    if summary.key in memo:
+        return memo[summary.key]
+    if summary.key in stack:
+        return set()
+    stack.add(summary.key)
+    out = set(summary.acquires)
+    for _held, callee, _line in summary.calls_under:
+        if callee[0] == "<lock>":
+            continue
+        cs = _resolve(summaries, by_name, callee)
+        if cs is not None:
+            out |= _locks_eventually(cs, summaries, by_name, memo, stack)
+    stack.discard(summary.key)
+    memo[summary.key] = out
+    return out
+
+
+def _check_cc002(infos, class_locks_by_key, findings):
+    summaries = {}
+    for info in infos:
+        if info.in_scope:
+            summaries.update(
+                _summarize_functions(info, class_locks_by_key[info.key]))
+    # '@alias' resolution by (module basename, function name)
+    by_name = {}
+    for (mod_key, fn), s in summaries.items():
+        by_name[(mod_key.rsplit(".", 1)[-1], fn)] = s
+    memo = {}
+    # edges: held lock -> lock acquired later, with a representative site
+    edges = {}
+    for s in summaries.values():
+        for held, callee, line in s.calls_under:
+            if held is None:
+                continue
+            if callee[0] == "<lock>":
+                inner = {callee[1]}
+            else:
+                cs = _resolve(summaries, by_name, callee)
+                if cs is None:
+                    continue
+                inner = _locks_eventually(cs, summaries, by_name, memo,
+                                          set())
+            for lock in inner:
+                a, b = _base(held), _base(lock)
+                if a == b:
+                    continue
+                edges.setdefault((a, b), (s.key, line))
+    # cycle detection over the order graph
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    reported = set()
+    for (a, b), (fn_key, line) in sorted(edges.items(),
+                                         key=lambda kv: kv[1][1]):
+        if (b, a) in edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other_fn, other_line = edges[(b, a)]
+            mod = _mod_of(fn_key, infos)
+            if mod is None:
+                continue
+            emit(findings, mod.mod, "CC002",
+                 _FakeNode(line), fn_key[1], f"{a}<->{b}",
+                 f"lock-order cycle: `{a}` then `{b}` here, but `{b}` "
+                 f"then `{a}` in {other_fn[0]}.{other_fn[1]} (line "
+                 f"{other_line}) — deadlock potential")
+
+
+def _base(lock_id):
+    """Normalize '@alias:_LOCK' and 'pkg.mod:_LOCK' to 'mod:_LOCK' so
+    the same lock referenced two ways is one graph node."""
+    mod, _, name = lock_id.rpartition(":")
+    return f"{mod.lstrip('@').rsplit('.', 1)[-1]}:{name}"
+
+
+class _FakeNode:
+    def __init__(self, lineno):
+        self.lineno = lineno
+
+
+def _mod_of(fn_key, infos):
+    for info in infos:
+        if info.key == fn_key[0]:
+            return info
+    return None
+
+
+# ------------------------------------------------------------------- CC003
+
+def _check_cc003(info, findings):
+    mod = info.mod
+    # every name that gets .join()ed somewhere in the module, including
+    # `for t in threads: t.join()` loop aliases
+    joined = set()
+    # names daemonized AFTER construction: `t.daemon = True` or
+    # `t.setDaemon(True)` — equivalent to the daemon=True kwarg
+    daemonized = set()
+    loop_alias = {}  # loop var -> iterated name
+
+    def _recv_name(recv):
+        return recv.id if isinstance(recv, ast.Name) else \
+            recv.attr if isinstance(recv, ast.Attribute) else None
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, (ast.Name, ast.Attribute)):
+            # `for t in threads:` / `for t in self.threads:`
+            it = node.iter
+            loop_alias[node.target.id] = it.id \
+                if isinstance(it, ast.Name) else it.attr
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            name = _recv_name(node.func.value)
+            if name is not None:
+                joined.add(name)
+                joined.add(loop_alias.get(name, name))
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Attribute) and \
+                node.targets[0].attr == "daemon" and \
+                isinstance(node.value, ast.Constant) and \
+                node.value.value is True:
+            name = _recv_name(node.targets[0].value)
+            if name is not None:
+                daemonized.add(name)
+                daemonized.add(loop_alias.get(name, name))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "setDaemon" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is True:
+            name = _recv_name(node.func.value)
+            if name is not None:
+                daemonized.add(name)
+                daemonized.add(loop_alias.get(name, name))
+    for node, parents in ParentedWalk(mod.tree):
+        if not (isinstance(node, ast.Call) and
+                call_name(node).endswith("Thread")):
+            continue
+        daemon = any(k.arg == "daemon" and
+                     isinstance(k.value, ast.Constant) and
+                     k.value.value is True for k in node.keywords)
+        if daemon:
+            continue
+        # the assigned name (t = Thread(...) / [Thread... for _] / self.x),
+        # or the collection a Thread() is appended into
+        target = None
+        for p in reversed(parents):
+            if isinstance(p, ast.Assign) and len(p.targets) == 1:
+                t = p.targets[0]
+                if isinstance(t, ast.Name):
+                    target = t.id
+                elif isinstance(t, ast.Attribute):
+                    target = t.attr
+                break
+            if isinstance(p, ast.Call) and p is not node and \
+                    isinstance(p.func, ast.Attribute) and \
+                    p.func.attr in ("append", "add", "insert"):
+                # threads.append(Thread(...)) — joined via the collection
+                target = _recv_name(p.func.value)
+                break
+        if target is not None and (target in joined or
+                                   target in daemonized):
+            continue
+        scope = qualname_of(parents, node)
+        emit(findings, mod, "CC003", node, scope, target or "<anonymous>",
+             "non-daemon thread is never joined — it can hang interpreter "
+             "exit (join it, or pass daemon=True)")
+
+
+def run(project):
+    findings = []
+    infos = [_ModuleInfo(m) for m in project.modules()]
+    class_locks_by_key = {i.key: _instance_locks(i) for i in infos}
+    for info in infos:
+        if not info.in_scope:
+            continue
+        _check_cc001(info, class_locks_by_key[info.key], findings)
+        _check_cc003(info, findings)
+    _check_cc002(infos, class_locks_by_key, findings)
+    return findings

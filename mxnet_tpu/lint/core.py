@@ -1,0 +1,357 @@
+"""graftlint core: source model, findings, baseline, inline waivers.
+
+graftlint is the framework-invariant static analyzer (docs/
+static_analysis.md). Three pass families run over the whole package:
+
+- trace-safety (``TS*``)   — jitted/kernel code must never host-sync
+- concurrency  (``CC*``)   — lock discipline across the threaded subsystems
+- registry drift (``RD*``) — env knobs / counters / fault kinds stay in
+  sync with docs, ``profiler.dispatch_stats()`` and ``tools/chaos_run.py``
+
+Everything here is stdlib-only (``ast`` + ``json``): the linter must run
+in CI images with no jax and must never import the package it analyzes.
+
+Suppression has two layers:
+
+- **inline waiver** — ``# graftlint: disable=RULE[,RULE]`` on (or one
+  line above) the offending line, for invariants that are intentionally
+  relaxed at one site and explained by the surrounding comment;
+- **baseline** — ``tools/graftlint_baseline.json``, a checked-in list of
+  ``{fingerprint, rule, reason}`` entries for accepted debt. Findings in
+  the baseline are *suppressed*, not gone: the CLI reports them and the
+  delta of NEW findings is the CI gate.
+
+Fingerprints are human-readable and line-number free
+(``RULE:path:scope:token``) so routine edits above a finding don't churn
+the baseline.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+__all__ = ["Finding", "SourceModule", "Project", "load_baseline",
+           "save_baseline", "split_by_baseline", "run_all", "RULES"]
+
+# rule id -> one-line invariant (the catalog lives in docs/static_analysis.md)
+RULES = {
+    "TS001": "no implicit host sync (float/int/bool/.item/np.asarray/"
+             "control flow) on traced values in kernel or segment bodies",
+    "TS002": "no raw jax.jit outside the interned executable cache",
+    "TS003": "no read of donated input buffers after a donating dispatch",
+    "CC001": "module-level mutable state in a threaded module is only "
+             "mutated under its declared lock",
+    "CC002": "no lock-acquisition-order cycles (deadlock potential)",
+    "CC003": "every non-daemon thread is joined",
+    "RD001": "every MXNET_TPU_* env knob read in code is documented",
+    "RD002": "every counter mutated is declared in its module's _STATS",
+    "RD003": "every fault kind is exercised by tools/chaos_run.py",
+}
+
+_WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
+_ROLE_RE = re.compile(r"#\s*graftlint:\s*role=([a-z_]+)")
+
+
+class Finding:
+    """One rule violation at a concrete site."""
+
+    __slots__ = ("rule", "path", "line", "scope", "token", "message")
+
+    def __init__(self, rule, path, line, scope, token, message):
+        self.rule = rule
+        self.path = path          # repo-relative, '/'-separated
+        self.line = int(line)
+        self.scope = scope        # enclosing function qualname or '<module>'
+        self.token = token        # the specific item (knob, counter, call)
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return f"{self.rule}:{self.path}:{self.scope}:{self.token}"
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "scope": self.scope, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule} [{self.scope}] {self.message}"
+
+
+class SourceModule:
+    """One parsed source file plus its lint metadata."""
+
+    def __init__(self, abspath, relpath, role):
+        self.abspath = abspath
+        self.relpath = relpath
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=relpath)
+        m = _ROLE_RE.search("\n".join(self.lines[:10]))
+        self.role = m.group(1) if m else role
+        # lineno -> set of waived rule ids (the waiver covers its own line
+        # and the line below, so it can sit above a long statement)
+        self.waivers: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _WAIVER_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.waivers.setdefault(i, set()).update(rules)
+                self.waivers.setdefault(i + 1, set()).update(rules)
+
+    def waived(self, rule, line):
+        return rule in self.waivers.get(line, ())
+
+
+def _infer_role(relpath):
+    """Role from repo-relative path (fixtures override with a magic
+    comment). Roles steer which passes look at a file and which
+    sanctioned sites exist in it."""
+    p = relpath.replace(os.sep, "/")
+    base = os.path.basename(p)
+    if base == "registry.py" and "/ops/" in p:
+        return "registry"
+    if "/ops/" in p:
+        return "ops"
+    if base == "engine.py":
+        return "engine"
+    if base == "faults.py":
+        return "faults"
+    return "module"
+
+
+class Project:
+    """The analyzed source layout.
+
+    The defaults match this repo; tests point the same passes at mini
+    fixture trees by overriding the directories.
+    """
+
+    def __init__(self, root, package_dirs=("mxnet_tpu",),
+                 doc_dirs=("docs",), doc_files=("README.md",),
+                 tool_dirs=("tools",),
+                 chaos_files=("tools/chaos_run.py",),
+                 extra_source_files=("tests/conftest.py",),
+                 exclude_dirs=("lint",)):
+        self.root = os.path.abspath(root)
+        self.package_dirs = tuple(package_dirs)
+        self.doc_dirs = tuple(doc_dirs)
+        self.doc_files = tuple(doc_files)
+        self.tool_dirs = tuple(tool_dirs)
+        self.chaos_files = tuple(chaos_files)
+        self.extra_source_files = tuple(extra_source_files)
+        self.exclude_dirs = set(exclude_dirs) | {"__pycache__"}
+        self._modules = None
+        self._aux = {}
+
+    # ------------------------------------------------------------- sources
+    def modules(self):
+        """Parsed package modules (the analyzed surface)."""
+        if self._modules is None:
+            self._modules = []
+            for pkg in self.package_dirs:
+                top = os.path.join(self.root, pkg)
+                for dirpath, dirnames, filenames in os.walk(top):
+                    dirnames[:] = sorted(d for d in dirnames
+                                         if d not in self.exclude_dirs)
+                    for name in sorted(filenames):
+                        if not name.endswith(".py"):
+                            continue
+                        abspath = os.path.join(dirpath, name)
+                        rel = os.path.relpath(abspath, self.root).replace(
+                            os.sep, "/")
+                        self._modules.append(
+                            SourceModule(abspath, rel, _infer_role(rel)))
+        return self._modules
+
+    def aux_module(self, relpath):
+        """Parse one non-package file (tools, conftest) on demand; None
+        when absent or unparsable."""
+        if relpath not in self._aux:
+            abspath = os.path.join(self.root, relpath)
+            try:
+                self._aux[relpath] = SourceModule(abspath, relpath,
+                                                  "module")
+            except (OSError, SyntaxError):
+                self._aux[relpath] = None
+        return self._aux[relpath]
+
+    def knob_source_modules(self):
+        """Files scanned for MXNET_TPU_* env reads: the package, tools/,
+        and the extra sources (tests/conftest.py reads the test-platform
+        knob)."""
+        out = list(self.modules())
+        for tdir in self.tool_dirs:
+            top = os.path.join(self.root, tdir)
+            if not os.path.isdir(top):
+                continue
+            for name in sorted(os.listdir(top)):
+                if name.endswith(".py"):
+                    mod = self.aux_module(f"{tdir}/{name}")
+                    if mod is not None:
+                        out.append(mod)
+        for rel in self.extra_source_files:
+            mod = self.aux_module(rel)
+            if mod is not None:
+                out.append(mod)
+        return out
+
+    def doc_text(self):
+        """Concatenated documentation text knobs must appear in."""
+        chunks = []
+        for ddir in self.doc_dirs:
+            top = os.path.join(self.root, ddir)
+            if not os.path.isdir(top):
+                continue
+            for name in sorted(os.listdir(top)):
+                if name.endswith((".md", ".rst", ".txt")):
+                    with open(os.path.join(top, name),
+                              encoding="utf-8") as f:
+                        chunks.append(f.read())
+        for rel in self.doc_files:
+            path = os.path.join(self.root, rel)
+            if os.path.isfile(path):
+                with open(path, encoding="utf-8") as f:
+                    chunks.append(f.read())
+        return "\n".join(chunks)
+
+    def faults_modules(self):
+        return [m for m in self.modules() if m.role == "faults"]
+
+    def chaos_modules(self):
+        out = []
+        for rel in self.chaos_files:
+            mod = self.aux_module(rel)
+            if mod is not None:
+                out.append(mod)
+        return out
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path):
+    """Baseline file -> {fingerprint: entry}. Missing file = empty."""
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("suppressions", ())}
+
+
+def save_baseline(path, findings, reasons=None, keep=None, retain=None):
+    """Write a baseline from ``findings``. ``reasons`` maps fingerprint ->
+    reason string; entries already in ``keep`` (a loaded baseline dict)
+    retain their reviewed reason. New entries get a placeholder reason
+    that a reviewer must replace before check-in. ``retain`` is a loaded
+    baseline dict of entries to carry over verbatim — used when only a
+    subset of rules ran, so suppressions for the unselected rules are
+    not silently dropped."""
+    reasons = reasons or {}
+    keep = keep or {}
+    entries = []
+    seen = set()
+    for f in findings:
+        fp = f.fingerprint
+        if fp in seen:
+            continue
+        seen.add(fp)
+        prior = keep.get(fp)
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "reason": reasons.get(fp) or (prior or {}).get("reason")
+            or "TODO: reviewed-by nobody — replace with a real reason",
+        })
+    for fp, e in (retain or {}).items():
+        if fp not in seen:
+            seen.add(fp)
+            entries.append(dict(e))
+    entries.sort(key=lambda e: e["fingerprint"])
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "suppressions": entries}, f, indent=1)
+        f.write("\n")
+    return entries
+
+
+def split_by_baseline(findings, baseline):
+    """-> (new, suppressed, stale_fingerprints)."""
+    new, suppressed = [], []
+    live = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            suppressed.append(f)
+            live.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - live)
+    return new, suppressed, stale
+
+
+# ------------------------------------------------------------------- ast util
+
+class ParentedWalk:
+    """Yield (node, ancestors) depth-first; ancestors is root-first."""
+
+    def __init__(self, tree):
+        self.tree = tree
+
+    def __iter__(self):
+        stack = [(self.tree, ())]
+        while stack:
+            node, parents = stack.pop()
+            yield node, parents
+            child_parents = parents + (node,)
+            for child in reversed(list(ast.iter_child_nodes(node))):
+                stack.append((child, child_parents))
+
+
+def qualname_of(parents, node):
+    """Dotted name of the function/class scope a node sits in."""
+    parts = [p.name for p in parents
+             if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))]
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        parts.append(node.name)
+    return ".".join(parts) or "<module>"
+
+
+def call_name(node):
+    """Best-effort dotted name of a Call's func ('jax.jit', 'register')."""
+    try:
+        return ast.unparse(node.func)
+    except Exception:
+        return ""
+
+
+def emit(findings, mod, rule, node, scope, token, message):
+    """Append one Finding unless an inline waiver covers its line."""
+    line = getattr(node, "lineno", 0)
+    if mod.waived(rule, line):
+        return
+    findings.append(Finding(rule, mod.relpath, line, scope, token, message))
+
+
+# ---------------------------------------------------------------------- runner
+
+def run_all(project, rules=None):
+    """Run every pass (or only the families of the selected rule ids)
+    over ``project``; returns inline-waiver-filtered findings sorted by
+    site."""
+    from . import concurrency, registry_drift, trace_safety
+
+    want = set(rules) if rules else None
+    findings = []
+    for prefix, family in (("TS", trace_safety), ("CC", concurrency),
+                           ("RD", registry_drift)):
+        if want is not None and not any(r.startswith(prefix)
+                                        for r in want):
+            continue
+        findings.extend(family.run(project))
+    if want is not None:
+        findings = [f for f in findings if f.rule in want]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.token))
+    return findings

@@ -1,0 +1,234 @@
+"""Registry-drift passes (RD001-RD003).
+
+Three registries drift silently as the codebase grows: env knobs
+(``MXNET_TPU_*``) appear in code faster than in docs, counters get
+incremented that no ``_STATS`` literal declares (so ``reset`` misses
+them and ``profiler.dispatch_stats()`` only shows them after first
+fire), and fault kinds get added to ``resilience/faults.py`` that
+``tools/chaos_run.py`` never drills — an untested recovery path is an
+untrusted one. These passes pin each registry to its consumers.
+
+Policy: RD findings describe *repository state*, not a single line, so
+the acceptance bar is zero — they are fixed (document the knob, declare
+the counter, add the drill), never baselined.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, ParentedWalk, call_name, qualname_of
+
+_KNOB_RE = re.compile(r"^MXNET_TPU_[A-Z0-9_]+$")
+
+
+# ------------------------------------------------------------------- RD001
+
+def _knob_literals(mod):
+    """(knob, node) and (prefix, node) string constants in one module.
+    A literal ending in '_' (or an f-string's leading chunk) is a prefix
+    that expands at runtime — it is satisfied when some documented knob
+    starts with it."""
+    knobs, prefixes = [], []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _KNOB_RE.match(node.value):
+            if node.value.endswith("_"):
+                prefixes.append((node.value, node))
+            else:
+                knobs.append((node.value, node))
+    return knobs, prefixes
+
+
+def _documented(knob, doc_text):
+    """Whole-token occurrence: `MXNET_TPU_CKPT` must not be satisfied by
+    a documented `MXNET_TPU_CKPT_KEEP`."""
+    return re.search(r"(?<![A-Z0-9_])" + re.escape(knob) + r"(?![A-Z0-9_])",
+                     doc_text) is not None
+
+
+def _check_rd001(project, findings):
+    doc_text = project.doc_text()
+    seen = set()
+    for mod in project.knob_source_modules():
+        knobs, prefixes = _knob_literals(mod)
+        for knob, node in knobs:
+            if knob in seen or _documented(knob, doc_text):
+                continue
+            # waiver check BEFORE dedup: a waiver covers one read site,
+            # not every other module reading the same undocumented knob
+            if mod.waived("RD001", getattr(node, "lineno", 0)):
+                continue
+            seen.add(knob)
+            findings.append(Finding(
+                "RD001", mod.relpath, node.lineno, "<module>", knob,
+                f"env knob `{knob}` is read in code but documented "
+                "nowhere under docs/ (add it to docs/env_vars.md)"))
+        for prefix, node in prefixes:
+            if prefix in seen:
+                continue
+            if not re.search(re.escape(prefix) + r"[A-Z0-9_]", doc_text):
+                if mod.waived("RD001", getattr(node, "lineno", 0)):
+                    continue
+                seen.add(prefix)
+                findings.append(Finding(
+                    "RD001", mod.relpath, node.lineno, "<module>", prefix,
+                    f"dynamic env-knob prefix `{prefix}*` matches no "
+                    "documented knob"))
+
+
+# ------------------------------------------------------------------- RD002
+
+def _declared_counters(mod):
+    """Keys of the module-level ``_STATS = {...}`` literal, or None."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == "_STATS" and \
+                isinstance(stmt.value, ast.Dict):
+            return {k.value for k in stmt.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _imports_stats_from_package(mod):
+    """True when the module does ``from . import _STATS`` (the serving
+    submodule pattern: counters live in the package __init__)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.level >= 1 and \
+                not node.module:
+            if any(a.name == "_STATS" for a in node.names):
+                return True
+    return False
+
+
+def _package_init_counters(mod, by_path):
+    """Declared counters of the package __init__ next to ``mod``."""
+    parent = mod.relpath.rsplit("/", 1)[0]
+    init = by_path.get(f"{parent}/__init__.py")
+    if init is None:
+        return None
+    return _declared_counters(init)
+
+
+def _check_rd002(project, findings):
+    mods = project.modules()
+    by_path = {m.relpath: m for m in mods}
+    for mod in mods:
+        declared = _declared_counters(mod)
+        if declared is None and _imports_stats_from_package(mod):
+            declared = _package_init_counters(mod, by_path)
+        if declared is None:
+            continue
+        for node, parents in ParentedWalk(mod.tree):
+            key_node = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "_STATS" and \
+                            isinstance(t.slice, ast.Constant) and \
+                            isinstance(t.slice.value, str):
+                        key_node = t.slice
+            if key_node is None:
+                continue
+            # reset loops (`for k in _STATS: _STATS[k] = 0`) use Name
+            # slices and never reach here; only literal keys are audited
+            key = key_node.value
+            if key in declared:
+                continue
+            scope = qualname_of(parents, node)
+            if mod.waived("RD002", node.lineno):
+                continue
+            findings.append(Finding(
+                "RD002", mod.relpath, node.lineno, scope, key,
+                f"counter `{key}` is mutated but not declared in this "
+                "module's _STATS literal — reset_stats() and "
+                "profiler.dispatch_stats() will miss it until first "
+                "increment"))
+
+
+# ------------------------------------------------------------------- RD003
+
+def _fault_kinds(project):
+    """Fault kinds the harness knows: string literals consulted via
+    ``_ACTIVE.get("kind")`` inside faults.py, plus literal arguments of
+    ``maybe_crash("point")`` / ``maybe_hang("point")`` anywhere in the
+    package (crash/hang points are named by their call sites)."""
+    kinds = {}
+    for mod in project.faults_modules():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node).endswith("_ACTIVE.get") and node.args \
+                    and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                kinds.setdefault(node.args[0].value, (mod, node.lineno))
+    for mod in project.modules():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node).split(".")[-1] in ("maybe_crash",
+                                                       "maybe_hang") \
+                    and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                # anchor at the actual call site so the finding points at
+                # a real line and inline waivers there apply
+                kinds.setdefault(node.args[0].value, (mod, node.lineno))
+    return kinds
+
+
+def _chaos_strings(project):
+    """Kind literals that count as drill coverage: arguments of
+    ``faults.inject("kind")``, ``kind == "..."`` dispatch comparisons,
+    and ``*KINDS*`` tuple/list assignments (tier-1 auto-parametrizes
+    over those, so an undrilled entry fails at runtime). A kind merely
+    named in a docstring or message string does NOT count."""
+    out = set()
+    for mod in project.chaos_modules():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node).split(".")[-1] == "inject" and \
+                    node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                out.add(node.args[0].value)
+            elif isinstance(node, ast.Compare):
+                for sub in [node.left] + list(node.comparators):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        out.add(sub.value)
+            elif isinstance(node, ast.Assign):
+                if any(isinstance(t, ast.Name) and "KINDS" in t.id
+                       for t in node.targets):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            out.add(sub.value)
+    return out
+
+
+def _check_rd003(project, findings):
+    if not project.chaos_modules():
+        return
+    covered = _chaos_strings(project)
+    for kind, (mod, lineno) in sorted(_fault_kinds(project).items()):
+        if kind in covered:
+            continue
+        if mod.waived("RD003", lineno):
+            continue
+        findings.append(Finding(
+            "RD003", mod.relpath, lineno, "<module>", kind,
+            f"fault kind `{kind}` is never exercised by "
+            "tools/chaos_run.py — an undrilled recovery path is an "
+            "untrusted one"))
+
+
+def run(project):
+    findings = []
+    _check_rd001(project, findings)
+    _check_rd002(project, findings)
+    _check_rd003(project, findings)
+    return findings

@@ -1,0 +1,574 @@
+"""Trace-safety passes (TS001-TS003).
+
+The whole-program-compilation contract (ROADMAP item 3, the Julia-to-TPU
+paper): code that runs under a jax trace — op kernel bodies in
+``mxnet_tpu/ops/*``, bulked-segment replay in ``engine.py``, the eager
+executable wrappers in ``ops/registry.py`` — must be *trace-pure*. A
+``float()``/``.item()``/``np.asarray`` on a traced value either blocks
+the host on the device (silent performance cliff) or raises a
+TracerConversionError three layers away from the defect. These passes
+prove such code is absent, so ``capture()`` and INT8 fusion can assume
+it.
+
+Taint model (TS001): inside a kernel, the *positional-without-default*
+parameters are the traced arrays (the registry's calling convention:
+``fn(*arrays, **params)`` — static params always carry defaults), and
+taint propagates through assignments, arithmetic, jnp calls, subscripts
+and loops. ``.shape``/``.dtype``/``.ndim``/``.size`` are static under
+trace and drop taint. An ``isinstance(x, <Tracer>)`` check whose body
+raises/returns is recognized as a *tracer guard* and untaints ``x`` —
+the sanctioned idiom for host-only ops (see
+``_contrib_calibrate_entropy``).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import ParentedWalk, call_name, emit, qualname_of
+
+# attributes that are compile-time constants under trace: reading them
+# off a tracer never syncs
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+
+# .m() calls that force a traced value onto the host
+_SYNC_METHODS = {"item", "tolist", "asnumpy", "block_until_ready"}
+
+# builtins that coerce (and therefore sync) a traced scalar
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+# numpy functions that materialize their argument on the host
+_NUMPY_SINKS = {"asarray", "array", "ascontiguousarray", "copyto",
+                "asanyarray"}
+
+# builtins whose result is static even over traced operands (arity,
+# type identity — no device read involved)
+_STATIC_BUILTINS = {"len", "isinstance", "hasattr", "type", "callable",
+                    "issubclass", "id", "repr"}
+
+# functions compiled/traced by jax; their bodies are traced scopes.
+# role -> (predicate(funcdef, parents) -> bool)
+_SANCTIONED_JIT = {
+    # the interned eager cache is THE place allowed to call jax.jit
+    "registry": {"_compile"},
+    # a recorded bulk segment compiles itself exactly once, keyed+cached
+    "engine": {"_flush"},
+}
+
+
+def _numpy_aliases(tree):
+    """Names bound to the numpy module (or its sink functions) anywhere in
+    the file — kernels import numpy locally, so scan every import."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy" or a.name.startswith("numpy."):
+                    mod_aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "numpy":
+                for a in node.names:
+                    if a.name in _NUMPY_SINKS:
+                        fn_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _is_tracer_guard(test):
+    """Names checked by ``isinstance(x, <...Tracer...>)`` (possibly
+    or-ed: ``isinstance(a, T) or isinstance(b, T)``), else []."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        names = []
+        for v in test.values:
+            got = _is_tracer_guard(v)
+            if not got:
+                return []
+            names.extend(got)
+        return names
+    if not (isinstance(test, ast.Call) and isinstance(test.func, ast.Name)
+            and test.func.id == "isinstance" and len(test.args) == 2):
+        return []
+    try:
+        klass = ast.unparse(test.args[1])
+    except Exception:
+        return []
+    if "Tracer" in klass or "tracer_class" in klass:
+        target = test.args[0]
+        if isinstance(target, ast.Name):
+            return [target.id]
+    return []
+
+
+class _KernelChecker:
+    """TS001 over one traced function body."""
+
+    def __init__(self, mod, fn, scope, findings, np_mods, np_fns,
+                 static_helpers=()):
+        self.mod = mod
+        self.scope = scope
+        self.findings = findings
+        self.np_mods = np_mods
+        self.np_fns = np_fns
+        self.static_helpers = set(static_helpers)
+        self.returns_tainted = False
+        self.tainted = set()
+        for i, a in enumerate(fn.args.args):
+            if i < len(fn.args.args) - len(fn.args.defaults):
+                self.tainted.add(a.arg)
+        if fn.args.vararg is not None:
+            self.tainted.add(fn.args.vararg.arg)
+        self.body = fn.body
+
+    # ---------------------------------------------------------- taint query
+    def is_tainted(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity tests never touch the device
+            return self.is_tainted(node.left) or \
+                any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and \
+                    (node.func.id in _STATIC_BUILTINS or
+                     node.func.id in self.static_helpers):
+                return False  # arity/type checks and shape-only helpers
+            # a method call on a traced receiver yields a traced value
+            # (x.sum(), x.astype(...)); static attrs untaint above, so
+            # x.aval.m() stays clean
+            if isinstance(node.func, ast.Attribute) and \
+                    self.is_tainted(node.func.value):
+                return True
+            # a call over traced values yields traced values (jnp.*)
+            return any(self.is_tainted(a) for a in node.args) or \
+                any(self.is_tainted(k.value) for k in node.keywords)
+        return False
+
+    def _taint_target(self, target, on):
+        names = [n.id for n in ast.walk(target) if isinstance(n, ast.Name)]
+        for n in names:
+            if on:
+                self.tainted.add(n)
+            else:
+                self.tainted.discard(n)
+
+    # ------------------------------------------------------------ violations
+    def _check_expr(self, node):
+        for sub, _parents in ParentedWalk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            args = list(sub.args) + [k.value for k in sub.keywords]
+            any_tainted = any(self.is_tainted(a) for a in args)
+            fname = call_name(sub)
+            if isinstance(sub.func, ast.Name) and \
+                    sub.func.id in _SYNC_BUILTINS and args and any_tainted:
+                self._emit(sub, f"{sub.func.id}()",
+                           f"{sub.func.id}() coerces a traced value on "
+                           "the host")
+            elif isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _SYNC_METHODS and \
+                    self.is_tainted(sub.func.value):
+                self._emit(sub, f".{sub.func.attr}()",
+                           f".{sub.func.attr}() forces a device sync on a "
+                           "traced value")
+            elif isinstance(sub.func, ast.Attribute) and \
+                    isinstance(sub.func.value, ast.Name) and \
+                    sub.func.value.id in self.np_mods and \
+                    sub.func.attr in _NUMPY_SINKS and any_tainted:
+                self._emit(sub, fname,
+                           f"{fname}() materializes a traced value on the "
+                           "host (use jnp, or add a tracer guard)")
+            elif isinstance(sub.func, ast.Name) and \
+                    sub.func.id in self.np_fns and any_tainted:
+                self._emit(sub, fname,
+                           f"{fname}() (numpy) materializes a traced value "
+                           "on the host")
+
+    def _check_branch_test(self, test, kind):
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+            return  # identity tests never sync
+        if _is_tracer_guard(test):
+            return
+        if self.is_tainted(test):
+            self._emit(test, f"{kind}-on-traced",
+                       f"Python `{kind}` on a traced value forces a host "
+                       "sync (trace-time error under jit) — use jnp.where/"
+                       "lax.cond")
+
+    def _emit(self, node, token, why):
+        emit(self.findings, self.mod, "TS001", node, self.scope, token,
+             f"implicit host sync in traced code: {why}")
+
+    def _inner_usage(self, fndef):
+        """How the enclosing body uses inner function ``fndef``:
+        (used_as_callback, per-positional-arg taint, starred_args)."""
+        callback = False
+        star = False
+        pos_taint = [False] * len(fndef.args.args)
+        call_func_ids = set()
+        for top in self.body:
+            for sub in ast.walk(top):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and \
+                        sub.func.id == fndef.name:
+                    call_func_ids.add(id(sub.func))
+                    for i, a in enumerate(sub.args):
+                        if isinstance(a, ast.Starred):
+                            star = True
+                            callback = callback or self.is_tainted(a.value)
+                        elif i < len(pos_taint) and self.is_tainted(a):
+                            pos_taint[i] = True
+        for top in self.body:
+            for sub in ast.walk(top):
+                if isinstance(sub, ast.Name) and sub.id == fndef.name and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        id(sub) not in call_func_ids:
+                    callback = True  # passed to lax.scan/cond/vjp/...
+        return callback, pos_taint, star
+
+    # --------------------------------------------------------------- driver
+    def run(self):
+        self._run_body(self.body)
+
+    def _run_body(self, body):
+        for stmt in body:
+            self._run_stmt(stmt)
+
+    def _run_stmt(self, stmt):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._check_expr(value)
+                on = self.is_tainted(value)
+                if isinstance(stmt, ast.AugAssign):
+                    # `s += 1` keeps s traced — OR with the target's taint
+                    on = on or self.is_tainted(stmt.target)
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    if isinstance(t, (ast.Name, ast.Tuple, ast.List)):
+                        self._taint_target(t, on)
+        elif isinstance(stmt, ast.If):
+            self._check_branch_test(stmt.test, "if")
+            self._check_expr(stmt.test)
+            guards = _is_tracer_guard(stmt.test)
+            self._run_body(stmt.body)
+            self._run_body(stmt.orelse)
+            if guards and any(isinstance(s, (ast.Raise, ast.Return))
+                              for s in stmt.body):
+                for g in guards:
+                    self.tainted.discard(g)
+        elif isinstance(stmt, ast.While):
+            self._check_branch_test(stmt.test, "while")
+            self._check_expr(stmt.test)
+            self._run_body(stmt.body)
+            self._run_body(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            self._check_branch_test(stmt.test, "assert")
+            self._check_expr(stmt.test)
+        elif isinstance(stmt, ast.For):
+            self._check_expr(stmt.iter)
+            self._taint_target(stmt.target, self.is_tainted(stmt.iter))
+            self._run_body(stmt.body)
+            self._run_body(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+            self._run_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._run_body(stmt.body)
+            for h in stmt.handlers:
+                self._run_body(h.body)
+            self._run_body(stmt.orelse)
+            self._run_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # inner defs: taint their params from how the kernel uses
+            # them — passed as a callback (lax.scan/cond body) means all
+            # params receive traced operands; called directly means each
+            # param inherits its call sites' argument taint
+            callback, pos_taint, star = self._inner_usage(stmt)
+            inner = _KernelChecker.__new__(_KernelChecker)
+            inner.mod, inner.scope = self.mod, f"{self.scope}.{stmt.name}"
+            inner.findings = self.findings
+            inner.np_mods, inner.np_fns = self.np_mods, self.np_fns
+            inner.static_helpers = self.static_helpers
+            inner.returns_tainted = False
+            inner.tainted = set(self.tainted)
+            for i, a in enumerate(stmt.args.args):
+                if callback or (i < len(pos_taint) and pos_taint[i]):
+                    inner.tainted.add(a.arg)
+                else:
+                    inner.tainted.discard(a.arg)
+            if stmt.args.vararg is not None:
+                if callback or star:
+                    inner.tainted.add(stmt.args.vararg.arg)
+                else:
+                    inner.tainted.discard(stmt.args.vararg.arg)
+            inner.body = stmt.body
+            inner.run()
+        elif isinstance(stmt, (ast.Return, ast.Expr, ast.Raise)):
+            value = getattr(stmt, "value", None) or \
+                getattr(stmt, "exc", None)
+            if value is not None:
+                if isinstance(stmt, ast.Return) and self.is_tainted(value):
+                    self.returns_tainted = True
+                self._check_expr(value)
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self._check_expr(sub)
+
+
+def _is_register_decorated(fn):
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == "register":
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == "register":
+            return True
+    return False
+
+
+def _traced_scopes(mod):
+    """(funcdef, scope-qualname) pairs whose bodies run under trace."""
+    out = []
+    for node, parents in ParentedWalk(mod.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if mod.role == "ops" and _is_register_decorated(node):
+            out.append((node, parents))
+        elif mod.role == "engine" and node.name == "seg_fn":
+            out.append((node, parents))
+        elif mod.role == "registry" and node.name == "traced":
+            out.append((node, parents))
+    return out
+
+
+def _static_helpers(mod, np_mods, np_fns):
+    """Module-level non-kernel functions that stay static over traced
+    inputs (``_batched(x) -> x.ndim == 4``): every return value is
+    untainted even with all params tainted. Calls to them drop taint."""
+    out = set()
+    for stmt in mod.tree.body:
+        if not isinstance(stmt, ast.FunctionDef) or \
+                _is_register_decorated(stmt):
+            continue
+        probe = _KernelChecker(mod, stmt, f"<helper {stmt.name}>", [],
+                               np_mods, np_fns)
+        probe.tainted = {a.arg for a in stmt.args.args}
+        if stmt.args.vararg is not None:
+            probe.tainted.add(stmt.args.vararg.arg)
+        probe.run()
+        if not probe.returns_tainted and not probe.findings:
+            out.add(stmt.name)
+    return out
+
+
+def _module_helpers(mod):
+    """Module-level non-kernel functions callable from kernel bodies."""
+    return {stmt.name: stmt for stmt in mod.tree.body
+            if isinstance(stmt, ast.FunctionDef)
+            and not _is_register_decorated(stmt)}
+
+
+def _helper_call_taints(checker, helper_names):
+    """(name, per-positional-arg taint, blanket) for each direct call
+    from ``checker``'s body to a module-level helper. ``blanket`` means
+    a starred/keyword argument was tainted — taint every param."""
+    out = []
+    for top in checker.body:
+        for sub in ast.walk(top):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id in helper_names:
+                taints, blanket = [], False
+                for a in sub.args:
+                    if isinstance(a, ast.Starred):
+                        blanket = blanket or checker.is_tainted(a.value)
+                    else:
+                        taints.append(checker.is_tainted(a))
+                if any(checker.is_tainted(k.value) for k in sub.keywords):
+                    blanket = True
+                out.append((sub.func.id, taints, blanket))
+    return out
+
+
+def _check_ts001(mod, findings):
+    np_mods, np_fns = _numpy_aliases(mod.tree)
+    helpers = _static_helpers(mod, np_mods, np_fns)
+    module_fns = _module_helpers(mod)
+    seen = set()
+    sources = []
+    for fn, parents in _traced_scopes(mod):
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        scope = qualname_of(parents, fn)
+        ck = _KernelChecker(mod, fn, scope, findings, np_mods, np_fns,
+                            static_helpers=helpers)
+        ck.run()
+        sources.append(ck)
+    # interprocedural step: a non-static module helper called with traced
+    # args from traced code runs under the trace too — analyze its body
+    # with the union of its call sites' taints (fixpoint over
+    # helper->helper calls; a widened re-run replaces the previous
+    # findings so nothing duplicates)
+    analyzed = {}   # helper name -> union of tainted param names so far
+    results = {}    # helper name -> findings of the latest (widest) run
+    while sources:
+        next_sources = []
+        for ck in sources:
+            for name, taints, blanket in _helper_call_taints(ck,
+                                                             module_fns):
+                if name in helpers:
+                    continue  # proven static: no syncs, untainted return
+                fndef = module_fns[name]
+                params = [a.arg for a in fndef.args.args]
+                tset = set(params) if blanket else \
+                    {params[i] for i, t in enumerate(taints)
+                     if t and i < len(params)}
+                if blanket and fndef.args.vararg is not None:
+                    tset.add(fndef.args.vararg.arg)
+                prev = analyzed.get(name, set())
+                if not tset or tset <= prev:
+                    continue
+                analyzed[name] = prev | tset
+                out = []
+                hk = _KernelChecker(mod, fndef, fndef.name, out,
+                                    np_mods, np_fns,
+                                    static_helpers=helpers)
+                hk.tainted = set(analyzed[name])
+                hk.run()
+                results[name] = out
+                next_sources.append(hk)
+        sources = next_sources
+    for out in results.values():
+        findings.extend(out)
+
+
+def _check_ts002(mod, findings):
+    """Raw jax.jit outside the sanctioned compile sites. Every executable
+    must come from the interned eager cache (ops/registry.py), the
+    segment cache (engine.py) or an explicitly keyed cache — a bare
+    jax.jit at op level dodges donation, interning and the dispatch
+    counters."""
+    jit_names, jax_mods = _jit_aliases(mod.tree)
+    # a literal `jax.jit` counts even when the import happened elsewhere
+    # (e.g. jax handed in as an argument)
+    jax_mods = jax_mods | {"jax"}
+    sanctioned = _SANCTIONED_JIT.get(mod.role, set())
+    for node, parents in ParentedWalk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node)
+        root, _, attr = fname.rpartition(".")
+        is_jit = (attr in ("jit", "pjit") and root in jax_mods) or \
+            (isinstance(node.func, ast.Name) and node.func.id in jit_names)
+        if not is_jit:
+            continue
+        fn_names = {p.name for p in parents if isinstance(p, ast.FunctionDef)}
+        if fn_names & sanctioned:
+            continue
+        scope = qualname_of(parents, node)
+        emit(findings, mod, "TS002", node, scope, fname,
+             f"raw {fname}() bypasses the interned executable cache "
+             "(route through ops.registry dispatch or a keyed cache)")
+
+
+def _jit_aliases(tree):
+    """Names this module binds to jax.jit/jax.pjit: ``from jax import
+    jit [as j]`` binds a bare name; ``import jax [as j]`` (or a bare
+    ``import jax.sub``) binds a module whose ``.jit`` attribute is the
+    same function. Returns (bare_names, module_aliases)."""
+    names, mods = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name in ("jit", "pjit"):
+                        names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    mods.add(a.asname or "jax")
+                elif a.name.startswith("jax.") and a.asname is None:
+                    mods.add("jax")
+    return names, mods
+
+
+def _check_ts003(mod, findings):
+    """Donated-buffer read after dispatch. In a donation-aware function
+    (one that names ``donate``/``donated``), once the executable has been
+    invoked with ``fn(*arrays, ...)`` the donated input buffers may
+    already be deleted — any later non-dispatch read of that arrays
+    variable is a use-after-free on HBM."""
+    for node, parents in ParentedWalk(mod.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        src_names = {n.id for n in ast.walk(node)
+                     if isinstance(n, ast.Name)}
+        src_names |= {a.arg for a in ast.walk(node)
+                      if isinstance(a, ast.arg)}
+        if not any("donat" in s for s in src_names):
+            continue
+        scope = qualname_of(parents, node)
+        # the dispatch calls: Name(...) with a Starred(Name) argument
+        dispatch_calls = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name):
+                for a in sub.args:
+                    if isinstance(a, ast.Starred) and \
+                            isinstance(a.value, ast.Name):
+                        dispatch_calls.append((sub, a.value.id))
+        if not dispatch_calls:
+            continue
+        first_line = min(c.lineno for c, _ in dispatch_calls)
+        arr_names = {name for _, name in dispatch_calls}
+        # any read of the dispatched arrays after the first dispatch that
+        # is not itself a Starred dispatch operand is a donated read
+        starred_ids = set()
+        for c, _ in dispatch_calls:
+            for a in c.args:
+                if isinstance(a, ast.Starred):
+                    starred_ids.add(id(a.value))
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in arr_names and \
+                    isinstance(sub.ctx, ast.Load) and \
+                    sub.lineno > first_line and id(sub) not in starred_ids:
+                emit(findings, mod, "TS003", sub, scope, sub.id,
+                     f"read of `{sub.id}` after a donating dispatch — "
+                     "the input buffers may already be deleted "
+                     "(donate_argnums)")
+
+
+def run(project):
+    findings = []
+    for mod in project.modules():
+        if mod.role in ("ops", "engine", "registry"):
+            _check_ts001(mod, findings)
+            _check_ts002(mod, findings)
+        if mod.role == "registry":
+            _check_ts003(mod, findings)
+    return findings

@@ -671,11 +671,20 @@ def _where(cond, x, y):
     return jnp.where(cond.astype(bool), x, y)
 
 
-@register("boolean_mask")
+@register("boolean_mask", host=True)
 def _boolean_mask(data, mask, axis=0):
-    # dynamic-shape op: TPU requires static shapes; document + host fallback
+    # dynamic-shape op: host=True dispatches it outside the jitted
+    # executable cache, so the mask read below is a legal host read
     import numpy as np
 
+    from .registry import tracer_class
+
+    if isinstance(mask, tracer_class()):
+        raise NotImplementedError(
+            "boolean_mask produces a data-dependent output shape and "
+            "cannot run under jit/trace on TPU; move it outside the "
+            "jitted region (eager dispatch runs it on the host), or "
+            "express the computation with jnp.where over a static shape")
     return jnp.compress(np.asarray(mask).astype(bool), data, axis=axis)
 
 
@@ -759,8 +768,9 @@ def _topk(a, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
 
 @register("histogram", no_grad=True, num_outputs=2)
 def _histogram(a, bin_cnt=10, range=None):
-    lo, hi = range if range is not None else (float(a.min()), float(a.max()))
-    cnt, edges = jnp.histogram(a, bins=bin_cnt, range=(lo, hi))
+    # range=None lets jnp derive (min, max) as traced values — coercing
+    # them through float() here would host-sync under jit
+    cnt, edges = jnp.histogram(a, bins=bin_cnt, range=range)
     return cnt.astype(jnp.float32), edges.astype(jnp.float32)
 
 

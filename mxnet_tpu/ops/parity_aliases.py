@@ -505,7 +505,7 @@ def _calibrate_entropy_op(hist, hist_edges, num_quantized_bins=255):
     Returns (min, max) range."""
     import jax.core as jcore
 
-    if isinstance(hist, jcore.Tracer):
+    if isinstance(hist, jcore.Tracer) or isinstance(hist_edges, jcore.Tracer):
         raise NotImplementedError(
             "_contrib_calibrate_entropy is a host-side calibration op; "
             "call it eagerly, outside jit")

@@ -64,11 +64,12 @@ class OpDef:
 
     __slots__ = (
         "name", "fn", "num_outputs", "mutate", "aliases", "no_grad",
-        "param_normalizer", "dynamic_params", "doc",
+        "param_normalizer", "dynamic_params", "host", "doc",
     )
 
     def __init__(self, name, fn, num_outputs=1, mutate=(), aliases=(),
-                 no_grad=False, param_normalizer=None, dynamic_params=()):
+                 no_grad=False, param_normalizer=None, dynamic_params=(),
+                 host=False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
@@ -79,6 +80,11 @@ class OpDef:
         self.no_grad = no_grad
         self.param_normalizer = param_normalizer
         self.dynamic_params = tuple(dynamic_params)
+        # host: the kernel has a data-dependent output shape and must run
+        # outside the jitted executable cache (it may read operands on the
+        # host); under an enclosing trace it is still called directly, and
+        # is expected to raise a clear error there
+        self.host = host
         self.doc = fn.__doc__
 
     def n_out(self, params):
@@ -115,14 +121,14 @@ class OpDef:
 
 
 def register(name, *, num_outputs=1, mutate=(), aliases=(), no_grad=False,
-             param_normalizer=None, dynamic_params=()):
+             param_normalizer=None, dynamic_params=(), host=False):
     """Decorator registering a jax-traceable function as an operator."""
 
     def _reg(fn):
         op = OpDef(name, fn, num_outputs=num_outputs, mutate=mutate,
                    aliases=aliases, no_grad=no_grad,
                    param_normalizer=param_normalizer,
-                   dynamic_params=dynamic_params)
+                   dynamic_params=dynamic_params, host=host)
         _OPS[name] = op
         for a in aliases:
             _ALIASES[a] = name
@@ -309,6 +315,15 @@ def _set_bulk_hook(hook, placeholder_cls):
     _PLACEHOLDER_CLS = placeholder_cls
 
 
+def _force_placeholders(arrays):
+    """Resolve any lazy bulking placeholders to concrete buffers."""
+    ph = _PLACEHOLDER_CLS
+    if ph is not None and any(type(a) is ph for a in arrays):
+        arrays = tuple(
+            a._mxtpu_force() if type(a) is ph else a for a in arrays)
+    return arrays
+
+
 _AUTOGRAD = None
 
 
@@ -426,17 +441,19 @@ def dispatch(op, params, arrays, device, is_traced=None):
         ring.append((next(_profiler._DISPATCH_SEQ),
                      _time.perf_counter(), op.name))
 
+    if op.host:
+        # dynamic-output-shape op: runs unjitted so it may read operands
+        # on the host; resolve lazy bulking placeholders first
+        arrays = _force_placeholders(arrays)
+        return op.closed(params)(*arrays)
+
     if _BULK_HOOK is not None:
         out = _BULK_HOOK(op, params, arrays, device)
         if out is not NotImplemented:
             return out
-        if _PLACEHOLDER_CLS is not None:
-            # bulking declined the call; resolve any lazy inputs so the
-            # eager executable sees concrete buffers
-            ph = _PLACEHOLDER_CLS
-            if any(type(a) is ph for a in arrays):
-                arrays = tuple(
-                    a._mxtpu_force() if type(a) is ph else a for a in arrays)
+        # bulking declined the call; resolve any lazy inputs so the
+        # eager executable sees concrete buffers
+        arrays = _force_placeholders(arrays)
 
     # scalar hyperparams declared dynamic become runtime operands so their
     # per-step drift (scheduled lr, bias-corrected lr) can't churn the

@@ -81,7 +81,8 @@ def dispatch_ring():
 def set_config(**kwargs):
     """Configure the profiler (profiler.py:33). ``filename`` names the
     output; everything else toggles collection categories."""
-    _CONFIG.update(kwargs)
+    with _LOCK:  # dump()/set_state() read _CONFIG from other threads
+        _CONFIG.update(kwargs)
 
 
 profiler_set_config = set_config

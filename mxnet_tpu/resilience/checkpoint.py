@@ -99,10 +99,13 @@ _STATS = {"ckpt_saves": 0, "ckpt_save_failures": 0, "ckpt_restores": 0,
 # in-flight async save. Fork-mode children are separate processes and
 # finish on their own; the barrier just reaps + reports them.
 _LIVE_MANAGERS = None
+_TRACK_LOCK = threading.Lock()
 
 
 def _barrier_all_at_exit():
-    for mgr in list(_LIVE_MANAGERS or ()):
+    with _TRACK_LOCK:
+        live = list(_LIVE_MANAGERS or ())
+    for mgr in live:
         try:
             mgr.wait_for_async()
         except Exception:
@@ -110,14 +113,17 @@ def _barrier_all_at_exit():
 
 
 def _track_manager(mgr):
+    # managers can be constructed from worker threads (a per-replica
+    # serving setup): the lazy WeakSet init and the add must not race
     global _LIVE_MANAGERS
-    if _LIVE_MANAGERS is None:
-        import atexit
-        import weakref
+    with _TRACK_LOCK:
+        if _LIVE_MANAGERS is None:
+            import atexit
+            import weakref
 
-        _LIVE_MANAGERS = weakref.WeakSet()
-        atexit.register(_barrier_all_at_exit)
-    _LIVE_MANAGERS.add(mgr)
+            _LIVE_MANAGERS = weakref.WeakSet()
+            atexit.register(_barrier_all_at_exit)
+        _LIVE_MANAGERS.add(mgr)
 
 
 class CheckpointCorruptError(RuntimeError):

@@ -46,3 +46,8 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection chaos drill (tools/chaos_run.py); fast "
         "kinds run in tier-1, slow kinds carry the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "lint: graftlint static-analysis gate (tools/graftlint.py, "
+        "docs/static_analysis.md); runs in tier-1 so a new invariant "
+        "violation fails CI")

@@ -1,0 +1,36 @@
+"""CC001 fixture: module state in a threaded module, locked vs not."""
+import threading
+
+_LOCK = threading.Lock()
+_TABLE = {}
+_PENDING = []
+_STATS = {"hits": 0}
+
+
+def _worker():
+    return None
+
+
+def start():
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+
+
+def good(key, value):
+    with _LOCK:
+        _TABLE[key] = value  # clean: mutation under the declared lock
+
+
+def bad(value):
+    _PENDING.append(value)  # VIOLATION: unlocked mutation
+
+
+def counted():
+    _STATS["hits"] += 1  # clean: counter-dict exemption (see RD002)
+
+
+def waived(key):
+    _TABLE.pop(key, None)  # graftlint: disable=CC001 — single writer
+
+
+MODULE_INIT = _TABLE.setdefault("init", 0)  # clean: import-time is 1-threaded

@@ -1,0 +1,28 @@
+"""CC002 fixture: two locks taken in opposite orders on two paths —
+including one path where the second lock is taken inside a callee."""
+import threading
+
+_ALPHA = threading.Lock()
+_BETA = threading.Lock()
+
+
+def ab():
+    with _ALPHA:
+        with _BETA:
+            return 1
+
+
+def ba():
+    with _BETA:
+        with _ALPHA:  # VIOLATION: cycle with ab()
+            return 2
+
+
+def _locked_helper():
+    with _BETA:
+        return 3
+
+
+def via_call():
+    with _ALPHA:
+        return _locked_helper()  # same A->B edge, via the call graph

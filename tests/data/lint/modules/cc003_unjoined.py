@@ -1,0 +1,45 @@
+"""CC003 fixture: a non-daemon thread nobody joins."""
+import threading
+
+
+def spawn_bad():
+    t = threading.Thread(target=print)  # VIOLATION: never joined
+    t.start()
+    return t
+
+
+def spawn_daemon():
+    d = threading.Thread(target=print, daemon=True)
+    d.start()
+
+
+def spawn_joined():
+    w = threading.Thread(target=print)
+    w.start()
+    w.join()
+
+
+def spawn_attr_daemon():
+    a = threading.Thread(target=print)
+    a.daemon = True  # clean: daemonized after construction
+    a.start()
+
+
+def spawn_setdaemon():
+    s = threading.Thread(target=print)
+    s.setDaemon(True)  # clean: legacy daemonize API
+    s.start()
+
+
+class Pool:
+    def __init__(self):
+        self.workers = []
+
+    def spawn_into_list(self):
+        # clean: appended into a collection the drain loop joins
+        self.workers.append(threading.Thread(target=print))
+        self.workers[-1].start()
+
+    def drain(self):
+        for w in self.workers:
+            w.join()

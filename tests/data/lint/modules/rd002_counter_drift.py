@@ -1,0 +1,19 @@
+"""RD002 fixture: a counter mutated but not declared in _STATS."""
+_STATS = {"declared": 0}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0  # clean: reset loop uses a Name slice
+
+
+def hit():
+    _STATS["declared"] += 1  # clean
+
+
+def drift():
+    _STATS["undeclared"] += 1  # VIOLATION: not in the _STATS literal

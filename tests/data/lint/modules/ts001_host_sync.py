@@ -1,0 +1,102 @@
+# graftlint: role=ops
+"""TS001 fixture: one violation per host-sync form, plus clean kernels
+that must NOT fire (static attrs, identity tests, tracer guards, static
+shape helpers, static builtins, directly-called inner helpers)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FakeTracer:
+    pass
+
+
+def register(name, **kw):
+    def _reg(fn):
+        return fn
+    return _reg
+
+
+def _batched(x):
+    return x.ndim == 4
+
+
+@register("fx_float")
+def k_float(x, eps=1e-6):
+    return x * float(x)  # VIOLATION: float() on traced value
+
+
+@register("fx_item")
+def k_item(x):
+    return x.item()  # VIOLATION: .item() on traced value
+
+
+@register("fx_np")
+def k_np(x):
+    return jnp.asarray(np.asarray(x))  # VIOLATION: np.asarray on traced
+
+
+@register("fx_branch")
+def k_branch(x):
+    if x > 0:  # VIOLATION: Python control flow on traced value
+        return x
+    return -x
+
+
+@register("fx_inner")
+def k_inner(x, n=4):
+    def pad(v, k):
+        return v * int(k)  # clean: called directly with static k
+
+    def body(c, v):
+        return c + float(v), None  # VIOLATION: scan callback args traced
+
+    y, _ = jax.lax.scan(body, x, x)
+    return pad(x, n) + y
+
+
+@register("fx_clean")
+def k_clean(x, axis=0, size=None):
+    if size is None and _batched(x) and len(x.shape) > 2:
+        return jnp.asarray(x).sum(axis=axis)
+    return x * float(axis)
+
+
+@register("fx_guarded")
+def k_guarded(x):
+    if isinstance(x, FakeTracer):
+        raise NotImplementedError("host-only op")
+    return np.asarray(x)  # clean: tracer-guarded host fallback
+
+
+@register("fx_method")
+def k_method(x):
+    return float(x.sum())  # VIOLATION: a reduction result is still traced
+
+
+@register("fx_dict")
+def k_dict(x):
+    d = {"v": x}
+    return float(d["v"])  # VIOLATION: taint flows through dict literals
+
+
+@register("fx_clean_static_attr_call")
+def k_clean_aval(x):
+    s = x.aval.str_short()  # clean: .aval is static under trace
+    return x * len(s)
+
+
+@register("fx_aug")
+def k_aug(x):
+    s = x
+    s += 1
+    return float(s)  # VIOLATION: taint survives augmented assignment
+
+
+def _hostify(v):
+    return float(v)  # VIOLATION when reached with traced args
+
+
+@register("fx_helper")
+def k_helper(x):
+    return x * _hostify(x)

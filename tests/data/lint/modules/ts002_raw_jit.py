@@ -1,0 +1,31 @@
+# graftlint: role=ops
+"""TS002 fixture: a raw jax.jit in an ops module bypasses the interned
+executable cache — under its canonical name or any import alias."""
+import jax
+import jax as _j
+from jax import jit as _aliased_jit
+
+
+def build(fn):
+    return jax.jit(fn)  # VIOLATION: raw jit outside the sanctioned cache
+
+
+def build_from_alias(fn):
+    return _aliased_jit(fn)  # VIOLATION: `from jax import jit as _x`
+
+
+def build_module_alias(fn):
+    return _j.jit(fn)  # VIOLATION: `import jax as _j; _j.jit`
+
+
+def describe(fn):
+    return fn.__name__  # clean
+
+
+def jit(fn):
+    """Clean near-miss: a local helper merely NAMED jit."""
+    return fn
+
+
+def wrap(fn):
+    return jit(fn)  # clean: calls the local helper, not jax.jit

@@ -1,0 +1,14 @@
+# graftlint: role=registry
+"""TS003 fixture: reading a donated input buffer after dispatch."""
+
+
+def dispatch_donated(fn, arrays, donate_slots):
+    out = fn(*arrays)
+    arrays[0].shape  # VIOLATION: donated buffer read after dispatch
+    return out
+
+
+def dispatch_clean(fn, arrays, donate_slots):
+    before = arrays[0].shape  # clean: read happens before dispatch
+    del before
+    return fn(*arrays)

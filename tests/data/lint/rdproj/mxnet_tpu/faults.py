@@ -1,0 +1,20 @@
+"""RD003 fixture: one chaos-drilled fault kind, one never drilled."""
+_ACTIVE = {}
+
+
+def hook_covered():
+    return _ACTIVE.get("fix_covered")
+
+
+def hook_injected():
+    return _ACTIVE.get("fix_injected")  # clean: drilled via inject("...")
+
+
+def hook_uncovered():
+    return _ACTIVE.get("fix_uncovered")  # VIOLATION RD003
+
+
+def hook_docstring_only():
+    # VIOLATION RD003: named in the chaos harness docstring but never
+    # actually injected/dispatched there — a mention is not a drill
+    return _ACTIVE.get("fix_docstring_only")

@@ -1,0 +1,11 @@
+"""RD001 fixture: one documented knob, one undocumented."""
+import os
+
+DOCUMENTED = os.environ.get("MXNET_TPU_FIX_DOCUMENTED", "1")
+MISSING = os.environ.get("MXNET_TPU_FIX_MISSING", "")  # VIOLATION RD001
+
+
+def drill_new_point():
+    from . import faults
+    # clean: waiver sits at the real call site (RD003 anchors here)
+    faults.maybe_crash("fix_waived_point")  # graftlint: disable=RD003

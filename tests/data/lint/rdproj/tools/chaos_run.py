@@ -1,0 +1,16 @@
+"""Fixture chaos harness: drills only fix_covered and fix_injected.
+
+fix_docstring_only is named right here in the docstring yet must still
+count as UNDRILLED — prose is not coverage.
+"""
+KINDS = ("fix_covered",)
+
+
+def run_kind(kind):
+    if kind == "fix_injected":
+        return inject("fix_injected")
+    return None
+
+
+def inject(kind):
+    return kind

@@ -126,6 +126,28 @@ def test_boolean_mask_read():
     np.testing.assert_allclose(a[m].asnumpy(), a_np[a_np > 5])
 
 
+def test_boolean_mask_op_host_dispatch():
+    # the registered op is host=True: eager ND dispatch (device set) runs
+    # it outside the jit cache and reads the mask on the host; under an
+    # enclosing jit it raises a clear error instead of silently syncing
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+
+    from mxnet_tpu import profiler
+
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    mask = mx.nd.array(np.array([1, 0, 1, 0], dtype=np.float32))
+    out = mx.nd.contrib.boolean_mask(data, mask)
+    np.testing.assert_allclose(
+        out.asnumpy(), np.arange(12, dtype=np.float32).reshape(4, 3)[::2])
+    # host dispatch still leaves a forensic trail for crash reports
+    assert any(e["op"] == "boolean_mask" for e in profiler.dispatch_ring())
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        jax.jit(lambda d, m: mx.npx.boolean_mask(d, m))(
+            jnp.ones((3, 2)), jnp.array([1, 0, 1]))
+
+
 @pytest.mark.parametrize("case", ["scalar", "matching_tensor", "single"])
 def test_boolean_mask_assign(case):
     a_np = np.arange(8, dtype=np.float32)

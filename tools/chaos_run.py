@@ -50,11 +50,11 @@ _ENV = {
     "MXNET_TPU_FAULT_HANG_CAP": "10",
 }
 
-FAST_KINDS = ("nan_grad", "ckpt_enospc", "ckpt_partial_write",
-              "ckpt_shard_corrupt", "ckpt_crash_before_manifest",
-              "ckpt_async_crash", "hang_step", "hang_collective",
-              "hang_batch", "peer_death", "peer_death_recover", "oom_step",
-              "dist_connect_timeout")
+FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
+              "ckpt_partial_write", "ckpt_shard_corrupt",
+              "ckpt_crash_before_manifest", "ckpt_async_crash",
+              "hang_step", "hang_collective", "hang_batch", "peer_death",
+              "peer_death_recover", "oom_step", "dist_connect_timeout")
 
 
 def _mx():
@@ -299,6 +299,37 @@ def _drill_hang_batch(mx, workdir):
     return len(ok_after) > 0, "queue survived the stalled batch"
 
 
+def _drill_nan_serving(mx, workdir):
+    """A poisoned inference batch (kind ``nan_serving``) flows through
+    the real compiled executable; the BatchServer's output health check
+    fails ONLY that batch's futures and the queue keeps serving."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.resilience.sentinel import NumericHealthError
+
+    mx.random.seed(5)
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    pred = serving.Predictor.from_block(net, input_shapes={"data": (3,)},
+                                        batch_sizes=(4,))
+    x = np.ones((1, 3), np.float32)
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=1.0) as srv:
+        with faults.inject("nan_serving") as f:
+            fut = srv.submit(x)
+            try:
+                fut.result(timeout=10)
+                return False, "poisoned batch resolved as healthy"
+            except NumericHealthError:
+                pass
+        ok_after = srv.submit(x).result(timeout=10)  # queue not wedged
+    ok = (f.fired == 1 and len(ok_after) > 0
+          and np.isfinite(ok_after[0]).all())
+    return ok, "poisoned batch isolated; queue kept serving"
+
+
 def _drill_oom_step(mx, workdir):
     import numpy as np
 
@@ -370,6 +401,8 @@ def run_kind(kind, workdir=None):
             return _drill_hang_collective(mx, tmp)
         if kind == "hang_batch":
             return _drill_hang_batch(mx, tmp)
+        if kind == "nan_serving":
+            return _drill_nan_serving(mx, tmp)
         if kind == "peer_death":
             return _drill_peer_death(mx, tmp)
         if kind == "oom_step":

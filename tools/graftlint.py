@@ -1,0 +1,114 @@
+"""graftlint CLI — run the framework-invariant static analysis suite.
+
+Runs the three pass families (trace-safety, concurrency discipline,
+registry drift — docs/static_analysis.md) over the repository, subtracts
+the checked-in baseline (tools/graftlint_baseline.json), and prints ONE
+JSON line (same convention as tools/dispatch_bench.py / chaos_run.py):
+
+    {"metric": "graftlint_new_findings", "value": <n>, "unit": "findings",
+     "extra": {"total": ..., "suppressed": ..., "stale_suppressions": ...,
+               "per_rule": {...}, "rules": {...}}}
+
+Exit code is non-zero when any NEW finding (not in the baseline) exists.
+Stdlib-only: never imports mxnet_tpu runtime code, so it runs in any CI
+image with no jax.
+
+Run:   python tools/graftlint.py [--json] [--rules TS001,CC002]
+       python tools/graftlint.py --update-baseline   # refresh accepted debt
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the lint package is import-safe without jax; load it straight from its
+# directory so mxnet_tpu/__init__.py (which needs jax) never runs — and
+# without putting mxnet_tpu/ itself on sys.path, where its random.py /
+# io/ / profiler.py would shadow the stdlib for any later import. The
+# top-level alias name keeps in-package relative imports working without
+# an importable `mxnet_tpu` ancestor.
+_LINT_DIR = os.path.join(_ROOT, "mxnet_tpu", "lint")
+_spec = importlib.util.spec_from_file_location(
+    "graftlint", os.path.join(_LINT_DIR, "__init__.py"),
+    submodule_search_locations=[_LINT_DIR])
+_pkg = importlib.util.module_from_spec(_spec)
+sys.modules[_spec.name] = _pkg
+_spec.loader.exec_module(_pkg)
+_core = sys.modules["graftlint.core"]
+
+DEFAULT_BASELINE = os.path.join(_ROOT, "tools", "graftlint_baseline.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--rules", default="",
+                    help="comma list of rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the one-line JSON summary")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write every current finding into the baseline "
+                         "(existing reasons are preserved; new entries "
+                         "get a TODO reason a reviewer must replace)")
+    args = ap.parse_args(argv)
+
+    project = _core.Project(args.root)
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    findings = _core.run_all(project, rules=rules)
+    baseline = _core.load_baseline(args.baseline)
+
+    if args.update_baseline:
+        # a --rules-filtered run only saw a subset of findings; carry the
+        # unselected rules' suppressions over untouched
+        retain = {fp: e for fp, e in baseline.items()
+                  if rules and e.get("rule") not in set(rules)}
+        entries = _core.save_baseline(args.baseline, findings,
+                                      keep=baseline, retain=retain)
+        print(f"graftlint: wrote {len(entries)} suppression(s) to "
+              f"{os.path.relpath(args.baseline, args.root)}",
+              file=sys.stderr)
+        return 0
+
+    # a --rules-filtered run can only see the selected rules' findings, so
+    # only their baseline entries are judged live/stale — anything else
+    # would misreport every unselected suppression as stale
+    visible = baseline if not rules else \
+        {fp: e for fp, e in baseline.items() if e.get("rule") in set(rules)}
+    new, suppressed, stale = _core.split_by_baseline(findings, visible)
+    if not args.json:
+        for f in new:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}  "
+                  f"[{f.fingerprint}]", file=sys.stderr)
+        for fp in stale:
+            print(f"stale baseline entry (fix landed — remove it): {fp}",
+                  file=sys.stderr)
+        print(f"graftlint: {len(new)} new, {len(suppressed)} baselined, "
+              f"{len(stale)} stale over {len(project.modules())} modules",
+              file=sys.stderr)
+
+    per_rule = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    print(json.dumps({
+        "metric": "graftlint_new_findings",
+        "value": len(new),
+        "unit": "findings",
+        "extra": {
+            "total": len(findings),
+            "suppressed": len(suppressed),
+            "stale_suppressions": len(stale),
+            "per_rule": per_rule,
+            "new": [f.as_dict() for f in new[:50]],
+        },
+    }))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
