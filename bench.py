@@ -19,27 +19,29 @@ V5E_BF16_PEAK = 197e12
 BASELINE_IMG_S = 109.0  # reference K80 img/s, bs=32
 
 
-def _throughput(trainer, x, y, iters, warmup=2):
+def _throughput(trainer, x, y, iters, warmup=2, step=None):
     """Training-step throughput on a device-resident synthetic batch — the
     same methodology as the reference's own benchmark harnesses
     (example/image-classification/benchmark_score.py feeds synthetic data
     from the device). Input-pipeline throughput is benchmarked separately
-    (io/record_pipeline)."""
+    (io/record_pipeline). ``step`` overrides the step callable (the
+    ``--capture`` mode passes the capture()-wrapped step)."""
     import jax
 
+    step = step or trainer.step
     xd = jax.device_put(x, trainer._batch_sharding)
     yd = jax.device_put(y, trainer._batch_sharding)
     for _ in range(warmup):
-        trainer.step(xd, yd).block_until_ready()
+        step(xd, yd).block_until_ready()
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = trainer.step(xd, yd)
+        loss = step(xd, yd)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
     return x.shape[0] * iters / dt
 
 
-def main():
+def main(capture_mode=False):
     import numpy as np
     import jax
 
@@ -84,8 +86,15 @@ def main():
                 net, gluon.loss.SoftmaxCrossEntropyLoss(),
                 "sgd", {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
                 dtype=dtype)
+            step = None
+            if capture_mode:
+                # whole-program capture: step programs compile through
+                # the capture/AOT path (BENCH_r06 records this number)
+                from mxnet_tpu import capture as _capture
+
+                step = _capture.capture(trainer)
             try:
-                img_s = _throughput(trainer, x, y, iters)
+                img_s = _throughput(trainer, x, y, iters, step=step)
                 break
             except Exception as e:
                 print(f"# bs={batch} dtype={dtype} attempt {attempt}: "
@@ -106,13 +115,20 @@ def main():
             "unit": "img/s/chip", "vs_baseline": 0.0, "error": "all configs failed"}))
         return
     img_s = best[0]
-    print(json.dumps({
+    out = {
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+    }
+    if capture_mode:
+        from mxnet_tpu import capture as _capture
+
+        out["mode"] = "captured"
+        out["capture_stats"] = {k: v for k, v in _capture.stats().items()
+                                if v}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    main(capture_mode="--capture" in sys.argv[1:])

@@ -1,0 +1,1344 @@
+"""Whole-program step capture + persistent AOT compile cache.
+
+ROADMAP item 3 (the Julia-to-TPU full-compilation argument, PAPERS.md
+[1810.09868], and TensorFlow's whole-graph compilation [1605.08695]):
+instead of eager dispatch with bulked segments, compile the *entire*
+training step — forward, backward, optimizer update sweep, and the
+HealthSentinel/loss-scaler finite check — into ONE donated XLA
+executable, and serialize compiled programs to disk so a new process
+(serving cold-start, multi-host restart) skips XLA compilation.
+
+Three layers, all routed through the single sanctioned compile site
+``_compile_jit`` (graftlint TS002):
+
+1. **Capture** — :func:`capture` turns a gluon ``Trainer`` step (the
+   eager fwd/bwd + bulked-update hot loop) or a parallel
+   ``ShardedTrainer`` into a captured step object. The gluon capture
+   re-runs the user's imperative step under trace via the
+   mutation->functional bridge (``jit.TraceSession``), with three
+   properties the plain ``mx.jit.trace`` path lacks:
+
+   - **dynamic scalar operands**: every hyperparameter an optimizer op
+     declares ``dynamic_params`` for (lr, wd, rescale_grad — including
+     schedule- and bias-correction-drifted values) is a runtime operand,
+     refreshed each step by a *scalar replay* of the update sweep's
+     Python (array math skipped), so an Adam bias correction or lr
+     schedule neither retraces nor goes stale;
+   - **fused sentinel check**: with a HealthSentinel attached, one
+     ``multi_all_finite`` reduction over the gradients runs *inside*
+     the program and gates every weight/state write with a select, so
+     an unhealthy batch never touches the weights — policies
+     (raise/skip_batch/rollback) apply on the host from the returned
+     flag exactly as on the eager path;
+   - **retrace forensics**: a signature change (shape, dtype, scalar
+     slots, rebound trainer state) bumps ``capture_retraces``, records
+     a structured reason in the dispatch ring (crash reports embed it)
+     and in :func:`retrace_log` — never a silent recompile.
+
+2. **CapturedExec** — the keyed executable wrapper the
+   ``ShardedTrainer`` fused/elastic steps and the serving ``Predictor``
+   bucket executables compile through: per-signature executable cache,
+   the same forensics, and the AOT layer below.
+
+3. **AOT compile cache** — with ``MXNET_TPU_COMPILE_CACHE=<dir>``,
+   compiled programs are persisted as ``jax.export`` artifacts keyed by
+   (program fingerprint, avals/sharding/donation signature, backend
+   topology) with the jax/jaxlib versions in the header, next to jax's
+   persistent XLA executable cache (``<dir>/xla``). A warm process
+   deserializes the traced program (skipping Python tracing + lowering)
+   and re-links the XLA executable from the persistent cache (skipping
+   XLA compilation). Stale (version-mismatched) and corrupt artifacts
+   fall back to a fresh compile — never a crash.
+
+Env knobs (docs/env_vars.md): ``MXNET_TPU_CAPTURE``,
+``MXNET_TPU_COMPILE_CACHE``, ``MXNET_TPU_COMPILE_CACHE_MAX_MB``,
+``MXNET_TPU_COMPILE_CACHE_SALT``. Counters surface in
+``profiler.dispatch_stats()``. See docs/capture.md.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from . import profiler as _profiler
+
+__all__ = ["capture", "CapturedTrainerStep", "CapturedShardedStep",
+           "CapturedExec", "CaptureError", "enabled", "aot_enabled",
+           "cache_dir", "compile_cache", "aot_compile", "note_recapture",
+           "retrace_log", "clear_retrace_log", "stats", "reset_stats",
+           "fingerprint", "code_sig", "net_sig"]
+
+_LOCK = threading.Lock()
+
+# Flat counters, merged into profiler.dispatch_stats() (docs/capture.md).
+_STATS = {
+    "capture_steps": 0,           # captured trainer-step invocations
+    "capture_hits": 0,            # signature-cache hits on captured execs
+    "capture_misses": 0,          # first compile per signature
+    "capture_retraces": 0,        # signature changes after first compile
+    "capture_fallback_eager": 0,  # kill-switch / capture-failure eager runs
+    "aot_cache_hits": 0,          # artifacts loaded from disk
+    "aot_cache_misses": 0,        # artifacts absent: fresh trace + store
+    "aot_cache_stale": 0,         # version/platform mismatch: recompiled
+    "aot_cache_corrupt": 0,       # unreadable artifact: recompiled
+    "aot_cache_writes": 0,        # artifacts written
+    "aot_cache_evictions": 0,     # files removed by the size-cap GC
+}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+class CaptureError(RuntimeError):
+    """Capture could not (re)build a step program (scalar-slot drift,
+    unsupported trainer config). The caller falls back to eager."""
+
+
+# ------------------------------------------------------------------ env knobs
+
+def enabled():
+    """Master kill switch: ``MXNET_TPU_CAPTURE=0`` makes :func:`capture`
+    return an eager-fallback step (identical semantics, no compile)."""
+    return os.environ.get("MXNET_TPU_CAPTURE", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def cache_dir():
+    """AOT artifact directory (``MXNET_TPU_COMPILE_CACHE``), or None when
+    persistence is disabled."""
+    d = os.environ.get("MXNET_TPU_COMPILE_CACHE", "").strip()
+    return d or None
+
+
+def aot_enabled():
+    return enabled() and cache_dir() is not None
+
+
+def _cache_limit_bytes():
+    try:
+        mb = float(os.environ.get("MXNET_TPU_COMPILE_CACHE_MAX_MB", "2048"))
+    except ValueError:
+        mb = 2048.0
+    return int(mb * 1e6)
+
+
+def _cache_salt():
+    return os.environ.get("MXNET_TPU_COMPILE_CACHE_SALT", "")
+
+
+# -------------------------------------------------------- retrace forensics
+
+# Structured reasons for every captured-program recompile, newest last.
+# Bounded; guarded by _LOCK (read by tests and crash-report consumers).
+_RETRACE_LOG: list = []
+_RETRACE_LOG_CAP = 64
+
+
+def retrace_log():
+    """Structured reasons for every captured-step recompile after its
+    first build: ``{"label", "reason", "prev", "new", "t"}`` dicts,
+    oldest first. The same reasons land in the dispatch ring (and so in
+    watchdog crash reports) as ``capture_retrace:<label>:<reason>``."""
+    with _LOCK:
+        return [dict(e) for e in _RETRACE_LOG]
+
+
+def clear_retrace_log():
+    with _LOCK:
+        del _RETRACE_LOG[:]
+
+
+def _sig_reason(prev, new):
+    """Human-readable diff of two capture signatures."""
+    if prev is None:
+        return "first capture"
+    try:
+        if len(prev) != len(new):
+            return f"operand count changed {len(prev)} -> {len(new)}"
+        for i, (p, n) in enumerate(zip(prev, new)):
+            if p != n:
+                return f"operand {i} changed {p} -> {n}"
+    except TypeError:
+        pass
+    return f"signature changed {prev!r} -> {new!r}"
+
+
+def _note_retrace(label, prev_sig, new_sig, reason=None):
+    """Record one captured-program recompile: counter + structured log +
+    dispatch-ring entry, so a watchdog crash report written later names
+    the retrace cause instead of showing a silent compile stall."""
+    reason = reason or _sig_reason(prev_sig, new_sig)
+    _STATS["capture_retraces"] += 1
+    entry = {"label": label, "reason": reason, "prev": repr(prev_sig),
+             "new": repr(new_sig), "t": time.time()}
+    with _LOCK:
+        _RETRACE_LOG.append(entry)
+        if len(_RETRACE_LOG) > _RETRACE_LOG_CAP:
+            del _RETRACE_LOG[:-_RETRACE_LOG_CAP]
+    _profiler.record_dispatch(f"capture_retrace:{label}:{reason}")
+    return entry
+
+
+def note_recapture(label, prev, new, reason=None):
+    """Public forensics entry for compile-site owners (the parallel
+    ``ShardedTrainer``, serving): a program that must be REBUILT — mesh
+    shrink, ``set_learning_rate``, elastic re-capture — records why,
+    exactly like an in-place signature retrace."""
+    return _note_retrace(label, prev, new, reason=reason)
+
+
+# -------------------------------------------------------- fingerprinting
+
+def fingerprint(parts):
+    """THE shared key-schema digest for every capture/AOT compile site
+    (gluon trainer steps, sharded step programs, serving buckets): a
+    stable 32-hex hash of a structural-identity dict. One helper so a
+    schema change (new field, version bump) cannot fork the cache-key
+    format across sites."""
+    return hashlib.sha256(json.dumps(
+        parts, sort_keys=True, default=repr).encode()).hexdigest()[:32]
+
+
+def code_sig(fn):
+    """Structural signature of a callable's *computation*: its bytecode
+    + consts, recursing into nested code objects (comprehensions, inner
+    defs). Param shapes alone cannot distinguish ``relu`` from ``tanh``
+    or one lambda loss body from another — without this in the program
+    fingerprint a warm AOT cache would silently serve the wrong compiled
+    program."""
+    import types
+
+    code = getattr(fn, "__code__", None)
+    if code is None:  # callable object: sign its class's call path
+        for name in ("hybrid_forward", "forward", "__call__"):
+            meth = getattr(type(fn), name, None)
+            code = getattr(meth, "__code__", None)
+            if code is not None:
+                break
+    if code is None:
+        return repr(fn)
+    out = []
+    stack = [code]
+    while stack:
+        c = stack.pop()
+        out.append(c.co_code.hex())
+        for const in c.co_consts:
+            if isinstance(const, types.CodeType):
+                stack.append(const)
+            else:
+                out.append(repr(const))
+    return hashlib.sha256("|".join(out).encode()).hexdigest()[:16]
+
+
+def net_sig(net):
+    """Structural signature of a gluon block tree: the repr (layer
+    types, activations, unit counts) + every distinct block class's
+    forward bytecode, so architecture changes that keep the param
+    shapes identical still change the program fingerprint."""
+    parts = [repr(net)]
+    seen = set()
+    stack = [net]
+    while stack:
+        b = stack.pop()
+        cls = type(b)
+        key = f"{cls.__module__}.{cls.__qualname__}"
+        if key not in seen:
+            seen.add(key)
+            fwd = getattr(b, "hybrid_forward", None) \
+                or getattr(b, "forward", None)
+            parts.append(f"{key}:{code_sig(fwd) if fwd else ''}")
+        stack.extend(getattr(b, "_children", {}).values())
+    return hashlib.sha256("|".join(sorted(parts)).encode()).hexdigest()[:16]
+
+
+# ------------------------------------------------------- sanctioned compile
+
+def _compile_jit(fn, jit_kwargs):
+    """THE sanctioned ``jax.jit`` site for captured programs (graftlint
+    TS002): every capture/AOT executable — trainer steps, elastic
+    grad/apply programs, serving bucket forwards, deserialized AOT
+    artifacts — compiles here, so donation/sharding conventions and the
+    capture counters cannot be bypassed by a stray raw jit."""
+    import jax
+
+    return jax.jit(fn, **{k: v for k, v in jit_kwargs.items()
+                          if v is not None})
+
+
+# ----------------------------------------------------------- scalar sessions
+
+_TLS = threading.local()
+
+
+def _session():
+    return getattr(_TLS, "session", None)
+
+
+class _ScalarSession:
+    """Dispatch-hook session threading dynamic scalar params through a
+    captured program. Modes:
+
+    - ``discover``: eager discovery pass — ops run normally; every
+      dispatch of an op with declared ``dynamic_params`` records an
+      operand slot (op name + keys + current values), fixing the slot
+      order the compiled program consumes operands in.
+    - ``record``: the jit trace — the same dispatches consume operand
+      *tracers* (the program's trailing inputs) instead of baking the
+      Python float of the moment into the executable.
+    - ``replay``: per-step refresh — the update sweep's *Python* re-runs
+      (schedules, bias corrections, ``num_update`` bookkeeping advance
+      exactly as eagerly) while ops with ``mutate`` slots are skipped
+      via identity outputs, collecting fresh operand values with no
+      device work.
+    """
+
+    __slots__ = ("mode", "slots", "values", "operands", "pos", "off")
+
+    def __init__(self, mode, slots=None, operands=None):
+        self.mode = mode
+        self.slots = slots if slots is not None else []
+        self.values = []
+        self.operands = operands
+        self.pos = 0
+        self.off = 0
+
+    def __enter__(self):
+        if getattr(_TLS, "session", None) is not None:
+            raise CaptureError("nested capture sessions are not supported")
+        _TLS.session = self
+        _install_hook()
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.session = None
+        return False
+
+    # ---- dispatch hook body (see registry._CAPTURE_HOOK)
+    def on_dispatch(self, op, params, arrays, is_traced):
+        mode = self.mode
+        dyn_keys, dyn_vals, static = op.split_dynamic(params)
+        if mode == "record":
+            if not dyn_keys or not is_traced:
+                return NotImplemented
+            if self.pos >= len(self.slots) or \
+                    self.slots[self.pos] != (op.name, dyn_keys):
+                raise CaptureError(
+                    f"scalar slot drift at #{self.pos}: traced "
+                    f"{(op.name, dyn_keys)}, discovered "
+                    f"{self.slots[self.pos] if self.pos < len(self.slots) else None}")
+            ops_in = self.operands[self.off:self.off + len(dyn_keys)]
+            self.pos += 1
+            self.off += len(dyn_keys)
+            return op.closed(static)(*arrays, **dict(zip(dyn_keys, ops_in)))
+        if dyn_keys:
+            self.slots.append((op.name, dyn_keys))
+            self.values.extend(dyn_vals)
+        if mode == "discover":
+            return NotImplemented  # run normally; slots now known
+        # replay: skip the array math of mutating update ops — their
+        # results are discarded; only the scalar Python above matters
+        slots_m = op.mutate_slots(params)
+        if not slots_m:
+            return NotImplemented
+        n_primary = op.n_out(params)
+        prim = arrays[slots_m[0]]
+        outs = tuple([prim] * n_primary) + tuple(arrays[s] for s in slots_m)
+        return outs if len(outs) > 1 else outs[0]
+
+
+def _capture_dispatch_hook(op, params, arrays, device, is_traced):
+    sess = getattr(_TLS, "session", None)
+    if sess is None:
+        return NotImplemented
+    return sess.on_dispatch(op, params, arrays, is_traced)
+
+
+_HOOK_INSTALLED = False
+
+
+def _install_hook():
+    global _HOOK_INSTALLED
+    with _LOCK:
+        if _HOOK_INSTALLED:
+            return
+        from .ops import registry
+
+        registry._set_capture_hook(_capture_dispatch_hook)
+        _HOOK_INSTALLED = True
+
+
+# ------------------------------------------------------------ AOT artifacts
+
+_MAGIC = b"MXTPUAOT1\n"
+
+
+def _backend_sig():
+    import jax
+
+    devs = jax.devices()
+    return f"{devs[0].platform}:{len(devs)}"
+
+
+def _versions():
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+
+
+class CompileCache:
+    """On-disk store of compiled-program artifacts.
+
+    Layout under the root: ``programs/<key>.aotx`` — a header (schema,
+    jax/jaxlib versions, backend, payload SHA-256) followed by the
+    ``jax.export`` serialization of the traced program — and ``xla/``,
+    jax's persistent compilation cache of XLA *executables*, enabled for
+    the process when this cache is. A warm load therefore skips both
+    Python tracing/lowering (our artifact) and XLA compilation (jax's).
+
+    Invalidation (docs/capture.md): the key hashes the caller's
+    structural fingerprint + avals/sharding/donation signature + backend
+    topology + ``MXNET_TPU_COMPILE_CACHE_SALT``; the header carries the
+    jax/jaxlib versions, so a version bump is detected as *stale* and
+    recompiled in place. Corrupt artifacts (bad magic, truncated, hash
+    mismatch, undeserializable) are treated identically — fresh compile,
+    never a crash.
+    """
+
+    def __init__(self, root):
+        self.root = root
+        self.programs = os.path.join(root, "programs")
+        self.xla = os.path.join(root, "xla")
+        os.makedirs(self.programs, exist_ok=True)
+        os.makedirs(self.xla, exist_ok=True)
+
+    def xla_subcache(self):
+        """Context manager pointing jax's persistent compilation cache at
+        ``<root>/xla`` for the duration of one capture/AOT compile, so
+        the XLA-executable layer persists too — WITHOUT leaving a
+        zero-threshold global cache armed for every unrelated jit in the
+        process. An operator-configured cache dir is left alone. The
+        sticky "cache checked" latch is reset on both transitions so the
+        scoped enable works mid-process."""
+        import contextlib
+
+        import jax
+
+        @contextlib.contextmanager
+        def scoped():
+            try:
+                # everything fallible (private-API import included) is
+                # probed BEFORE the first config.update, so an
+                # unsupported jax can never strand a partially-applied
+                # zero-threshold cache config on the whole process
+                prior_dir = jax.config.jax_compilation_cache_dir
+                if prior_dir:
+                    yield  # operator-configured: leave it alone
+                    return
+                prior = {
+                    "jax_compilation_cache_dir": prior_dir,
+                    "jax_persistent_cache_min_compile_time_secs":
+                        jax.config.jax_persistent_cache_min_compile_time_secs,
+                    "jax_persistent_cache_min_entry_size_bytes":
+                        jax.config.jax_persistent_cache_min_entry_size_bytes,
+                }
+                from jax._src import compilation_cache as _cc
+            except Exception:  # XLA layer unsupported: program layer only
+                yield
+                return
+            try:
+                jax.config.update("jax_compilation_cache_dir", self.xla)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", 0)
+                _cc.reset_cache()
+            except Exception:
+                for k, v in prior.items():  # roll back a partial apply
+                    try:
+                        jax.config.update(k, v)
+                    except Exception:
+                        pass
+                yield
+                return
+            try:
+                yield
+            finally:
+                try:
+                    for k, v in prior.items():
+                        jax.config.update(k, v)
+                    _cc.reset_cache()
+                except Exception:
+                    pass
+
+        return scoped()
+
+    # ------------------------------------------------------------------ keys
+    def key(self, label, fingerprint, sig):
+        blob = json.dumps({
+            "label": label, "fingerprint": fingerprint, "sig": repr(sig),
+            "backend": _backend_sig(), "salt": _cache_salt(),
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:40]
+
+    def _path(self, key):
+        return os.path.join(self.programs, f"{key}.aotx")
+
+    # ------------------------------------------------------------------- load
+    def load(self, key):
+        """Deserialize the artifact under ``key``; None on miss/stale/
+        corrupt (counting each), never an exception."""
+        path = self._path(key)
+        if not os.path.isfile(path):
+            _STATS["aot_cache_misses"] += 1  # absent: fresh trace+store
+            return None
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            if not blob.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            off = len(_MAGIC)
+            hlen = int.from_bytes(blob[off:off + 4], "big")
+            header = json.loads(blob[off + 4:off + 4 + hlen])
+            payload = blob[off + 4 + hlen:]
+        except Exception:
+            _STATS["aot_cache_corrupt"] += 1
+            return None
+        vers = _versions()
+        if header.get("jax") != vers["jax"] \
+                or header.get("jaxlib") != vers["jaxlib"] \
+                or header.get("backend") != _backend_sig():
+            _STATS["aot_cache_stale"] += 1
+            try:  # never serveable again under this key: free it now
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+            _STATS["aot_cache_corrupt"] += 1
+            return None
+        try:
+            from jax import export as _export
+
+            exported = _export.deserialize(bytearray(payload))
+        except Exception:
+            _STATS["aot_cache_corrupt"] += 1
+            return None
+        try:  # freshen mtime so the size-cap GC evicts cold artifacts,
+            os.utime(path)  # not the most-reloaded ones
+        except OSError:
+            pass
+        return exported
+
+    # ------------------------------------------------------------------ store
+    def store(self, key, exported, label=""):
+        """Atomically persist one exported program; best-effort (a full
+        disk must never fail the compile that produced the program)."""
+        try:
+            payload = bytes(exported.serialize())
+            header = dict(_versions())
+            header.update({
+                "schema": 1, "backend": _backend_sig(), "label": label,
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "created": time.time(),
+            })
+            hbytes = json.dumps(header, sort_keys=True).encode()
+            path = self._path(key)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(_MAGIC)
+                f.write(len(hbytes).to_bytes(4, "big"))
+                f.write(hbytes)
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            _STATS["aot_cache_writes"] += 1
+            self.gc()
+            return path
+        except Exception:
+            return None
+
+    # --------------------------------------------------------------------- gc
+    def gc(self, limit_bytes=None):
+        """Size-cap eviction: while the cache exceeds
+        ``MXNET_TPU_COMPILE_CACHE_MAX_MB``, delete the oldest-mtime
+        files (program artifacts and XLA-cache entries alike)."""
+        limit = _cache_limit_bytes() if limit_bytes is None else limit_bytes
+        entries = []
+        total = 0
+        for d in (self.programs, self.xla):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                p = os.path.join(d, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+        if total <= limit:
+            return 0
+        evicted = 0
+        for _, size, p in sorted(entries):
+            if total <= limit:
+                break
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            _STATS["aot_cache_evictions"] += 1
+        return evicted
+
+
+_CACHES: dict = {}
+
+
+def compile_cache():
+    """The process CompileCache for ``MXNET_TPU_COMPILE_CACHE``, or None
+    when persistence is off (read per call: tests flip the env var)."""
+    d = cache_dir()
+    if d is None:
+        return None
+    with _LOCK:
+        cache = _CACHES.get(d)
+        if cache is None:
+            try:
+                cache = CompileCache(d)
+            except OSError:
+                return None
+            _CACHES[d] = cache
+    return cache
+
+
+def _precompile(jitted, example_args):
+    """Force trace + XLA compile now (build time), so first-step latency
+    never lands inside an armed watchdog guard. Falls back to the lazy
+    jitted callable for programs AOT lowering can't specialize."""
+    try:
+        return jitted.lower(*example_args).compile()
+    except Exception:
+        return jitted
+
+
+def aot_compile(fn, *, label, fingerprint, example_args, sig=None,
+                in_shardings=None, out_shardings=None, donate_argnums=()):
+    """Compile ``fn`` through the sanctioned site, persisting/loading the
+    traced program via the AOT cache when enabled.
+
+    Warm path: deserialize the artifact (skips Python tracing and
+    lowering) and compile its ``call`` — which the persistent XLA
+    subcache resolves to a stored executable (skips XLA compilation).
+    Cold path: jit ``fn``, export with ``example_args``, store. Both
+    paths execute the exported program form when a cache is configured,
+    so cold and warm runs are bitwise-identical by construction.
+    """
+    jit_kwargs = {"in_shardings": in_shardings,
+                  "out_shardings": out_shardings,
+                  "donate_argnums": donate_argnums or None}
+    cache = compile_cache()
+    if cache is None or not enabled():
+        return _precompile(_compile_jit(fn, jit_kwargs), example_args)
+    key = cache.key(label, fingerprint, sig if sig is not None
+                    else _avals_sig(example_args))
+    # load() counts the outcome: absent -> misses, version/backend
+    # mismatch -> stale, unreadable -> corrupt (each a distinct series,
+    # so cold-cache misses never masquerade as invalidation churn)
+    exported = cache.load(key)
+    if exported is None:
+        jitted = _compile_jit(fn, jit_kwargs)
+        try:
+            from jax import export as _export
+
+            exported = _export.export(jitted)(*example_args)
+            cache.store(key, exported, label=label)
+        except Exception:
+            # program not exportable (callbacks, unsupported primitive):
+            # serve the plain executable; persistence is best-effort
+            with cache.xla_subcache():
+                return _precompile(jitted, example_args)
+    else:
+        _STATS["aot_cache_hits"] += 1
+    wrapped = _compile_jit(exported.call,
+                           {"donate_argnums": donate_argnums or None})
+    with cache.xla_subcache():
+        return _precompile(wrapped, example_args)
+
+
+def _avals_sig(args):
+    """Flat (shape, dtype, sharding) signature of a pytree of arrays."""
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        sh = getattr(leaf, "sharding", None)
+        out.append((shape, dtype, repr(sh) if sh is not None else None))
+    return tuple(out)
+
+
+# ------------------------------------------------------------- CapturedExec
+
+class CapturedExec:
+    """A keyed captured executable: per-signature compile cache with
+    retrace forensics and AOT persistence.
+
+    The compile path for ``parallel.ShardedTrainer`` fused/elastic steps
+    and serving ``Predictor`` bucket forwards. ``sig_argnums`` selects
+    which positional args key the per-call signature (the batch operands;
+    state avals are fixed per instance and belong in ``fingerprint``), so
+    the steady-state hot path costs one small tuple build + dict hit.
+    """
+
+    def __init__(self, fn, *, label, fingerprint="", in_shardings=None,
+                 out_shardings=None, donate_argnums=(), sig_argnums=()):
+        self._fn = fn
+        self.label = label
+        self.fingerprint = fingerprint
+        self._in_shardings = in_shardings
+        self._out_shardings = out_shardings
+        self._donate = tuple(donate_argnums or ())
+        self._sig_argnums = tuple(sig_argnums)
+        self._entries = {}
+        self._last_sig = None
+        self._lock = threading.Lock()
+
+    def _sig_of(self, args):
+        return tuple((tuple(args[i].shape), str(args[i].dtype))
+                     for i in self._sig_argnums)
+
+    def __call__(self, *args):
+        sig = self._sig_of(args)
+        entry = self._entries.get(sig)
+        if entry is None:
+            with self._lock:
+                entry = self._entries.get(sig)
+                if entry is None:
+                    if self._last_sig is not None or self._entries:
+                        _note_retrace(self.label, self._last_sig, sig)
+                    _STATS["capture_misses"] += 1
+                    entry = aot_compile(
+                        self._fn, label=self.label,
+                        fingerprint=self.fingerprint,
+                        example_args=args, sig=_avals_sig(args),
+                        in_shardings=self._in_shardings,
+                        out_shardings=self._out_shardings,
+                        donate_argnums=self._donate)
+                    self._entries[sig] = entry
+                    self._last_sig = sig
+        else:
+            _STATS["capture_hits"] += 1
+        return entry(*args)
+
+    @property
+    def compiled_signatures(self):
+        return sorted(self._entries)
+
+
+# ------------------------------------------------- gluon Trainer capture
+
+def _absorb_session(outer, inner):
+    """Merge a nested TraceSession's reads/mutations into ``outer`` —
+    used when the captured step wraps its update sweep in its own
+    session (to learn pre/post values for the sentinel select) while the
+    enclosing discovery/trace session still needs every state cell."""
+    if outer is None:
+        return
+    for nd_ in inner.captured:
+        if id(nd_) in outer.created:
+            continue
+        outer.orig.setdefault(id(nd_), inner.orig[id(nd_)])
+        if id(nd_) not in outer._captured_ids:
+            outer._captured_ids.add(id(nd_))
+            outer.captured.append(nd_)
+    for nd_ in inner.mutated:
+        if id(nd_) in outer.created:
+            continue
+        if id(nd_) not in outer._mutated_ids:
+            outer._mutated_ids.add(id(nd_))
+            outer.mutated.append(nd_)
+
+
+class CapturedTrainerStep:
+    """One gluon training step — forward, backward, gradient allreduce,
+    optimizer sweep, sentinel finite-check — as a single donated XLA
+    executable with dynamic scalar operands.
+
+    Bitwise-equal to the eager path (eager fwd/bwd + ``Trainer.step``
+    with or without ``engine.bulk``-ed updates), including optimizers
+    whose per-step scalars drift (Adam bias correction, lr schedules):
+    those enter as runtime operands refreshed by a per-step scalar
+    replay, not baked constants (docs/capture.md).
+
+    Parameters
+    ----------
+    net : initialized gluon Block
+    loss_fn : callable(pred_nd, label_nd) -> NDArray (head grad = ones,
+        exactly like calling ``loss.backward()`` eagerly)
+    trainer : gluon.Trainer (``update_on_kvstore`` unsupported)
+    batch_size : rescale denominator for ``Trainer.step``; default = the
+        batch's row count
+    sentinel : HealthSentinel; default = the one attached to ``trainer``
+        (which is bypassed on the captured path — the check runs fused,
+        the policy applies on the host from the returned flag)
+    loss_scaler : amp.LossScaler — its scale becomes a runtime operand:
+        the loss is scaled before backward, gradients unscale before the
+        finite check and update, and the scaler's host schedule advances
+        from the program's overflow flag.
+    """
+
+    def __init__(self, net, loss_fn, trainer, batch_size=None,
+                 sentinel=None, loss_scaler=None, label="trainer_step"):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.trainer = trainer
+        self.label = label
+        self._batch_size = batch_size
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if trainer._update_on_kvstore:
+            raise CaptureError(
+                "capture() does not support update_on_kvstore trainers "
+                "(the update runs outside the step program)")
+        self.sentinel = sentinel if sentinel is not None \
+            else getattr(trainer, "_sentinel", None)
+        self.loss_scaler = loss_scaler
+        self._entries = {}
+        self._last_sig = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------ step python
+    def _opt_host_snapshot(self):
+        opt = self.trainer._optimizer
+        return (opt.num_update, dict(opt._index_update_count),
+                opt.rescale_grad)
+
+    def _opt_host_restore(self, snap):
+        opt = self.trainer._optimizer
+        opt.num_update, count, opt.rescale_grad = snap
+        opt._index_update_count = dict(count)
+
+    def _grad_list(self):
+        out = []
+        for p in self.trainer._params:
+            if p.grad_req != "null":
+                out.extend(p.list_grad())
+        return out
+
+    def _health_flags(self, grads):
+        """Fused health check over the gradients, as traced values:
+        ``(finite, norm_ok_or_None)`` — ``multi_all_finite`` plus the
+        grad-norm bound when the sentinel sets one, mirroring
+        ``HealthSentinel._grads_healthy`` (two separate flags so the
+        host attributes a trip to the same counter eager would:
+        ``sentinel_nonfinite`` vs ``sentinel_grad_norm_trips``)."""
+        from .ndarray import ndarray as _nd
+
+        finite = _nd.imperative_invoke(
+            "multi_all_finite", *grads, num_arrays=len(grads))[0]
+        flag = finite.data_.reshape(())
+        thr = (self.sentinel.grad_norm_threshold
+               if self.sentinel is not None else None)
+        if thr is None:
+            return flag, None
+        import jax.numpy as jnp
+
+        sq = _nd.imperative_invoke(
+            "multi_sum_sq", *grads, num_arrays=len(grads))
+        total = sum(s.data_.reshape(()) for s in sq)
+        # same comparison shape as eager (norm vs threshold, not the
+        # squared form) so threshold-boundary rounding agrees
+        norm_ok = jnp.sqrt(total) <= jnp.float32(thr)
+        return flag, norm_ok
+
+    def _run_step_python(self, x_nd, y_nd, batch_size, scale_val=None,
+                         check_gate=None):
+        """The step body re-run by discovery and by the jit trace. The
+        update sweep runs in a nested TraceSession so the sentinel
+        select knows each cell's pre-update value. ``check_gate`` is the
+        sentinel's cadence operand (1.0 = this step is a check step):
+        on off-cadence steps the eager ``before_update`` never looks at
+        the gradients, so the select must let even an unhealthy batch
+        through — except the loss-scaler's finiteness gate, which eager
+        AMP applies every step."""
+        import jax.numpy as jnp
+
+        from . import autograd
+        from .jit import TraceSession, _active
+        from .ndarray.ndarray import NDArray
+
+        trainer = self.trainer
+        with autograd.record():
+            out = self.net(x_nd)
+            loss = self.loss_fn(out, y_nd)
+            if scale_val is not None:
+                scale_nd = NDArray(jnp.asarray(scale_val, jnp.float32))
+                sess = _active()
+                if sess is not None:
+                    sess.note_created(scale_nd)
+                loss_b = loss * scale_nd
+            else:
+                loss_b = loss
+        loss_b.backward()
+        grads = self._grad_list()
+        if scale_val is not None:
+            inv = 1.0 / scale_nd
+            for g in grads:
+                g._set_data((g * inv)._data)
+        flags = self._health_flags(grads) if (
+            self.sentinel is not None or scale_val is not None) else None
+        outer = _active()
+        trainer._optimizer.rescale_grad = trainer._scale / batch_size
+        with TraceSession() as upd:
+            trainer._allreduce_grads()
+            trainer._update()
+        _absorb_session(outer, upd)
+        if flags is not None:
+            finite, norm_ok = flags
+            ok = finite if norm_ok is None \
+                else jnp.logical_and(finite, norm_ok)
+            if check_gate is not None:
+                passed = jnp.logical_or(ok, check_gate == 0)
+                if scale_val is not None:
+                    # AMP overflow skips are never sampled
+                    passed = jnp.logical_and(passed, finite)
+            else:
+                passed = ok
+            for cell in upd.mutated:
+                cell._data = jnp.where(passed, cell._data,
+                                       upd.orig[id(cell)])
+        return loss, flags
+
+    # ------------------------------------------------------------------ build
+    def _build(self, x_nd, y_nd, batch_size, sig):
+        """Discovery + capture + compile, with the XLA subcache scoped
+        around the WHOLE build when persistence is on: the discovery
+        pass's per-op eager executables then also resolve from the
+        persistent cache, so a warm cold-start skips those compiles too,
+        not just the whole-program one."""
+        import contextlib
+
+        cache = compile_cache()
+        scope = cache.xla_subcache() if cache is not None \
+            else contextlib.nullcontext()
+        with scope:
+            return self._build_inner(x_nd, y_nd, batch_size, sig)
+
+    def _build_inner(self, x_nd, y_nd, batch_size, sig):
+        import jax.numpy as jnp
+
+        from .jit import TraceSession
+        from .ndarray.ndarray import NDArray
+
+        host_snap = self._opt_host_snapshot()
+        scale0 = (self.loss_scaler.loss_scale
+                  if self.loss_scaler is not None else None)
+        has_gate = self.sentinel is not None
+        with _ScalarSession("discover") as scal, TraceSession() as sess:
+            sess.note_created(x_nd)
+            sess.note_created(y_nd)
+            try:
+                self._run_step_python(x_nd, y_nd, batch_size, scale0,
+                                      1.0 if has_gate else None)
+            finally:
+                for m in sess.mutated:
+                    m._data = sess.orig[id(m)]
+                self._opt_host_restore(host_snap)
+        slots = list(scal.slots)
+        n_dyn = len(scal.values)
+        state_cells = list(sess.captured)
+        has_flag = self.sentinel is not None or self.loss_scaler is not None
+        has_scale = self.loss_scaler is not None
+        has_norm = self.sentinel is not None \
+            and self.sentinel.grad_norm_threshold is not None
+        step = self
+
+        def pure(arg_datas, state_datas, dyn_vals):
+            saved = [c._data for c in state_cells]
+            snap = step._opt_host_snapshot()
+            try:
+                for c, d in zip(state_cells, state_datas):
+                    c._data = d
+                x2, y2 = NDArray(arg_datas[0]), NDArray(arg_datas[1])
+                scale_t = dyn_vals[n_dyn] if has_scale else None
+                gate_t = dyn_vals[n_dyn + int(has_scale)] if has_gate \
+                    else None
+                with _ScalarSession("record", slots, dyn_vals), \
+                        TraceSession() as inner:
+                    inner.note_created(x2)
+                    inner.note_created(y2)
+                    loss, flags = step._run_step_python(
+                        x2, y2, batch_size, scale_t, gate_t)
+                outs = [loss.data_]
+                if flags is not None:
+                    outs.append(flags[0])
+                    if flags[1] is not None:
+                        outs.append(flags[1])
+                new_state = [c._data for c in state_cells]
+            finally:
+                for c, d in zip(state_cells, saved):
+                    c._data = d
+                step._opt_host_restore(snap)
+            return outs, new_state
+
+        import numpy as np
+
+        fingerprint = self._fingerprint(sig, slots, state_cells)
+        # numpy f32 scalars: the per-step refresh passes np.float32 too,
+        # so the example avals match the steady-state call exactly (a
+        # Python float would trace a weak-typed operand and the compiled
+        # program would reject the refreshed values)
+        example = ([x_nd.data_, y_nd.data_],
+                   [c._data for c in state_cells],
+                   [np.float32(v) for v in scal.values]
+                   + ([np.float32(scale0)] if has_scale else [])
+                   + ([np.float32(1.0)] if has_gate else []))
+        fn = aot_compile(pure, label=self.label, fingerprint=fingerprint,
+                         example_args=example, donate_argnums=(1,))
+        entry = {
+            "fn": fn, "cells": state_cells, "slots": slots,
+            "has_flag": has_flag, "has_scale": has_scale,
+            "has_gate": has_gate, "has_norm": has_norm,
+            "states_ref": self.trainer._updaters[0].states,
+            "ctx": x_nd.context,
+        }
+        self._entries[sig] = entry
+        self._last_sig = sig
+        return entry
+
+    def _fingerprint(self, sig, slots, state_cells):
+        trainer = self.trainer
+        opt = trainer._optimizer
+        parts = {
+            "net": [(n, tuple(c.shape), str(c.dtype))
+                    for n, c in sorted(
+                        self.net._collect_params_with_prefix().items())],
+            # param avals can't distinguish relu from tanh or one lambda
+            # loss from another — the computation structure must key too
+            "net_struct": net_sig(self.net),
+            "loss_code": code_sig(self.loss_fn),
+            "optimizer": type(opt).__name__,
+            "loss": getattr(self.loss_fn, "__qualname__",
+                            type(self.loss_fn).__name__),
+            "sig": repr(sig),
+            "slots": repr(slots),
+            "n_state": len(state_cells),
+            "sentinel": None if self.sentinel is None else
+                (self.sentinel.policy, self.sentinel.grad_norm_threshold),
+            "scaler": self.loss_scaler is not None,
+        }
+        return fingerprint(parts)
+
+    # ------------------------------------------------------------------- call
+    def _sig_of(self, x_nd, y_nd, batch_size):
+        return ((tuple(x_nd.shape), str(x_nd.data_.dtype)),
+                (tuple(y_nd.shape), str(y_nd.data_.dtype)),
+                float(batch_size))
+
+    def _entry_valid(self, entry):
+        """A checkpoint restore (``set_states_bytes``) rebinds the
+        updater's state dict to fresh cells; the captured program must
+        then re-discover its state list instead of silently reading the
+        orphaned ones."""
+        return entry["states_ref"] is self.trainer._updaters[0].states
+
+    def __call__(self, x, y, batch_size=None):
+        import numpy as np
+
+        from .ndarray.ndarray import NDArray
+        from .resilience import faults as _faults
+        from .resilience import watchdog as _watchdog
+
+        _STATS["capture_steps"] += 1
+        x_nd = x if isinstance(x, NDArray) else NDArray(x)
+        y_nd = y if isinstance(y, NDArray) else NDArray(y)
+        if not enabled():
+            _STATS["capture_fallback_eager"] += 1
+            return self._eager_step(x_nd, y_nd, batch_size)
+        # the nan_grad drill: a captured program cannot be poisoned from
+        # the outside per-step, so the fault poisons the batch instead —
+        # NaN flows through the real compiled fwd/bwd into the fused
+        # sentinel check, same detection surface as the eager hook
+        if _faults.active("nan_grad"):
+            f = _faults.get("nan_grad")
+            if f is not None and f.should_fire():
+                x_nd = NDArray(x_nd.data_ * np.float32("nan"), x_nd.context)
+        bs = batch_size if batch_size is not None else (
+            self._batch_size if self._batch_size is not None
+            else int(x_nd.shape[0]))
+        sig = self._sig_of(x_nd, y_nd, bs)
+        entry = self._entries.get(sig)
+        if entry is not None and not self._entry_valid(entry):
+            _note_retrace(self.label, sig, sig,
+                          reason="trainer state rebound "
+                                 "(checkpoint restore)")
+            del self._entries[sig]
+            entry = None
+        if entry is None:
+            if self._last_sig is not None and self._last_sig != sig:
+                _note_retrace(self.label, self._last_sig, sig)
+            _STATS["capture_misses"] += 1
+            try:
+                entry = self._build(x_nd, y_nd, bs, sig)
+            except CaptureError:
+                _STATS["capture_fallback_eager"] += 1
+                return self._eager_step(x_nd, y_nd, batch_size)
+        else:
+            _STATS["capture_hits"] += 1
+        # scalar replay: re-run the update sweep's Python (schedules,
+        # bias corrections, num_update) with array math skipped, giving
+        # this step's fresh operand values. Snapshot the host bookkeeping
+        # first: a batch the fused health check rejects never reaches the
+        # update sweep on the eager path, so its replay must un-advance
+        # (Adam's t, num_update) to stay bitwise with eager skip_batch.
+        host_snap = self._opt_host_snapshot()
+        self.trainer._optimizer.rescale_grad = \
+            self.trainer._scale / bs
+        with _ScalarSession("replay") as rep:
+            self.trainer._update()
+        if [s for s in rep.slots] != entry["slots"]:
+            self._opt_host_restore(host_snap)  # undo the replay advance
+            raise CaptureError(
+                f"scalar replay diverged from the captured program "
+                f"(captured {len(entry['slots'])} slots, replayed "
+                f"{len(rep.slots)}); recapture with a fresh "
+                "CapturedTrainerStep")
+        dyn = [np.float32(v) for v in rep.values]
+        if entry["has_scale"]:
+            dyn.append(np.float32(self.loss_scaler.loss_scale))
+        # sentinel cadence (HealthSentinel.check_every): same counter
+        # and sampling rule as the eager before_update — an off-cadence
+        # step's gate operand disables the in-program select, so even an
+        # unhealthy batch updates the weights, exactly like eager
+        checking = False
+        if self.sentinel is not None:
+            self.sentinel._step += 1
+            checking = (self.sentinel._step - 1) \
+                % self.sentinel.check_every == 0
+        if entry["has_gate"]:
+            dyn.append(np.float32(1.0 if checking else 0.0))
+        self._step_count += 1
+        _watchdog.note_step(self._step_count)
+        try:
+            with _watchdog.guard("step", detail="capture.CapturedTrainerStep",
+                                 step=self._step_count):
+                _faults.maybe_hang("hang_step")
+                outs, new_state = entry["fn"](
+                    [x_nd.data_, y_nd.data_],
+                    [c._data for c in entry["cells"]], dyn)
+        except _watchdog.StallError as e:
+            if not self._stall_rollback(e):
+                # the stalled step never applied: un-advance the replay's
+                # host bookkeeping (Adam's t, num_update) so a caller that
+                # catches the stall and keeps training stays bitwise with
+                # eager (a successful rollback restores it from the ckpt)
+                self._opt_host_restore(host_snap)
+                raise
+            return None
+        for c, v in zip(entry["cells"], new_state):
+            c._data = v
+        loss = NDArray(outs[0], entry["ctx"])
+        if entry["has_flag"]:
+            finite_ok = bool(np.asarray(outs[1]).reshape(-1)[0])
+            norm_ok = (bool(np.asarray(outs[2]).reshape(-1)[0])
+                       if entry["has_norm"] else None)
+            gated = (not finite_ok) if not checking \
+                else not (finite_ok and norm_ok is not False)
+            if gated and (checking or entry["has_scale"]):
+                self._opt_host_restore(host_snap)
+            self._apply_flag(finite_ok, norm_ok, checking)
+        return loss
+
+    def _apply_flag(self, finite_ok, norm_ok, checking):
+        """Host-side policy application from the program's fused health
+        flags — the captured counterpart of ``HealthSentinel
+        .before_update`` (weights were already gated by the in-program
+        select, so every policy only does bookkeeping/restore here).
+        ``checking`` follows the sentinel's ``check_every`` cadence:
+        off-cadence steps do no sentinel bookkeeping at all (eager
+        ``before_update`` returns before looking at the gradients); a
+        loss-scaler overflow is still recorded every step."""
+        from .resilience import sentinel as _sentinel
+
+        scaler = self.loss_scaler
+        if scaler is not None:
+            scaler.update_scale(not finite_ok)
+        s = self.sentinel
+        if s is None or not checking:
+            if scaler is not None and not finite_ok:
+                _sentinel.note_skip("amp_overflow")
+            return
+        ok = finite_ok and norm_ok is not False
+        _sentinel.note_check(
+            ok, kind="nonfinite" if not finite_ok else "grad_norm")
+        if ok:
+            return
+        s.last_reason = (
+            "non-finite gradient (NaN/Inf) (captured step)"
+            if not finite_ok else
+            f"global grad norm exceeds threshold "
+            f"{s.grad_norm_threshold:.3e} (captured step)")
+        if s.policy == "raise":
+            raise _sentinel.NumericHealthError(
+                f"numeric health check failed at captured step "
+                f"{self._step_count}: {s.last_reason}")
+        if s.policy == "skip_batch" or s.manager is None:
+            _sentinel.note_skip("sentinel")
+            return
+        restored = s.manager.restore_latest(net=s._net or self.net,
+                                            trainer=self.trainer)
+        if restored is None:
+            raise _sentinel.NumericHealthError(
+                "rollback requested (captured step) but no valid "
+                f"checkpoint exists under {s.manager.directory}")
+        _sentinel.note_skip("sentinel")
+        _sentinel.note_rollback()
+
+    def _stall_rollback(self, err):
+        """Mirror ``Trainer._stall_rollback`` for the captured call."""
+        from .resilience import watchdog as _watchdog
+
+        s = self.sentinel
+        if s is None or s.policy != "rollback" or s.manager is None:
+            return False
+        manifest = s.manager.restore_latest(net=s._net or self.net,
+                                            trainer=self.trainer)
+        if manifest is None:
+            return False
+        _watchdog.note_rollback(err, manifest)
+        import warnings
+
+        warnings.warn(
+            f"captured step stalled ({err}); rolled back to checkpoint "
+            f"step {manifest.get('step')} and skipped the step")
+        return True
+
+    def _eager_step(self, x_nd, y_nd, batch_size):
+        """The identical step semantics, eagerly (kill switch and
+        capture-failure fallback): plain fwd/bwd + ``Trainer.step`` with
+        the sentinel attached, exactly the pre-capture hot loop. With a
+        loss scaler the captured data flow is replicated by hand (scale
+        loss, unscale grads, fused finite check gating the update)."""
+        import numpy as np
+
+        from . import autograd
+
+        trainer = self.trainer
+        bs = batch_size if batch_size is not None else (
+            self._batch_size if self._batch_size is not None
+            else int(x_nd.shape[0]))
+        scaler = self.loss_scaler
+        if scaler is None:
+            reattach = self.sentinel is not None \
+                and trainer._sentinel is None
+            if reattach:
+                trainer._sentinel = self.sentinel
+            try:
+                with autograd.record():
+                    loss = self.loss_fn(self.net(x_nd), y_nd)
+                loss.backward()
+                trainer.step(bs)
+            finally:
+                if reattach:
+                    trainer._sentinel = None
+            return loss
+        from .resilience import faults as _faults
+        from .resilience import watchdog as _watchdog
+
+        scale = float(scaler.loss_scale)
+        with autograd.record():
+            loss = self.loss_fn(self.net(x_nd), y_nd)
+            loss_b = loss * scale
+        loss_b.backward()
+        s = self.sentinel
+        checking = False
+        if s is not None:
+            s._step += 1
+            checking = (s._step - 1) % s.check_every == 0
+        trainer._optimizer.rescale_grad = trainer._scale / bs
+        # mirror gluon.Trainer.step's guard/fault points: the kill-switch
+        # path must keep the watchdog deadline, hang/NaN drills, and
+        # stall rollback the resilience stack promises for every step
+        try:
+            with _watchdog.guard("step", detail="capture._eager_step",
+                                 step=getattr(s, "_step", None)):
+                _faults.maybe_hang("hang_step")
+                grads = self._grad_list()
+                inv = 1.0 / scale
+                for g in grads:
+                    g._set_data((g * inv)._data)
+                _faults.maybe_nan_grads(self.trainer._params)
+                finite_t, norm_t = self._health_flags(grads)
+                finite_ok = bool(np.asarray(finite_t).reshape(-1)[0])
+                norm_ok = (bool(np.asarray(norm_t).reshape(-1)[0])
+                           if norm_t is not None else None)
+                ok = finite_ok and norm_ok is not False
+                if finite_ok and (ok or not checking):
+                    trainer._allreduce_grads()
+                    trainer._update()
+        except _watchdog.StallError as e:
+            if not self._stall_rollback(e):
+                raise
+            return None
+        self._apply_flag(finite_ok, norm_ok, checking)
+        return loss
+
+
+class CapturedShardedStep:
+    """Captured view of a ``parallel.ShardedTrainer``: the trainer's
+    fused step is already one donated pjit program, and every one of its
+    step/grads/apply programs compiles through the capture path — AOT
+    persistence, retrace forensics, capture counters — so this wrapper
+    just counts steps and delegates (watchdog, elastic microbatching,
+    mesh-shrink recovery all apply unchanged; an elastic or mesh
+    re-capture shows up in :func:`retrace_log` instead of recompiling
+    silently)."""
+
+    def __init__(self, trainer, label="sharded_step"):
+        self.trainer = trainer
+        self.label = label
+        # no executable invalidation needed: every ShardedTrainer step/
+        # grads/apply program already compiles through _capture_exec, so
+        # a pre-built (possibly minutes-of-XLA) executable is kept
+
+    def __call__(self, x, y, microbatches=None):
+        _STATS["capture_steps"] += 1
+        return self.trainer.step(x, y, microbatches=microbatches)
+
+    @property
+    def mesh(self):
+        return self.trainer.mesh
+
+
+def capture(trainer, net=None, loss_fn=None, **kwargs):
+    """Capture a whole training step as one donated XLA executable.
+
+    ``capture(sharded_trainer)`` returns a :class:`CapturedShardedStep`;
+    ``capture(trainer, net=net, loss_fn=loss)`` (gluon) returns a
+    :class:`CapturedTrainerStep`. With ``MXNET_TPU_CAPTURE=0`` the gluon
+    wrapper executes the identical step eagerly (kill switch).
+    """
+    from .parallel.trainer import ShardedTrainer
+
+    if isinstance(trainer, ShardedTrainer):
+        return CapturedShardedStep(trainer, **kwargs)
+    if net is None or loss_fn is None:
+        raise CaptureError(
+            "capture(gluon_trainer) needs net= and loss_fn= (the step "
+            "program is fwd+bwd+update, not just the update sweep)")
+    return CapturedTrainerStep(net, loss_fn, trainer, **kwargs)

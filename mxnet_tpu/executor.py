@@ -166,9 +166,36 @@ class Executor:
             return outs, list(grads), new_aux
 
         self._jit_fwd_bwd = fwd_bwd if placement else jax.jit(fwd_bwd)
+        self._infer_capture = None
         self._outputs = None
         self._pending_train = False
         self.monitor_callback = None
+
+    def enable_capture(self, label, fingerprint):
+        """Route the stateless inference fast path (``forward_batch``)
+        through the capture/AOT compile path (mxnet_tpu.capture): the
+        executable compiles via the sanctioned capture site, gets
+        capture/AOT counters and retrace forensics, and — with
+        ``MXNET_TPU_COMPILE_CACHE`` set — persists to/loads from the
+        on-disk artifact keyed by ``fingerprint``, so a serving
+        cold-start skips tracing and XLA compilation. Placed
+        (``group2ctx``) graphs run eagerly per node and are left alone.
+        Returns self for chaining."""
+        if self._placement is not None:
+            return self
+        from . import capture as _capture
+
+        if not _capture.enabled():
+            return self
+        pure = self._pure
+
+        def infer(arg_vals, aux_vals):
+            outs, _new_aux = pure(arg_vals, aux_vals, False)
+            return outs
+
+        self._infer_capture = _capture.CapturedExec(
+            infer, label=label, fingerprint=fingerprint)
+        return self
 
     # ------------------------------------------------------------------ api
     @property
@@ -250,7 +277,11 @@ class Executor:
                 v = v._data
             arg_vals.append(v)
         aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
-        outs, _ = self._jit_fwd(arg_vals, aux_vals, False)
+        cap = self._infer_capture
+        if cap is not None:
+            outs = cap(arg_vals, aux_vals)
+        else:
+            outs, _ = self._jit_fwd(arg_vals, aux_vals, False)
         if raw:
             return outs
         return [NDArray(o, self._ctx) for o in outs]

@@ -118,6 +118,8 @@ def _infer_role(relpath):
         return "ops"
     if base == "engine.py":
         return "engine"
+    if base == "capture.py":
+        return "capture"
     if base == "faults.py":
         return "faults"
     return "module"

@@ -52,6 +52,12 @@ _SANCTIONED_JIT = {
     "registry": {"_compile"},
     # a recorded bulk segment compiles itself exactly once, keyed+cached
     "engine": {"_flush"},
+    # whole-program capture + AOT cache: every captured executable —
+    # trainer steps, elastic grad/apply programs, serving bucket
+    # forwards, deserialized AOT artifacts — compiles through the one
+    # keyed site so donation conventions and the capture/AOT counters
+    # cannot be bypassed
+    "capture": {"_compile_jit"},
 }
 
 
@@ -568,6 +574,12 @@ def run(project):
     for mod in project.modules():
         if mod.role in ("ops", "engine", "registry"):
             _check_ts001(mod, findings)
+            _check_ts002(mod, findings)
+        if mod.role == "capture":
+            # the capture/AOT module is itself a compile site: TS002
+            # polices that every jit goes through _compile_jit (TS001's
+            # kernel taint model does not apply — captured programs
+            # re-run user Python, checked at their own roles)
             _check_ts002(mod, findings)
         if mod.role == "registry":
             _check_ts003(mod, findings)

@@ -308,6 +308,19 @@ def _is_shared(buf):
 _BULK_HOOK = None
 _PLACEHOLDER_CLS = None
 
+# Capture hook, installed by mxnet_tpu.capture the first time a capture
+# session opens. Consulted BEFORE the traced early-return and the bulk
+# hook: capture's scalar sessions must see every dispatch (to discover/
+# substitute/replay dynamic scalar operands) regardless of which path
+# would otherwise execute it. None until capture is first used, so the
+# steady-state dispatch cost is one global None-check (like _BULK_HOOK).
+_CAPTURE_HOOK = None
+
+
+def _set_capture_hook(hook):
+    global _CAPTURE_HOOK
+    _CAPTURE_HOOK = hook
+
 
 def _set_bulk_hook(hook, placeholder_cls):
     global _BULK_HOOK, _PLACEHOLDER_CLS
@@ -433,6 +446,10 @@ def dispatch(op, params, arrays, device, is_traced=None):
     if _RECORD_DIR is not None and not is_traced and \
             op.name not in _RECORDED:
         _record_call(op, arrays, params)
+    if _CAPTURE_HOOK is not None:
+        out = _CAPTURE_HOOK(op, params, arrays, device, is_traced)
+        if out is not NotImplemented:
+            return out
     if device is None or is_traced:
         return op.closed(params)(*arrays)
 

@@ -642,6 +642,13 @@ class Updater:
         weight._set_data(weight._data.at[rows].set(w_rows._data))
         scatter(state, state_rows)
 
+    # reserved (non-index) key carrying the optimizer's per-index update
+    # counts, so a resumed Adam/FTML-style run replays the same bias
+    # correction t as the uninterrupted one (bitwise kill-resume); blobs
+    # written before this key existed still load (counts then restart,
+    # the old behavior)
+    _COUNTS_KEY = "__update_counts__"
+
     def set_states(self, states):
         def _to_nd(x):
             if isinstance(x, _np.ndarray):
@@ -653,8 +660,13 @@ class Updater:
             return x
 
         data = pickle.loads(states)
+        counts = data.pop(self._COUNTS_KEY, None)
         self.states = {k: _to_nd(v) for k, v in data.items()}
         self.states_synced = {k: True for k in self.states}
+        if counts is not None:
+            self.optimizer._index_update_count = dict(counts)
+            self.optimizer.num_update = max(
+                [self.optimizer.begin_num_update, *counts.values()])
 
     def get_states(self, dump_optimizer=False):
         def _to_np(x):
@@ -664,7 +676,11 @@ class Updater:
                 return tuple(_to_np(y) for y in x)
             return x
 
-        return pickle.dumps({k: _to_np(v) for k, v in self.states.items()})
+        out = {k: _to_np(v) for k, v in self.states.items()}
+        counts = self.optimizer._index_update_count
+        if counts:
+            out[self._COUNTS_KEY] = dict(counts)
+        return pickle.dumps(out)
 
 
 def get_updater(optimizer):

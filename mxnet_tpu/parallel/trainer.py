@@ -241,6 +241,55 @@ class ShardedTrainer:
 
         return compute_loss
 
+    def _capture_fingerprint(self):
+        """Structural identity of this trainer's step programs for the
+        capture/AOT compile path (mxnet_tpu.capture): everything that
+        changes the traced program — params, optimizer + hyperparams
+        (baked into make_update_fn here, unlike the gluon trainer's
+        dynamic operands), mesh topology, sharding rules, compute dtype.
+        A changed fingerprint is a re-capture, recorded in the retrace
+        forensics; an unchanged one re-links the on-disk AOT artifact."""
+        from .. import capture as _capture
+
+        parts = {
+            "params": sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in self.params.items()),
+            "aux": sorted((k, tuple(v.shape), str(v.dtype))
+                          for k, v in self.aux.items()),
+            # param avals alone can't distinguish relu from tanh or one
+            # lambda loss body from another (docs/capture.md key schema)
+            "net_struct": _capture.net_sig(self.net),
+            "loss_code": _capture.code_sig(self.loss_fn),
+            "optimizer": (str(self._optimizer),
+                          sorted(self._optimizer_params.items())),
+            "loss": getattr(self.loss_fn, "__qualname__",
+                            type(self.loss_fn).__name__),
+            "mesh": {str(a): int(s) for a, s in
+                     zip(self.mesh.axis_names, self.mesh.devices.shape)},
+            "rules": [(p.pattern, str(s)) for p, s in self._rules],
+            "dtype": self._compute_dtype,
+            "batch_axis": self._batch_axis,
+        }
+        return _capture.fingerprint(parts)
+
+    def _capture_exec(self, fn, label, **kwargs):
+        """Compile one step-program through the capture path (AOT
+        persistence + retrace forensics + capture counters), noting a
+        re-capture when the program fingerprint moved since the last
+        build (mesh shrink, set_learning_rate)."""
+        from .. import capture as _capture
+
+        fp = self._capture_fingerprint()
+        prev = getattr(self, "_capture_fp", None)
+        if prev is not None and prev != fp:
+            _capture.note_recapture(
+                label, prev, fp,
+                reason="step program rebind (mesh or hyperparameters "
+                       "changed)")
+        self._capture_fp = fp
+        return _capture.CapturedExec(fn, label=label, fingerprint=fp,
+                                     **kwargs)
+
     def _build_step(self):
         import jax
 
@@ -260,13 +309,13 @@ class ShardedTrainer:
         opt_sharding = self._opt_sharding()
         out_shardings = (self._param_sharding, self._aux_sharding,
                          opt_sharding, None)
-        self._step = jax.jit(
-            step,
+        self._step = self._capture_exec(
+            step, "sharded_step",
             in_shardings=(self._param_sharding, self._aux_sharding,
                           opt_sharding, self._batch_sharding,
                           self._batch_sharding),
             out_shardings=out_shardings,
-            donate_argnums=(0, 1, 2))
+            donate_argnums=(0, 1, 2), sig_argnums=(3, 4))
 
     @classmethod
     def for_multihost(cls, net, loss_fn, optimizer="sgd",
@@ -574,18 +623,22 @@ class ShardedTrainer:
                 compute_loss, has_aux=True)(params, aux, x, y)
             return grads, new_aux, loss
 
-        self._grads_fn = jax.jit(
-            grads_fn,
+        # the microbatch shapes key the signature: an elastic shrink
+        # re-captures at the smaller batch and the re-capture lands in
+        # the retrace forensics instead of recompiling silently
+        self._grads_fn = self._capture_exec(
+            grads_fn, "sharded_grads",
             in_shardings=(self._param_sharding, self._aux_sharding,
                           self._batch_sharding, self._batch_sharding),
-            out_shardings=(self._param_sharding, self._aux_sharding, None))
+            out_shardings=(self._param_sharding, self._aux_sharding, None),
+            sig_argnums=(2, 3))
 
         def apply_fn(params, grads, opt_state):
             return update(params, grads, opt_state)
 
         opt_sharding = self._opt_sharding()
-        self._apply_fn = jax.jit(
-            apply_fn,
+        self._apply_fn = self._capture_exec(
+            apply_fn, "sharded_apply",
             in_shardings=(self._param_sharding, self._param_sharding,
                           opt_sharding),
             out_shardings=(self._param_sharding, opt_sharding))

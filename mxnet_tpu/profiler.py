@@ -179,8 +179,13 @@ def dispatch_stats(reset=False):
       stalled_batches, queue_peak, p50/p99 request latency (us)
     - dataloader_respawns: multiprocessing DataLoader workers respawned
       after dying mid-epoch (docs/resilience.md)
+    - capture counters (docs/capture.md): capture_steps/hits/misses,
+      capture_retraces (recompiles of a captured program, each with a
+      structured reason in the dispatch ring and capture.retrace_log()),
+      capture_fallback_eager, aot_cache_hits/misses/stale/corrupt/
+      writes/evictions (the persistent AOT compile cache)
     """
-    from . import engine, resilience, serving
+    from . import capture, engine, resilience, serving
     from .gluon.data import dataloader
     from .ops import registry
 
@@ -189,6 +194,7 @@ def dispatch_stats(reset=False):
     stats.update(resilience.stats())
     stats.update(serving.stats())
     stats.update(dataloader.stats())
+    stats.update(capture.stats())
     if reset:
         reset_dispatch_stats()
     return stats
@@ -196,8 +202,8 @@ def dispatch_stats(reset=False):
 
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
-    serving + dataloader)."""
-    from . import engine, resilience, serving
+    serving + dataloader + capture)."""
+    from . import capture, engine, resilience, serving
     from .gluon.data import dataloader
     from .ops import registry
 
@@ -207,6 +213,7 @@ def reset_dispatch_stats():
     resilience.reset_stats()
     serving.reset_stats()
     dataloader.reset_stats()
+    capture.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

@@ -21,8 +21,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["HealthSentinel", "NumericHealthError", "note_skip", "stats",
-           "reset_stats"]
+__all__ = ["HealthSentinel", "NumericHealthError", "note_skip",
+           "note_check", "note_rollback", "stats", "reset_stats"]
 
 POLICIES = ("raise", "skip_batch", "rollback")
 
@@ -43,6 +43,25 @@ def note_skip(reason="sentinel"):
     _STATS["health_skipped_steps"] += 1
     if reason == "amp_overflow":
         _STATS["amp_overflow_skips"] += 1
+
+
+def note_check(healthy, kind="nonfinite"):
+    """Record one fused health check that ran OUTSIDE ``before_update`` —
+    the captured-step path (mxnet_tpu.capture) runs the finite check
+    inside its compiled program and reports the result here, so the
+    sentinel counter series stays one series across dispatch paths.
+    ``kind`` attributes an unhealthy result to the same counter
+    ``_grads_healthy`` would use: ``"nonfinite"`` or ``"grad_norm"``."""
+    _STATS["sentinel_checks"] += 1
+    if not healthy:
+        _STATS["sentinel_grad_norm_trips" if kind == "grad_norm"
+               else "sentinel_nonfinite"] += 1
+
+
+def note_rollback():
+    """Record one checkpoint rollback applied by an external policy
+    driver (the captured step applies the rollback itself)."""
+    _STATS["sentinel_rollbacks"] += 1
 
 
 def stats():

@@ -295,9 +295,49 @@ class Predictor:
         _STATS["serving_compiles"] += 1
         if bucket not in self._buckets:
             _STATS["serving_unbucketed"] += 1
-        return self._symbol.bind(self._ctx, arg_dict, grad_req="null",
-                                 aux_states=aux_dict,
-                                 group2ctx=self._group2ctx)
+        ex = self._symbol.bind(self._ctx, arg_dict, grad_req="null",
+                               aux_states=aux_dict,
+                               group2ctx=self._group2ctx)
+        # route the bucket executable through the capture/AOT compile
+        # path: with MXNET_TPU_COMPILE_CACHE set, a serving cold-start
+        # (warmup or first batch) loads the persisted program instead of
+        # tracing + XLA-compiling every bucket (docs/capture.md)
+        return ex.enable_capture(f"serving_bucket{bucket}",
+                                 self._program_fingerprint(bucket, sig))
+
+    def _program_fingerprint(self, bucket, sig):
+        """Structural identity of one bucket executable for the AOT
+        compile cache: the graph (symbol JSON), the bound param/aux
+        shapes+dtypes, the bucket and input signature. Param VALUES are
+        runtime operands — a re-trained params file reuses the artifact;
+        a changed architecture misses."""
+        import hashlib
+        import json
+
+        from .. import capture as _capture
+
+        base = getattr(self, "_symbol_digest", None)
+        if base is None:
+            # canonicalize gensym'd op-node names (fullyconnected0 vs
+            # fullyconnected1 across builds of the same block) so the
+            # digest keys the structure; variable nodes keep their names
+            # (they bind the params)
+            graph = json.loads(self._symbol.tojson())
+            for i, node in enumerate(graph.get("nodes", ())):
+                if node.get("op") != "null":
+                    node["name"] = f"n{i}"
+            base = hashlib.sha256(json.dumps(
+                graph, sort_keys=True).encode()).hexdigest()[:16]
+            self._symbol_digest = base
+        return _capture.fingerprint({
+            "symbol": base,
+            "args": sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in self._arg_params.items()),
+            "aux": sorted((k, tuple(v.shape), str(v.dtype))
+                          for k, v in self._aux_params.items()),
+            "bucket": int(bucket), "sig": repr(sig),
+            "dtype": str(self._dtype),
+        })
 
     def warmup(self, buckets=None, dtype=None):
         """Compile (bind + trace + XLA-compile) every declared bucket now,

@@ -51,3 +51,7 @@ def pytest_configure(config):
         "lint: graftlint static-analysis gate (tools/graftlint.py, "
         "docs/static_analysis.md); runs in tier-1 so a new invariant "
         "violation fails CI")
+    config.addinivalue_line(
+        "markers",
+        "capture: whole-program step capture + AOT compile cache "
+        "(mxnet_tpu/capture.py, docs/capture.md); runs in tier-1")

@@ -1,0 +1,20 @@
+# graftlint: role=capture
+"""TS002 fixture for the capture/AOT compile site: ``_compile_jit`` is
+the sanctioned keyed-cache site; an unsanctioned ``jax.jit`` right next
+to it (the tempting shortcut when adding a new captured program) must
+still fire."""
+import jax
+
+
+def _compile_jit(fn, jit_kwargs):
+    """Clean: THE sanctioned capture compile site."""
+    return jax.jit(fn, **jit_kwargs)
+
+
+def aot_compile_like(fn, example_args):
+    jitted = _compile_jit(fn, {})  # clean: routes through the site
+    return jitted.lower(*example_args).compile()
+
+
+def sneaky_warm_path(exported):
+    return jax.jit(exported.call)  # VIOLATION: bypasses _compile_jit

@@ -65,6 +65,14 @@ def test_ts002_exact(fixture_findings):
     ]), got
 
 
+def test_ts002_capture_site(fixture_findings):
+    # the capture/AOT module's sanctioned site (_compile_jit) and its
+    # callers stay clean; an unsanctioned jax.jit right next to them —
+    # e.g. jitting an exported artifact's .call directly — still fires
+    got = _in_file(fixture_findings, "ts002_capture.py")
+    assert got == [("TS002", "sneaky_warm_path", "jax.jit")], got
+
+
 def test_ts003_exact(fixture_findings):
     got = _in_file(fixture_findings, "ts003_donated_read.py")
     assert got == [("TS003", "dispatch_donated", "arrays")], got
@@ -127,9 +135,9 @@ def test_no_unexpected_fixture_findings(fixture_findings):
     # "exactly those, no more": every finding in the fixture tree is
     # claimed by one of the per-rule assertions above
     claimed = {"ts001_host_sync.py": 9, "ts002_raw_jit.py": 3,
-               "ts003_donated_read.py": 1, "cc001_unlocked.py": 1,
-               "cc002_lock_order.py": 1, "cc003_unjoined.py": 1,
-               "rd002_counter_drift.py": 1}
+               "ts002_capture.py": 1, "ts003_donated_read.py": 1,
+               "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
+               "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1}
     per_file = {}
     for f in fixture_findings:
         per_file[os.path.basename(f.path)] = \

@@ -54,7 +54,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "ckpt_partial_write", "ckpt_shard_corrupt",
               "ckpt_crash_before_manifest", "ckpt_async_crash",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
-              "peer_death_recover", "oom_step", "dist_connect_timeout")
+              "peer_death_recover", "oom_step", "dist_connect_timeout",
+              "capture_step")
 
 
 def _mx():
@@ -359,6 +360,56 @@ def _drill_oom_step(mx, workdir):
     return ok, f"n={trainer._elastic_n} stats={s}"
 
 
+def _drill_capture_step(mx, workdir):
+    """Fault injection under a CAPTURED whole-program step
+    (mxnet_tpu.capture, docs/capture.md): a nan_grad-poisoned batch
+    flows through the compiled program's fused finite check and the
+    in-program select leaves weights bitwise-untouched (skip_batch);
+    then hang_step stalls the captured call and the rollback sentinel
+    restores the checkpoint, exactly like the eager drills."""
+    import numpy as np
+
+    from mxnet_tpu import capture
+    from mxnet_tpu.resilience import (CheckpointManager, HealthSentinel,
+                                      faults)
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).sum()
+
+    net, trainer, _ = _trainer(mx)
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=2)
+    sent = HealthSentinel(policy="skip_batch")
+    step = capture.capture(trainer, net=net, loss_fn=loss_fn,
+                           sentinel=sent)
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = mx.nd.ones((2, 4))
+    step(x, y, batch_size=2)  # compile + one clean step
+    before = {k: v.asnumpy().copy()
+              for k, v in net._collect_params_with_prefix().items()}
+    with faults.inject("nan_grad") as f:
+        step(x, y, batch_size=2)
+    now = {k: v.asnumpy()
+           for k, v in net._collect_params_with_prefix().items()}
+    gated = f.fired == 1 and all(
+        np.array_equal(before[k], now[k]) for k in before)
+
+    # stall the captured call: rollback policy -> checkpoint restore
+    sent.policy = "rollback"
+    sent.attach(trainer, net=net, checkpoint_manager=mgr)
+    mgr.save(1, net=net, trainer=trainer)
+    t0 = time.monotonic()
+    with faults.inject("hang_step"):
+        out = step(x, y, batch_size=2)  # stalls -> rollback -> skipped
+    elapsed = time.monotonic() - t0
+    now = {k: v.asnumpy()
+           for k, v in net._collect_params_with_prefix().items()}
+    rolled = out is None and all(
+        np.array_equal(before[k], now[k]) for k in before)
+    step(x, y, batch_size=2)  # training continues
+    ok = gated and rolled and elapsed < 2 * float(_DEADLINE) + 1.0
+    return ok, f"gated={gated} rolled_back={rolled} elapsed={elapsed:.2f}s"
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -409,6 +460,8 @@ def run_kind(kind, workdir=None):
             return _drill_oom_step(mx, tmp)
         if kind == "dist_connect_timeout":
             return _drill_dist_connect_timeout(mx, tmp)
+        if kind == "capture_step":
+            return _drill_capture_step(mx, tmp)
         raise ValueError(f"unknown chaos kind {kind!r}")
     finally:
         faults.reset()
