@@ -257,19 +257,30 @@ class KVStoreTPU(KVStore):
             _faults.maybe_hang("hang_collective")
             super().push(key, value, priority)
 
-    def excise_dead_peers(self):
+    def excise_dead_peers(self, ranks=None):
         """Re-admit the store's collectives after dead ranks have been
         excised from the job — the kvstore-side hook of elastic peer
         recovery. ``PeerLostError`` bookkeeping is sticky by design (a
         dead rank must keep failing fast, never block), so once an
         elastic restart has rebuilt the worker set without the dead
         ranks (``parallel.ShardedTrainer`` mesh-shrink resume does this
-        automatically; an operator replacing a worker does it by hand),
-        call this to clear the bookkeeping and let push/pull serve
-        again. Returns the ranks that were cleared."""
+        automatically; ``serving.fleet.ReplicaSupervisor`` does it per
+        re-admitted replica; an operator replacing a worker does it by
+        hand), call this to clear the bookkeeping and let push/pull
+        serve again.
+
+        ``ranks=None`` (the historical form) clears every dead rank;
+        passing an iterable clears only those ranks — one recovered
+        replica must not silently re-admit a peer that is still dead.
+        Returns the ranks that were actually cleared."""
         dead = _watchdog.dead_peers()
-        _watchdog.reset_peers()
-        return dead
+        if ranks is None:
+            cleared = dead
+        else:
+            wanted = {int(r) for r in ranks}
+            cleared = [r for r in dead if r in wanted]
+        _watchdog.reset_peers(cleared if ranks is not None else None)
+        return cleared
 
     def _reduce(self, values):
         if len(values) == 1:

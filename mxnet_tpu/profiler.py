@@ -177,6 +177,12 @@ def dispatch_stats(reset=False):
       batch_samples/padded_samples (pad waste), bucket hits/misses/
       compiles, shed_deadline/shed_overload, poisoned_batches,
       stalled_batches, queue_peak, p50/p99 request latency (us)
+    - fleet counters (docs/serving.md "Fleet"): fleet_requests/retries/
+      hedges/hedge_wins, fleet_breaker_opens/half_open_probes,
+      fleet_probe_failures/replica_failures, fleet_restarts/drains,
+      fleet_shed_overloaded/deadline_exceeded, fleet-level p50/p99
+      latency (us) and the per-replica summary string
+      fleet_replica_latency_us
     - dataloader_respawns: multiprocessing DataLoader workers respawned
       after dying mid-epoch (docs/resilience.md)
     - capture counters (docs/capture.md): capture_steps/hits/misses,

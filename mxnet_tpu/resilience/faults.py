@@ -46,6 +46,20 @@ Supported fault kinds (the hook that honours each is noted):
                                   collective raises PeerLostError (rank
                                   from ``MXNET_TPU_FAULT_PEER_RANK``,
                                   default 1)
+- ``replica_crash``             — one serving-fleet replica dies mid-batch
+                                  (thread replicas fail the batch with
+                                  ``ReplicaCrash``; subprocess replicas
+                                  exit the worker process). Victim from
+                                  ``MXNET_TPU_FAULT_REPLICA``, default 0.
+- ``replica_hang``              — wedge one fleet replica's batch
+                                  execution in an interruptible sleep
+                                  (same targeting; unwedged by the batch
+                                  watchdog or the hang cap)
+- ``replica_nan_storm``         — poison EVERY batch on one fleet replica
+                                  with NaN (same targeting; arm with
+                                  ``times=N`` for an N-batch storm) so
+                                  the sentinel fails them and the
+                                  router's circuit breaker opens
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -65,11 +79,13 @@ import os
 import threading
 import time
 
-__all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "inject",
-           "arm", "disarm", "reset", "active", "get", "stats",
+__all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
+           "inject", "arm", "disarm", "reset", "active", "get", "stats",
            "reset_stats", "maybe_nan_grads", "checkpoint_write_filter",
            "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
-           "maybe_hang", "maybe_oom_step", "maybe_peer_death"]
+           "maybe_hang", "maybe_oom_step", "maybe_peer_death",
+           "maybe_replica_crash", "maybe_replica_hang",
+           "maybe_replica_nan_storm"]
 
 
 class SimulatedCrash(BaseException):
@@ -86,6 +102,13 @@ class FaultInjected(RuntimeError):
 class InjectedOOM(FaultInjected):
     """Injected step OOM. The message mimics XLA's RESOURCE_EXHAUSTED so
     string-based classifiers treat it exactly like the real thing."""
+
+
+class ReplicaCrash(FaultInjected):
+    """Injected death of one serving-fleet replica. A thread replica's
+    batch fails with this error (the router treats it as a replica fault
+    and retries elsewhere); a subprocess replica's worker converts it
+    into ``os._exit`` — the process-isolation analogue of a SIGKILL."""
 
 
 _LOCK = threading.Lock()
@@ -247,17 +270,13 @@ def maybe_crash(point):
         raise SimulatedCrash(f"injected crash at {point}")
 
 
-def maybe_nan_batch(feeds):
-    """Poison one inference batch (kind ``nan_serving``): the first
-    floating-point entry of ``feeds`` (dict name -> array) is replaced by
-    NaNs. Hooked into ``serving.Predictor`` just before execution, so the
-    poison flows through the real compiled executable and is caught by the
-    BatchServer's output health check — not short-circuited on the host."""
-    if not _ACTIVE:
-        return feeds
-    fault = _ACTIVE.get("nan_serving")
-    if fault is None:
-        return feeds
+def _poison_first_float(fault, feeds, kind):
+    """Shared NaN-poisoning body for ``nan_serving`` /
+    ``replica_nan_storm``: replace the first floating-point entry of
+    ``feeds`` (dict name -> array) with NaNs, consuming one fire of
+    ``fault``. The poison flows through the real compiled executable and
+    is caught by the BatchServer's output health check — not
+    short-circuited on the host."""
     import numpy as np
 
     # find a poisonable entry BEFORE consuming the fault's fire window:
@@ -271,13 +290,25 @@ def maybe_nan_batch(feeds):
             break
     if target is None:
         raise FaultInjected(
-            "nan_serving armed but the batch has no floating-point input "
+            f"{kind} armed but the batch has no floating-point input "
             f"to poison (inputs: {list(feeds)})")
     if not fault.should_fire():
         return feeds
     out = dict(feeds)
     out[target[0]] = np.full_like(target[1], np.nan)
     return out
+
+
+def maybe_nan_batch(feeds):
+    """Poison one inference batch (kind ``nan_serving``). Hooked into
+    ``serving.Predictor`` just before execution, proving the BatchServer
+    sentinel path."""
+    if not _ACTIVE:
+        return feeds
+    fault = _ACTIVE.get("nan_serving")
+    if fault is None:
+        return feeds
+    return _poison_first_float(fault, feeds, "nan_serving")
 
 
 def maybe_dist_connect_fault():
@@ -290,18 +321,11 @@ def maybe_dist_connect_fault():
             "coordinator connect timed out [injected fault]")
 
 
-def maybe_hang(point):
-    """Wedge the calling thread at ``point`` (``hang_step`` /
-    ``hang_collective`` / ``hang_batch``): spin in short interruptible
-    sleeps so the watchdog's asynchronous StallError can land between
-    bytecodes — exactly the Python-level-hang class the watchdog is able
-    to unblock. Capped (``MXNET_TPU_FAULT_HANG_CAP``, default 30 s) so a
-    broken watchdog fails the test instead of hanging the suite."""
-    if not _ACTIVE:
-        return
-    fault = _ACTIVE.get(point)
-    if fault is None or not fault.should_fire():
-        return
+def _hang_until_interrupted(point):
+    """The injected-hang body: spin in short interruptible sleeps so an
+    asynchronous StallError can land between bytecodes. Capped
+    (``MXNET_TPU_FAULT_HANG_CAP``, default 30 s) so a broken watchdog
+    fails the test instead of hanging the suite."""
     cap = float(os.environ.get("MXNET_TPU_FAULT_HANG_CAP", "30"))
     deadline = time.monotonic() + cap
     while time.monotonic() < deadline:
@@ -309,6 +333,20 @@ def maybe_hang(point):
     raise FaultInjected(
         f"injected hang at {point} ran its full {cap:.0f}s cap without "
         "being interrupted — is the watchdog armed for this phase?")
+
+
+def maybe_hang(point):
+    """Wedge the calling thread at ``point`` (``hang_step`` /
+    ``hang_collective`` / ``hang_batch``): spin in short interruptible
+    sleeps so the watchdog's asynchronous StallError can land between
+    bytecodes — exactly the Python-level-hang class the watchdog is able
+    to unblock."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get(point)
+    if fault is None or not fault.should_fire():
+        return
+    _hang_until_interrupted(point)
 
 
 def maybe_oom_step():
@@ -323,6 +361,60 @@ def maybe_oom_step():
         raise InjectedOOM(
             "RESOURCE_EXHAUSTED: out of memory while running the training "
             "step [injected fault]")
+
+
+# Serving-fleet replica faults: each hook is replica-addressed — the
+# fault only fires on the replica named by MXNET_TPU_FAULT_REPLICA
+# (default 0), checked BEFORE the fire window is consumed, so arming
+# ``times=N`` means N faults on the victim, never N silently burnt on
+# whichever replica happened to call first.
+
+def _fault_replica_target():
+    return int(os.environ.get("MXNET_TPU_FAULT_REPLICA", "0"))
+
+
+def maybe_replica_crash(replica_id):
+    """Raise :class:`ReplicaCrash` inside the victim replica's serving
+    path (kind ``replica_crash``). Hooked into the fleet's per-replica
+    predictor wrapper, so thread replicas fail the in-flight batch and
+    subprocess workers turn it into a real process exit."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get("replica_crash")
+    if fault is None or int(replica_id) != _fault_replica_target():
+        return
+    if fault.should_fire():
+        raise ReplicaCrash(
+            f"injected crash of serving replica {replica_id}")
+
+
+def maybe_replica_hang(replica_id):
+    """Wedge the victim replica's batch execution (kind
+    ``replica_hang``) in an interruptible sleep — detected by the batch
+    watchdog (StallError fails the batch), by router per-request
+    deadlines, and by the supervisor's health probe."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get("replica_hang")
+    if fault is None or int(replica_id) != _fault_replica_target():
+        return
+    if fault.should_fire():
+        _hang_until_interrupted("replica_hang")
+
+
+def maybe_replica_nan_storm(replica_id, feeds):
+    """Poison the victim replica's inference batch with NaN (kind
+    ``replica_nan_storm``). Unlike ``nan_serving`` (one poisoned batch
+    anywhere) this is replica-addressed and typically armed with
+    ``times=N``: a sustained storm on one replica, driving the router's
+    consecutive-failure circuit breaker open while other replicas keep
+    serving clean results."""
+    if not _ACTIVE:
+        return feeds
+    fault = _ACTIVE.get("replica_nan_storm")
+    if fault is None or int(replica_id) != _fault_replica_target():
+        return feeds
+    return _poison_first_float(fault, feeds, "replica_nan_storm")
 
 
 def maybe_peer_death():

@@ -24,6 +24,9 @@ Phases and their deadline env knobs (seconds; unset or ``0`` disables):
   (``kvstore='tpu'`` push, ``kvstore/dist.py`` allreduce/barrier/init)
 - ``batch``      — ``MXNET_TPU_WATCHDOG_BATCH_TIMEOUT``
   (``serving.BatchServer`` batch execution and ``close()`` drain)
+- ``probe``      — ``MXNET_TPU_WATCHDOG_PROBE_TIMEOUT``
+  (``serving.fleet`` replica health probes; falls back to the batch
+  deadline when unset — a probe is one tiny batch)
 
 Collectives additionally keep **peer-liveness bookkeeping**: a rank
 marked dead (``mark_peer_dead``, or the ``peer_death`` fault) makes the
@@ -64,7 +67,7 @@ __all__ = ["StallError", "PeerLostError", "guard", "collective_guard",
            "note_rollback", "note_peer_recovery", "mark_peer_dead",
            "dead_peers", "reset_peers", "stats", "reset_stats", "PHASES"]
 
-PHASES = ("step", "collective", "batch")
+PHASES = ("step", "collective", "batch", "probe")
 
 _STATS = {
     "watchdog_guards": 0,         # scopes armed (a timeout was configured)
@@ -144,11 +147,17 @@ def dead_peers():
         return sorted(_DEAD_PEERS)
 
 
-def reset_peers():
+def reset_peers(ranks=None):
     """Forget dead-peer bookkeeping (tests; or after an elastic restart
-    re-admits the rank)."""
+    re-admits the rank). With ``ranks`` given, only those ranks are
+    cleared — re-admitting one recovered serving replica must not also
+    silently re-admit a rank that is still dead."""
     with _PEER_LOCK:
-        _DEAD_PEERS.clear()
+        if ranks is None:
+            _DEAD_PEERS.clear()
+        else:
+            for r in ranks:
+                _DEAD_PEERS.discard(int(r))
 
 
 def _peer_lost_error(ranks, detail, stalled=None):

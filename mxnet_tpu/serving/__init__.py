@@ -16,6 +16,13 @@ the compile-once/replay world. Two layers (docs/serving.md):
   nearest declared bucket and unpad per request, per-request deadlines
   shed late work, and a poisoned batch trips the HealthSentinel policy
   instead of wedging the queue.
+- :class:`Fleet` (``serving/fleet.py``) — the self-healing multi-replica
+  layer: a :class:`ReplicaSupervisor` owning N Predictor/BatchServer
+  replicas (threads, or subprocesses for true crash isolation) with
+  health probes and drain → restart → re-admit transitions, behind a
+  :class:`Router` that load-balances by outstanding work, retries
+  failures on a different replica with capped jittered backoff,
+  optionally hedges tail requests, and circuit-breaks bad replicas.
 
 All counters below surface through ``profiler.dispatch_stats()`` /
 ``profiler.dumps()`` next to the PR 1 dispatch counters.
@@ -23,6 +30,7 @@ All counters below surface through ``profiler.dispatch_stats()`` /
 from __future__ import annotations
 
 import threading as _threading
+import weakref as _weakref
 from collections import deque as _deque
 
 # Counters are defined BEFORE the submodule imports at the bottom so
@@ -44,6 +52,19 @@ _STATS = {
     "serving_poisoned_batches": 0, # batches the health check rejected
     "serving_stalled_batches": 0,  # batches the watchdog timed out
     "serving_queue_peak": 0,       # high-water mark of queued requests
+    # Fleet (serving/fleet.py: Router + ReplicaSupervisor)
+    "fleet_requests": 0,           # requests admitted by the router
+    "fleet_retries": 0,            # attempts re-routed to another replica
+    "fleet_hedges": 0,             # duplicate tail-latency attempts sent
+    "fleet_hedge_wins": 0,         # requests a hedge attempt answered first
+    "fleet_breaker_opens": 0,      # circuit breakers tripped open
+    "fleet_half_open_probes": 0,   # re-admission trials through a breaker
+    "fleet_probe_failures": 0,     # supervisor health probes that failed
+    "fleet_replica_failures": 0,   # attempt failures charged to a replica
+    "fleet_restarts": 0,           # replica rebuilds (DEAD -> RESTARTING)
+    "fleet_drains": 0,             # replicas drained out of rotation
+    "fleet_shed_overloaded": 0,    # requests shed with FleetOverloaded
+    "fleet_deadline_exceeded": 0,  # router-side deadline expiries
 }
 
 _LAT_LOCK = _threading.Lock()
@@ -62,15 +83,42 @@ def _percentile_us(sorted_lat, q):
     return int(sorted_lat[idx] * 1e6)
 
 
+# Live fleets, for stats()/reset_stats() aggregation: per-replica request
+# latency lives on the replica objects (they come and go with restarts),
+# so the module keeps weak references to the Fleet fronts and pulls.
+_FLEETS_LOCK = _threading.Lock()
+_FLEETS = _weakref.WeakSet()
+
+
+def _register_fleet(fleet):
+    with _FLEETS_LOCK:
+        _FLEETS.add(fleet)
+
+
+def _live_fleets():
+    with _FLEETS_LOCK:
+        return list(_FLEETS)
+
+
 def stats():
     """All serving counters as one flat dict (merged into
     ``profiler.dispatch_stats()``), including request-latency percentiles
-    over a sliding window of the last 8192 completed requests."""
+    over a sliding window of the last 8192 completed requests and, for
+    live fleets, fleet-level p50/p99 plus a per-replica latency summary
+    string (``model/rid p50=..us p99=..us n=..``)."""
     out = dict(_STATS)
     with _LAT_LOCK:
         lat = sorted(_LATENCIES)
     out["serving_p50_latency_us"] = _percentile_us(lat, 0.50)
     out["serving_p99_latency_us"] = _percentile_us(lat, 0.99)
+    fleet_lat = []
+    summaries = []
+    for f in _live_fleets():
+        f._collect_latencies(fleet_lat, summaries)
+    fleet_lat.sort()
+    out["fleet_p50_latency_us"] = _percentile_us(fleet_lat, 0.50)
+    out["fleet_p99_latency_us"] = _percentile_us(fleet_lat, 0.99)
+    out["fleet_replica_latency_us"] = "; ".join(summaries)
     return out
 
 
@@ -79,11 +127,17 @@ def reset_stats():
         _STATS[k] = 0
     with _LAT_LOCK:
         _LATENCIES.clear()
+    for f in _live_fleets():
+        f._reset_latencies()
 
 
 from .predictor import Predictor  # noqa: E402
 from .batcher import (BatchServer, DeadlineExceeded, ServerClosed,  # noqa: E402
                       ServerOverloaded)
+from .fleet import (Fleet, FleetClosed, FleetOverloaded,  # noqa: E402
+                    ReplicaSupervisor, Router)
 
 __all__ = ["Predictor", "BatchServer", "DeadlineExceeded", "ServerClosed",
-           "ServerOverloaded", "stats", "reset_stats", "record_latency"]
+           "ServerOverloaded", "Fleet", "FleetClosed", "FleetOverloaded",
+           "ReplicaSupervisor", "Router", "stats", "reset_stats",
+           "record_latency"]

@@ -190,16 +190,27 @@ class BatchServer:
                     f"queue depth {len(self._queue)} at high-water "
                     f"mark {self.max_queue_depth}"))
                 return fut
-        feeds, rows, sig = self._coerce(data)
-        if rows < 1 or rows > self.max_batch_size:
-            raise MXNetError(f"request rows must be 1..{self.max_batch_size}"
-                             f", got {rows}")
+        # fail-fast on an already-spent deadline budget, BEFORE the host
+        # snapshot and before taking a queue slot: a router retry (or any
+        # caller) passing its remaining budget must get DeadlineExceeded
+        # immediately, not occupy the queue just to be pruned later
         if deadline_ms is not None:
+            if deadline_ms <= 0:
+                _STATS["serving_shed_deadline"] += 1
+                fut = Future()
+                fut.set_exception(DeadlineExceeded(
+                    f"deadline budget ({deadline_ms:.3g}ms) already spent "
+                    "at admission"))
+                return fut
             deadline = time.perf_counter() + deadline_ms / 1e3
         elif self.default_deadline_s is not None:
             deadline = time.perf_counter() + self.default_deadline_s
         else:
             deadline = None
+        feeds, rows, sig = self._coerce(data)
+        if rows < 1 or rows > self.max_batch_size:
+            raise MXNetError(f"request rows must be 1..{self.max_batch_size}"
+                             f", got {rows}")
         req = _Request(feeds, rows, sig, deadline)
         with self._cond:
             if self._closed:
@@ -225,6 +236,14 @@ class BatchServer:
     def queue_depth(self):
         with self._cond:
             return len(self._queue)
+
+    @property
+    def outstanding(self):
+        """Queued + in-flight request count — the fleet router's
+        load-balancing signal (outstanding work, not queue depth alone:
+        a replica mid-batch is busier than its empty queue suggests)."""
+        with self._cond:
+            return len(self._queue) + len(self._inflight)
 
     # ------------------------------------------------------------------ worker
     def _prune_expired(self):
@@ -286,6 +305,29 @@ class BatchServer:
                 self._cond.wait(max(0.0, t_wake - now))
 
     def _serve_loop(self):
+        """Worker-thread entry: the serve loop plus last-line-of-defense
+        cleanup. If the loop ever dies with an unhandled error —
+        including BaseExceptions like an injected SimulatedCrash, which
+        the per-batch ``except Exception`` deliberately does not absorb
+        — every admitted future is failed with ServerClosed before the
+        thread exits. A dead worker must never leave futures pending
+        forever; close() additionally re-checks for leftovers."""
+        try:
+            self._serve()
+        except BaseException as e:
+            with self._cond:
+                self._closed = True
+                leftovers = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+                self._inflight = ()
+                self._cond.notify_all()
+            err = ServerClosed(
+                f"BatchServer worker died: {type(e).__name__}: {e}")
+            for r in leftovers:
+                _try_resolve(r.future, exc=err)
+            raise
+
+    def _serve(self):
         while True:
             batch = self._take_batch()
             if batch is None:
@@ -364,6 +406,17 @@ class BatchServer:
                 _STATS["serving_stalled_batches"] += 1
             for r in batch:
                 _try_resolve(r.future, exc=e)
+        except BaseException as e:
+            # the worker thread itself is dying (injected SimulatedCrash,
+            # MemoryError escalation, interpreter teardown) — this
+            # batch's futures must resolve BEFORE the unwind clears
+            # _inflight, or they leak; _serve_loop fails the queued rest
+            err = ServerClosed(
+                f"BatchServer worker died mid-batch: "
+                f"{type(e).__name__}: {e}")
+            for r in batch:
+                _try_resolve(r.future, exc=err)
+            raise
         finally:
             with self._cond:
                 self._inflight = ()
@@ -398,17 +451,24 @@ class BatchServer:
                 batches = -(-pending_rows // self.max_batch_size) + inflight
                 timeout = per_batch * max(1, batches) + 1.0
         self._worker.join(timeout)
-        if not self._worker.is_alive():
-            return
-        # drain blew its deadline: stop draining, fail whatever is left
+        # fail whatever is left — whether the drain blew its deadline or
+        # the worker died mid-drain, admitted futures must not leak
         with self._cond:
-            self._drain = False
             leftovers = list(self._queue) + list(self._inflight)
-            self._queue.clear()
-            self._cond.notify_all()
-        err = ServerClosed(
-            "BatchServer drain exceeded its shutdown deadline "
-            f"({timeout:.3g}s); request abandoned at close")
+            if leftovers:
+                self._drain = False
+                self._queue.clear()
+                self._cond.notify_all()
+        if not leftovers:
+            return
+        if self._worker.is_alive():
+            err = ServerClosed(
+                "BatchServer drain exceeded its shutdown deadline "
+                f"({timeout:.3g}s); request abandoned at close")
+        else:
+            err = ServerClosed(
+                "BatchServer worker died before draining; request "
+                "abandoned at close")
         for r in leftovers:
             _try_resolve(r.future, exc=err)
         self._worker.join(0.1)

@@ -125,10 +125,20 @@ class Predictor:
         self._pending = {}         # MXPredSetInput state
         self._outputs = None
         self.warmup_ms = 0.0
+        self.warmup_cache_hits = 0
         if warmup and self._input_tails is not None:
+            from .. import capture as _capture
+
+            before = _capture.stats().get("aot_cache_hits", 0)
             t0 = time.perf_counter()
             self.warmup()
             self.warmup_ms = (time.perf_counter() - t0) * 1e3
+            # how many bucket executables this warmup deserialized from
+            # the persistent AOT cache instead of compiling — the fleet
+            # supervisor's evidence that a restarted replica warm-started
+            # (approximate under concurrent warmups: global counter delta)
+            self.warmup_cache_hits = (
+                _capture.stats().get("aot_cache_hits", 0) - before)
 
     # ------------------------------------------------------------ construction
     @classmethod

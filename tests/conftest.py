@@ -55,3 +55,7 @@ def pytest_configure(config):
         "markers",
         "capture: whole-program step capture + AOT compile cache "
         "(mxnet_tpu/capture.py, docs/capture.md); runs in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "fleet: self-healing serving fleet (mxnet_tpu/serving/fleet.py, "
+        "docs/serving.md); runs in tier-1")

@@ -1,0 +1,587 @@
+"""Self-healing serving fleet (ISSUE 8): supervised replicas, router
+retry/backoff/hedging, circuit breakers, drain & re-admit.
+
+Covers the acceptance surface: the replica state machine walks
+HEALTHY -> DRAINING -> DEAD -> RESTARTING -> WARMING -> HEALTHY, the
+router load-balances by outstanding work and retries failures on a
+DIFFERENT replica with the remaining deadline budget (never an expired
+request), hedged tail requests race with first-response-wins, K
+consecutive failures open a breaker and re-admission goes through a
+half-open probe, all-breakers-open degrades to structured
+FleetOverloaded, zero futures are ever lost under a concurrent
+kill-hammer, the kvstore excise_dead_peers hook is wired into
+membership transitions, restarts warm-start from the AOT compile cache,
+and subprocess replicas survive a real process kill.
+"""
+import os
+import threading
+import time
+from concurrent import futures as _futures
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.resilience import faults, watchdog
+from mxnet_tpu.serving import fleet as fleet_mod
+from mxnet_tpu.serving.batcher import DeadlineExceeded, ServerClosed
+
+pytestmark = pytest.mark.fleet
+
+IN_UNITS = 3
+X1 = np.ones((1, IN_UNITS), np.float32)
+
+
+def _factory(seed=7, prefix="fleet_t_"):
+    def make():
+        mx.random.seed(seed)
+        net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix=prefix)
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(4,),
+            warmup=False)
+    return make
+
+
+def _reference(seed=7):
+    return _factory(seed)().predict(X1)[0].asnumpy()
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.reset()
+    watchdog.reset_peers()
+    serving.reset_stats()
+    monkeypatch.setenv("MXNET_TPU_FAULT_HANG_CAP", "1")
+    monkeypatch.delenv("MXNET_TPU_COMPILE_CACHE", raising=False)
+    yield
+    faults.reset()
+    watchdog.reset_peers()
+
+
+def _fleet(replicas=2, **kw):
+    kw.setdefault("probe_interval_ms", 50)
+    kw.setdefault("breaker_k", 2)
+    kw.setdefault("breaker_cooldown_ms", 100)
+    kw.setdefault("retries", 2)
+    kw.setdefault("backoff_ms", 1)
+    kw.setdefault("server_kw", {"batch_timeout_ms": 1.0})
+    factories = kw.pop("factories", _factory())
+    return serving.Fleet(factories, replicas=replicas, **kw)
+
+
+# ---------------------------------------------------------------- basics
+
+
+def test_submit_matches_single_predictor():
+    ref = _reference()
+    with _fleet(replicas=2) as fleet:
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert np.array_equal(out[0], ref)
+        assert fleet.replica_states() == ["HEALTHY", "HEALTHY"]
+
+
+def test_unknown_model_is_an_error():
+    with _fleet(replicas=1) as fleet:
+        with pytest.raises(mx.base.MXNetError, match="serves models"):
+            fleet.submit(X1, model="nope")
+
+
+def test_per_model_groups_and_routing():
+    ref_a, ref_b = _reference(seed=1), _reference(seed=2)
+    with _fleet(replicas=1, factories={"a": _factory(seed=1),
+                                       "b": _factory(seed=2)}) as fleet:
+        assert fleet.models() == ["a", "b"]
+        out_a = fleet.submit(X1, model="a", deadline_ms=10000).result(15)
+        out_b = fleet.submit(X1, model="b", deadline_ms=10000).result(15)
+        assert np.array_equal(out_a[0], ref_a)
+        assert np.array_equal(out_b[0], ref_b)
+
+
+def test_load_balances_across_replicas():
+    """Concurrent traffic lands on BOTH replicas (least-outstanding
+    selection), visible in the per-replica latency summaries."""
+    with _fleet(replicas=2) as fleet:
+        fs = [fleet.submit(X1, deadline_ms=20000) for _ in range(24)]
+        for f in fs:
+            f.result(timeout=20)
+        counts = [len(r.latency_snapshot()) for r in fleet.replicas()]
+    assert sum(counts) == 24
+    assert all(c > 0 for c in counts), counts
+    summary = serving.stats()["fleet_replica_latency_us"]
+    assert "default/0" in summary and "default/1" in summary
+
+
+# ---------------------------------------------------- retries + deadlines
+
+
+def test_retry_lands_on_a_different_replica():
+    ref = _reference()
+    with _fleet(replicas=2, breaker_k=5) as fleet:
+        with faults.inject("replica_crash", times=1) as f:
+            out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert f.fired == 1
+        assert np.array_equal(out[0], ref)
+    s = serving.stats()
+    assert s["fleet_retries"] >= 1
+    assert s["fleet_replica_failures"] >= 1
+    # the win was recorded on the SURVIVOR, not the victim
+    victim_rid = int(os.environ.get("MXNET_TPU_FAULT_REPLICA", "0"))
+    assert f"default/{1 - victim_rid}" in s["fleet_replica_latency_us"]
+
+
+def test_admission_fail_fast_on_spent_budget():
+    with _fleet(replicas=1) as fleet:
+        fut = fleet.submit(X1, deadline_ms=0)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=1)
+        fut = fleet.submit(X1, deadline_ms=-5.0)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=1)
+    assert serving.stats()["fleet_deadline_exceeded"] >= 2
+
+
+def test_expired_request_is_never_retried():
+    """A sole replica that keeps crashing + a short deadline: the future
+    resolves with a structured error within ~the deadline, and no retry
+    fires after expiry (the retry budget was NOT exhausted — expiry cut
+    it off)."""
+    with _fleet(replicas=1, breaker_k=50, retries=50,
+                backoff_ms=200, backoff_cap_ms=200) as fleet:
+        fleet.submit(X1, deadline_ms=10000).result(timeout=15)  # warm
+        with faults.inject("replica_crash", times=None):
+            t0 = time.monotonic()
+            fut = fleet.submit(X1, deadline_ms=250)
+            # structured resolution: expiry, the crash itself, or an
+            # overloaded shed once the supervisor pulls the victim
+            with pytest.raises((DeadlineExceeded, faults.ReplicaCrash,
+                                serving.FleetOverloaded)):
+                fut.result(timeout=10)
+            elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, elapsed
+        retries_at_resolve = serving.stats()["fleet_retries"]
+        time.sleep(0.5)  # any stray scheduled retry would fire here
+        assert serving.stats()["fleet_retries"] == retries_at_resolve
+        assert serving.stats()["fleet_retries"] <= 2
+
+
+def test_backoff_is_capped_and_jittered():
+    rng = fleet_mod._jitter
+    rng.seed(1234)
+    delays = [fleet_mod._backoff_delay(0.1, 1.0, a) for a in range(1, 9)]
+    for attempt, d in enumerate(delays, start=1):
+        ceiling = min(0.1 * 2 ** (attempt - 1), 1.0)
+        assert ceiling / 2 - 1e-9 <= d <= ceiling + 1e-9
+    # capped: late attempts never exceed the ceiling
+    assert max(delays) <= 1.0 + 1e-9
+    # jittered: not the lockstep powers of two
+    assert delays[:3] != [0.1, 0.2, 0.4]
+
+
+# -------------------------------------------------------------- hedging
+
+
+def test_hedge_first_response_wins():
+    """Replica 0 hangs; the hedge fires after hedge_ms onto replica 1
+    and answers way before the 1s hang cap releases the victim."""
+    ref = _reference()
+    with _fleet(replicas=2, hedge_ms=25.0, breaker_k=50,
+                probe_interval_ms=2000) as fleet:
+        # warm both replicas off the clock (lazy first-compile)
+        for _ in range(4):
+            fleet.submit(X1, deadline_ms=20000).result(timeout=20)
+        serving.reset_stats()
+        with faults.inject("replica_hang", times=1):
+            # pin the request onto the victim: occupy replica 1 so
+            # least-outstanding picks rid 0 first
+            t0 = time.monotonic()
+            out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+            elapsed = time.monotonic() - t0
+        assert np.array_equal(out[0], ref)
+    s = serving.stats()
+    # either the primary landed on the healthy replica (no hedge needed)
+    # or the hedge won; with the victim targeted the hang costs >= 1s,
+    # so a fast answer proves the hedge raced past it
+    if elapsed < 0.9:
+        assert s["fleet_hedges"] >= 0  # fast path: primary on healthy rid
+    else:
+        assert s["fleet_hedges"] >= 1 and s["fleet_hedge_wins"] >= 1
+
+
+def test_hedge_counts_when_primary_is_wedged():
+    """Deterministic hedge: single request, victim rid 0 chosen first
+    (ties break by rid), hang holds it past the hedge delay."""
+    with _fleet(replicas=2, hedge_ms=20.0, breaker_k=50,
+                probe_interval_ms=2000) as fleet:
+        for _ in range(4):
+            fleet.submit(X1, deadline_ms=20000).result(timeout=20)
+        serving.reset_stats()
+        with faults.inject("replica_hang", times=1):
+            out = fleet.submit(X1, deadline_ms=10000)
+            res = out.result(timeout=15)
+        assert res is not None
+    s = serving.stats()
+    assert s["fleet_hedges"] >= 1
+    assert s["fleet_hedge_wins"] >= 1
+
+
+# ----------------------------------------------- breaker + state machine
+
+
+def test_breaker_opens_drains_restarts_readmits():
+    ref = _reference()
+    with _fleet(replicas=2) as fleet:
+        victim = fleet.replicas()[0]
+        with faults.inject("replica_crash", times=4) as f:
+            outs = [fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+                    for _ in range(4)]
+        assert all(np.array_equal(o[0], ref) for o in outs)
+        assert f.fired >= 2
+        assert fleet.wait_healthy(timeout=20)
+        seq = [(frm, to) for _, frm, to, _ in victim.transitions]
+        # the full machine, in order, after the initial build
+        for edge in [("HEALTHY", "DRAINING"), ("DRAINING", "DEAD"),
+                     ("DEAD", "RESTARTING"), ("RESTARTING", "WARMING"),
+                     ("WARMING", "HEALTHY")]:
+            assert edge in seq, (edge, seq)
+        assert seq.index(("HEALTHY", "DRAINING")) \
+            < seq.index(("WARMING", "HEALTHY"))
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert np.array_equal(out[0], ref)
+    s = serving.stats()
+    assert s["fleet_breaker_opens"] >= 1
+    assert s["fleet_drains"] >= 1
+    assert s["fleet_restarts"] >= 1
+    assert s["fleet_half_open_probes"] >= 1
+
+
+def test_all_breakers_open_sheds_structured_then_recovers():
+    ref = _reference()
+    with _fleet(replicas=1, breaker_k=1, retries=1,
+                breaker_cooldown_ms=5000) as fleet:
+        fleet.submit(X1, deadline_ms=10000).result(timeout=15)  # warm
+        with faults.inject("replica_crash", times=2):
+            with pytest.raises((serving.FleetOverloaded,
+                                faults.ReplicaCrash)):
+                fleet.submit(X1, deadline_ms=5000).result(timeout=10)
+            with pytest.raises(serving.FleetOverloaded) as ei:
+                fleet.submit(X1, deadline_ms=5000).result(timeout=10)
+        err = ei.value
+        assert err.model == "default"
+        assert err.total == 1
+        assert err.open_breakers + err.unhealthy >= 1
+        # the supervisor recycles the victim; once the fault is disarmed
+        # its half-open probe passes and service resumes
+        assert fleet.wait_healthy(timeout=20)
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert np.array_equal(out[0], ref)
+    assert serving.stats()["fleet_shed_overloaded"] >= 1
+
+
+def test_nan_storm_isolated_to_victim_and_recycled():
+    ref = _reference()
+    with _fleet(replicas=2) as fleet:
+        with faults.inject("replica_nan_storm", times=4) as f:
+            outs = [fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+                    for _ in range(4)]
+        assert all(np.array_equal(o[0], ref) for o in outs)
+        assert f.fired >= 2
+        assert fleet.wait_healthy(timeout=20)
+    s = serving.stats()
+    assert s["serving_poisoned_batches"] >= 2
+    assert s["fleet_restarts"] >= 1
+
+
+def test_probe_failure_restarts_a_hung_replica():
+    """No request traffic at all: the supervisor's own probes find the
+    wedged replica and recycle it."""
+    with _fleet(replicas=2, probe_interval_ms=40, breaker_k=50) as fleet:
+        fleet.submit(X1, deadline_ms=10000).result(timeout=15)  # lazy warm
+        with faults.inject("replica_hang", times=2):
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and \
+                    serving.stats()["fleet_restarts"] < 1:
+                time.sleep(0.05)
+        assert serving.stats()["fleet_probe_failures"] >= 1
+        assert serving.stats()["fleet_restarts"] >= 1
+        assert fleet.wait_healthy(timeout=20)
+
+
+def test_persistent_warm_failure_rebuilds_with_backoff():
+    """Review fix: a rebuilt replica whose warm probes keep failing must
+    go back through DEAD and rebuild (bounded strikes), not spin in
+    WARMING forever — and recover once the fault clears."""
+    with _fleet(replicas=2, probe_interval_ms=40) as fleet:
+        fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        victim = fleet.replicas()[0]
+        with faults.inject("replica_nan_storm", times=None):
+            fleet.fail_replica(victim.rid, reason="warm-fail test")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and \
+                    serving.stats()["fleet_restarts"] < 2:
+                time.sleep(0.05)
+            assert serving.stats()["fleet_restarts"] >= 2
+            seq = [(frm, to) for _, frm, to, _ in victim.transitions]
+            assert seq.count(("DEAD", "RESTARTING")) >= 2
+            # mid-machine replicas are owned by their restart thread: a
+            # second fail_replica must NOT start a concurrent restart
+            if victim.state != "HEALTHY":
+                assert fleet.fail_replica(victim.rid) is False
+        assert fleet.wait_healthy(timeout=20)
+
+
+def test_factory_failure_tears_down_built_replicas():
+    """Review fix: when replica 2's factory raises mid-start, replica
+    1's already-built worker must be torn down, not orphaned."""
+    good = _factory()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("factory boom")
+        return good()
+
+    before = {t.ident for t in threading.enumerate()}
+    with pytest.raises(RuntimeError, match="factory boom"):
+        serving.Fleet(flaky, replicas=2,
+                      server_kw={"batch_timeout_ms": 1.0})
+    time.sleep(0.3)
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.is_alive()
+              and t.name.startswith("mxnet-tpu-serving")]
+    assert not leaked, leaked
+
+
+def test_operator_fail_replica_walks_the_machine():
+    with _fleet(replicas=2) as fleet:
+        victim = fleet.replicas()[1]
+        assert fleet.fail_replica(victim.rid) is True
+        assert fleet.fail_replica(victim.rid) in (False, True)  # idempotent
+        assert fleet.wait_healthy(timeout=20)
+        assert victim.generation >= 2
+    s = serving.stats()
+    assert s["fleet_drains"] >= 1 and s["fleet_restarts"] >= 1
+
+
+# ----------------------------------------------------- zero lost futures
+
+
+def test_zero_lost_futures_under_kill_hammer():
+    """8 client threads, replicas killed mid-load twice over: every
+    admitted future resolves to a result or a structured error — no
+    lost futures, no wedged queues."""
+    ref = _reference()
+    results = {"ok": 0, "err": 0, "lost": 0, "bad": 0}
+    lock = threading.Lock()
+    with _fleet(replicas=4, breaker_k=2, retries=3) as fleet:
+        for _ in range(8):
+            fleet.submit(X1, deadline_ms=20000).result(timeout=20)  # warm
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                fut = fleet.submit(X1, deadline_ms=2000)
+                try:
+                    out = fut.result(timeout=10)
+                    with lock:
+                        if np.array_equal(out[0], ref):
+                            results["ok"] += 1
+                        else:
+                            results["bad"] += 1
+                except _futures.TimeoutError:
+                    with lock:
+                        results["lost"] += 1
+                except Exception:
+                    with lock:
+                        results["err"] += 1
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        # two kill waves against different replicas, mid-load
+        time.sleep(0.2)
+        fleet.fail_replica(0, reason="hammer")
+        time.sleep(0.2)
+        fleet.fail_replica(1, reason="hammer")
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert not any(t.is_alive() for t in threads)
+        assert fleet.wait_healthy(timeout=20)
+    assert results["lost"] == 0, results
+    assert results["bad"] == 0, results
+    assert results["ok"] > 0, results
+
+
+def test_close_resolves_outstanding_with_fleet_closed():
+    fleet = _fleet(replicas=1, breaker_k=50, probe_interval_ms=5000)
+    fleet.submit(X1, deadline_ms=10000).result(timeout=15)  # warm
+    with faults.inject("replica_hang", times=1):
+        fut = fleet.submit(X1)          # no deadline, wedged replica
+        time.sleep(0.05)
+        fleet.close()
+    with pytest.raises((serving.FleetClosed, ServerClosed,
+                        faults.FaultInjected, watchdog.StallError)):
+        fut.result(timeout=10)
+    # a closed fleet rejects new work, structurally
+    fut2 = fleet.submit(X1)
+    with pytest.raises((serving.FleetClosed, serving.FleetOverloaded)):
+        fut2.result(timeout=5)
+
+
+# ------------------------------------------------------- kvstore wiring
+
+
+def test_kvstore_membership_excise_wiring():
+    """Fleet membership rides the peer-liveness bookkeeping: a draining
+    replica's rid poisons the store's collectives (PeerLostError naming
+    it), and re-admission excises exactly that rank."""
+    kv = mx.kvstore.create("tpu")
+    kv.init(0, mx.nd.ones((4,)))
+    with _fleet(replicas=2, kvstore=kv) as fleet:
+        victim = fleet.replicas()[0]
+        assert fleet.fail_replica(victim.rid)
+        # dead immediately after the drain begins
+        assert victim.rid in watchdog.dead_peers()
+        with pytest.raises(watchdog.PeerLostError) as ei:
+            kv.push(0, mx.nd.ones((4,)))
+        assert victim.rid in ei.value.ranks
+        assert fleet.wait_healthy(timeout=20)
+        # re-admission excised the rank; the store serves again
+        assert victim.rid not in watchdog.dead_peers()
+        kv.push(0, mx.nd.ones((4,)))
+
+
+def test_excise_dead_peers_rank_scoped():
+    """The PR-5 re-admission hook, unit-tested so it can never silently
+    bit-rot again: rank-scoped excise clears ONLY the named ranks; the
+    legacy no-arg form clears everything."""
+    kv = mx.kvstore.create("tpu")
+    kv.init(1, mx.nd.ones((2,)))
+    watchdog.mark_peer_dead(1)
+    watchdog.mark_peer_dead(3)
+    assert kv.excise_dead_peers(ranks=[1]) == [1]
+    assert watchdog.dead_peers() == [3]
+    with pytest.raises(watchdog.PeerLostError):
+        kv.push(1, mx.nd.ones((2,)))
+    assert kv.excise_dead_peers(ranks=[7]) == []   # unknown rank: no-op
+    assert kv.excise_dead_peers() == [3]           # legacy form clears all
+    assert watchdog.dead_peers() == []
+    kv.push(1, mx.nd.ones((2,)))
+
+
+# ------------------------------------------------------- AOT warm start
+
+
+def test_restart_warm_starts_from_aot_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+
+    def factory():
+        mx.random.seed(7)
+        net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix="fleet_aot_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(4,))
+
+    with _fleet(replicas=1, factories=factory) as fleet:
+        ref = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        fleet.fail_replica(0, reason="warm-start test")
+        assert fleet.wait_healthy(timeout=30)
+        rebuilt = fleet.replicas()[0].predictor
+        assert rebuilt.warmup_cache_hits >= 1
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert np.array_equal(out[0], ref[0])
+
+
+# ----------------------------------------------------- process replicas
+
+
+def test_process_mode_survives_a_real_process_kill():
+    """True crash isolation: the replica's Predictor lives in a child
+    process; SIGKILLing it loses nothing — the request is retried on
+    the survivor and the victim is restarted and re-admitted."""
+    with _fleet(replicas=2, mode="process", probe_interval_ms=100,
+                breaker_k=3, probe_timeout=30.0,
+                factories=_process_factory) as fleet:
+        ref = fleet.submit(X1, deadline_ms=60000).result(timeout=60)
+        victim = fleet.replicas()[0]
+        gen = victim.generation
+        victim._proc.kill()
+        out = fleet.submit(X1, deadline_ms=60000).result(timeout=60)
+        assert np.array_equal(out[0], ref[0])
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                not (victim.generation > gen and victim.state == "HEALTHY"):
+            time.sleep(0.2)
+        assert victim.generation > gen
+        assert victim.state == "HEALTHY"
+    assert serving.stats()["fleet_restarts"] >= 1
+
+
+def _process_factory():
+    """Module-level (picklable) factory for spawn-mode replicas."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    mx.random.seed(7)
+    net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix="fleet_proc_")
+    net.initialize()
+    return serving.Predictor.from_block(
+        net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(4,),
+        warmup=False)
+
+
+def _nan_process_factory():
+    """A model whose every output is NaN — the process-replica sentinel
+    must catch it, not serve it."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    mx.random.seed(7)
+    net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix="fleet_nanp_")
+    net.initialize()
+    net.weight.set_data(net.weight.data() * np.nan)
+    return serving.Predictor.from_block(
+        net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(4,),
+        warmup=False)
+
+
+def test_process_mode_sentinel_catches_nan_outputs():
+    """Review fix: process replicas run the HealthSentinel in the child,
+    so NaN outputs come back as NumericHealthError (counted parent-side)
+    instead of being served as successes."""
+    from mxnet_tpu.resilience.sentinel import NumericHealthError
+
+    with _fleet(replicas=1, mode="process", probe_interval_ms=5000,
+                retries=0, probe_timeout=30.0,
+                factories=_nan_process_factory) as fleet:
+        fut = fleet.submit(X1, deadline_ms=60000)
+        with pytest.raises(NumericHealthError):
+            fut.result(timeout=60)
+    assert serving.stats()["serving_poisoned_batches"] >= 1
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_fleet_counters_reach_profiler():
+    from mxnet_tpu import profiler
+
+    with _fleet(replicas=1) as fleet:
+        fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        s = profiler.dispatch_stats()
+        assert s["fleet_requests"] >= 1
+        assert isinstance(s["fleet_replica_latency_us"], str)
+        assert "default/0" in s["fleet_replica_latency_us"]
+        assert s["fleet_p99_latency_us"] > 0
+        # the table renderer accepts the summary string
+        assert "fleet_replica_latency_us" in profiler.dumps()
+    profiler.reset_dispatch_stats()
+    s = profiler.dispatch_stats()
+    assert s["fleet_requests"] == 0
+    assert s["fleet_p99_latency_us"] == 0

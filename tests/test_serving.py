@@ -11,6 +11,7 @@ HealthSentinel policy without wedging the queue.
 import os
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 import pytest
@@ -426,6 +427,118 @@ def test_close_without_drain_fails_pending():
     # the worker may already have started the first batch; everything
     # still queued must be failed, nothing may hang
     assert failed >= 1
+
+
+def test_submit_fail_fast_on_spent_deadline():
+    """An already-spent deadline budget (<= 0) fails fast at admission —
+    never a queue slot, never a host snapshot of the batch (ISSUE 8:
+    router retries pass the REMAINING budget, which may be gone)."""
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=False)
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=1000.0) as srv:
+        for spent in (0, -3.5):
+            fut = srv.submit(np.zeros((1, 20), np.float32),
+                             deadline_ms=spent)
+            with pytest.raises(serving.DeadlineExceeded):
+                fut.result(timeout=1)
+        assert srv.queue_depth == 0
+        from mxnet_tpu import profiler
+
+        assert profiler.dispatch_stats()["serving_shed_deadline"] >= 2
+
+
+def test_close_vs_concurrent_submit_race_no_lost_futures():
+    """ISSUE 8 satellite: 8 threads hammer submit() while close(drain=True)
+    lands mid-stream. Every future the server RETURNED must resolve —
+    result, DeadlineExceeded, ServerOverloaded or ServerClosed — and a
+    raised ServerClosed at submit is the only other legal outcome. Zero
+    forever-pending futures."""
+    pred = _mlp_predictor(batch_sizes=(8,), warmup=True)
+    srv = serving.BatchServer(pred, max_batch_size=8, batch_timeout_ms=1.0,
+                              max_queue_depth=16)
+    x = np.random.RandomState(3).rand(1, 20).astype(np.float32)
+    futs = []
+    rejected = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    barrier = threading.Barrier(9)
+
+    def hammer():
+        barrier.wait()
+        while not stop.is_set():
+            try:
+                f = srv.submit(x, deadline_ms=500.0)
+                with lock:
+                    futs.append(f)
+            except serving.ServerClosed:
+                with lock:
+                    rejected.append(1)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.05)          # mid-stream
+    srv.close(drain=True)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    outcomes = {"ok": 0, "shed": 0, "lost": 0}
+    for f in futs:
+        try:
+            f.result(timeout=5)
+            outcomes["ok"] += 1
+        except (serving.DeadlineExceeded, serving.ServerOverloaded,
+                serving.ServerClosed):
+            outcomes["shed"] += 1
+        except FuturesTimeout:
+            outcomes["lost"] += 1
+    assert outcomes["lost"] == 0, (outcomes, len(futs))
+    assert outcomes["ok"] >= 1   # the drain actually served work
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_dead_worker_resolves_all_futures():
+    """Shed-under-drain with a DEAD worker: an injected SimulatedCrash
+    kills the serve loop mid-batch (BaseException — deliberately not
+    absorbed per batch). The dying worker must fail its in-flight AND
+    queued futures with ServerClosed, and close() must return without
+    hanging."""
+    pred = _mlp_predictor(batch_sizes=(4,), warmup=True)
+    srv = serving.BatchServer(pred, max_batch_size=4,
+                              batch_timeout_ms=5000.0)
+    x = np.random.RandomState(4).rand(1, 20).astype(np.float32)
+
+    calls = {"n": 0}
+    real = pred.predict_raw
+
+    def dying(feeds):
+        calls["n"] += 1
+        raise faults.SimulatedCrash("injected worker death")
+
+    pred.predict_raw = dying
+    try:
+        futs, refused = [], 0
+        for _ in range(6):
+            try:
+                futs.append(srv.submit(x, deadline_ms=30000.0))
+            except serving.ServerClosed:
+                refused += 1       # the worker died before this submit
+        assert futs                # at least the first batch was admitted
+        for f in futs:
+            with pytest.raises(serving.ServerClosed):
+                f.result(timeout=10)
+        assert len(futs) + refused == 6
+        assert calls["n"] == 1     # one batch died; nothing re-entered
+        # intake is closed by the dying worker
+        with pytest.raises(serving.ServerClosed):
+            srv.submit(x)
+    finally:
+        pred.predict_raw = real
+    srv.close(timeout=2.0)         # returns promptly, no leftover hang
 
 
 def test_request_validation():

@@ -545,6 +545,21 @@ RESILIENCE_KEYS = frozenset({
 })
 
 
+FLEET_KEYS = frozenset({
+    # router
+    "fleet_requests", "fleet_retries", "fleet_hedges", "fleet_hedge_wins",
+    "fleet_shed_overloaded", "fleet_deadline_exceeded",
+    # breaker
+    "fleet_breaker_opens", "fleet_half_open_probes",
+    # supervisor
+    "fleet_probe_failures", "fleet_replica_failures", "fleet_restarts",
+    "fleet_drains",
+    # latency (fleet-level ints + the per-replica summary string)
+    "fleet_p50_latency_us", "fleet_p99_latency_us",
+    "fleet_replica_latency_us",
+})
+
+
 def test_dispatch_stats_key_stability():
     """One profiler.dispatch_stats() call reports every resilience
     event; the key set is a stable API for dashboards."""
@@ -552,6 +567,11 @@ def test_dispatch_stats_key_stability():
     missing = RESILIENCE_KEYS - set(s)
     assert not missing, f"missing resilience counters: {sorted(missing)}"
     assert "serving_stalled_batches" in s
+    missing_fleet = FLEET_KEYS - set(s)
+    assert not missing_fleet, f"missing fleet counters: {sorted(missing_fleet)}"
+    for k in FLEET_KEYS - {"fleet_replica_latency_us"}:
+        assert isinstance(s[k], int), k
+    assert isinstance(s["fleet_replica_latency_us"], str)
     from mxnet_tpu import resilience
 
     assert set(resilience.stats()) | {"dataloader_respawns"} \

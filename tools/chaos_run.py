@@ -55,7 +55,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "ckpt_crash_before_manifest", "ckpt_async_crash",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
               "peer_death_recover", "oom_step", "dist_connect_timeout",
-              "capture_step")
+              "capture_step", "replica_crash", "replica_hang",
+              "replica_nan_storm")
 
 
 def _mx():
@@ -410,6 +411,68 @@ def _drill_capture_step(mx, workdir):
     return ok, f"gated={gated} rolled_back={rolled} elapsed={elapsed:.2f}s"
 
 
+def _drill_replica_fault(mx, workdir, kind):
+    """The ISSUE-8 chaos gate, in miniature: a 2-replica fleet under a
+    stream of deadlined requests while one replica is killed / hung /
+    NaN-poisoned mid-stream. Zero admitted requests may be lost (every
+    future resolves, and with retries every one of them to a CORRECT
+    result), the victim must be auto-restarted — warm from the AOT
+    compile cache — and re-admitted through a half-open breaker probe."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+
+    saved_cache = os.environ.get("MXNET_TPU_COMPILE_CACHE")
+    os.environ["MXNET_TPU_COMPILE_CACHE"] = os.path.join(workdir, "aot")
+    try:
+        def factory():
+            # the stable prefix keeps param names (and so the AOT cache
+            # fingerprint) identical across rebuilds — a gensym'd name
+            # (dense0_ vs dense7_) would miss the cache on every restart
+            mx.random.seed(5)
+            net = mx.gluon.nn.Dense(4, in_units=3, prefix="fleet_net_")
+            net.initialize()
+            return serving.Predictor.from_block(
+                net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+
+        serving.reset_stats()
+        x = np.ones((1, 3), np.float32)
+        with serving.Fleet(factory, replicas=2, probe_interval_ms=50,
+                           breaker_k=2, retries=2, backoff_ms=1,
+                           breaker_cooldown_ms=100,
+                           server_kw={"batch_timeout_ms": 1.0}) as fleet:
+            baseline = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+            with faults.inject(kind, times=4) as f:
+                futs = [fleet.submit(x, deadline_ms=10000)
+                        for _ in range(8)]
+                oks = errs = 0
+                for fu in futs:
+                    try:
+                        r = fu.result(timeout=30)
+                        oks += int(np.array_equal(r[0], baseline[0]))
+                    except Exception:
+                        errs += 1
+            recovered = fleet.wait_healthy(timeout=20)
+            victim = fleet.replicas()[0]
+            warm_hits = getattr(victim.predictor, "warmup_cache_hits", 0)
+            after = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+        s = serving.stats()
+        ok = (oks == 8 and errs == 0 and f.fired >= 1 and recovered
+              and s["fleet_restarts"] >= 1 and s["fleet_drains"] >= 1
+              and s["fleet_half_open_probes"] >= 1 and warm_hits >= 1
+              and np.array_equal(after[0], baseline[0]))
+        return ok, (f"ok={oks}/8 errs={errs} fired={f.fired} "
+                    f"restarts={s['fleet_restarts']} "
+                    f"half_open={s['fleet_half_open_probes']} "
+                    f"warm_hits={warm_hits} recovered={recovered}")
+    finally:
+        if saved_cache is None:
+            os.environ.pop("MXNET_TPU_COMPILE_CACHE", None)
+        else:
+            os.environ["MXNET_TPU_COMPILE_CACHE"] = saved_cache
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -462,6 +525,8 @@ def run_kind(kind, workdir=None):
             return _drill_dist_connect_timeout(mx, tmp)
         if kind == "capture_step":
             return _drill_capture_step(mx, tmp)
+        if kind in ("replica_crash", "replica_hang", "replica_nan_storm"):
+            return _drill_replica_fault(mx, tmp, kind)
         raise ValueError(f"unknown chaos kind {kind!r}")
     finally:
         faults.reset()

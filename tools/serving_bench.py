@@ -13,8 +13,15 @@ Sections (details on stderr):
 - server:  closed-loop BatchServer sweep at several client concurrencies
            (throughput, p50/p99 latency, pad-waste %, shed count)
 - overload: tiny queue + many clients, proving load shedding engages
+- fleet:   4-replica Fleet sweep — p99 with every replica healthy vs the
+           same offered load while one replica is crash-killed
+           mid-stream (``replica_crash`` fault). Gates: zero lost
+           requests (every future resolves to a result or a structured
+           error) and degraded p99 <= 3x the healthy baseline; the
+           victim must be auto-restarted and re-admitted.
 
 Run: JAX_PLATFORMS=cpu python tools/serving_bench.py [--iters N]
+     [--skip-fleet]
 """
 from __future__ import annotations
 
@@ -24,6 +31,7 @@ import os
 import sys
 import threading
 import time
+from concurrent import futures
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -112,9 +120,103 @@ def bench_server(mx, serving, pred, clients, per_client, timeout_ms=1.0,
     }
 
 
+def _fleet_factory():
+    """Module-level so process-mode fleets could pickle it too; the
+    bench runs thread mode."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    return _build_predictor(mx, serving, buckets=(1, 16))
+
+
+def bench_fleet(mx, serving, replicas=4, clients=8, per_client=40):
+    """The fleet sweep: closed-loop load against a healthy fleet, then
+    the same load while one replica is crash-killed mid-stream. Reports
+    p99 for both phases plus the loss/error/restart accounting."""
+    import numpy as np
+
+    from mxnet_tpu.resilience import faults
+
+    serving.reset_stats()
+    fleet = serving.Fleet(_fleet_factory, replicas=replicas,
+                          probe_interval_ms=100, breaker_k=3, retries=2,
+                          backoff_ms=2, breaker_cooldown_ms=200,
+                          server_kw={"batch_timeout_ms": 1.0})
+    xs = np.random.RandomState(3).rand(clients, 1, 20).astype(np.float32)
+
+    def run_phase(kill=False):
+        lat, counts = [], {"ok": 0, "err": 0, "lost": 0}
+        lock = threading.Lock()
+        barrier = threading.Barrier(clients + 1)
+
+        def client(tid):
+            barrier.wait()
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                fut = fleet.submit(xs[tid], deadline_ms=2000.0)
+                try:
+                    fut.result(timeout=10)
+                    with lock:
+                        counts["ok"] += 1
+                        lat.append(time.perf_counter() - t0)
+                except futures.TimeoutError:
+                    # the future never resolved: a LOST request — the
+                    # invariant the fleet must never break (py3.10:
+                    # futures.TimeoutError is NOT the builtin)
+                    with lock:
+                        counts["lost"] += 1
+                except Exception:
+                    with lock:
+                        counts["err"] += 1
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        if kill:
+            # arm the crash storm before releasing the clients: the
+            # victim dies mid-stream, the router retries around it
+            ctx = faults.inject("replica_crash", times=6)
+            ctx.__enter__()
+        barrier.wait()
+        try:
+            for t in threads:
+                t.join()
+        finally:
+            if kill:
+                ctx.__exit__(None, None, None)
+        lat.sort()
+        p99 = int(lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1) + 0.5))]
+                  * 1e6) if lat else 0
+        return p99, counts
+
+    # warm every replica's lazy bucket executors off the clock
+    for _ in range(2 * replicas):
+        fleet.submit(xs[0], deadline_ms=5000.0).result(timeout=30)
+
+    healthy_p99, healthy = run_phase(kill=False)
+    degraded_p99, degraded = run_phase(kill=True)
+    recovered = fleet.wait_healthy(timeout=30)
+    stats = serving.stats()
+    fleet.close()
+    return {
+        "replicas": replicas,
+        "clients": clients,
+        "fleet_p99_healthy_us": healthy_p99,
+        "fleet_p99_killed_us": degraded_p99,
+        "healthy": healthy,
+        "killed": degraded,
+        "lost": healthy["lost"] + degraded["lost"],
+        "restarts": stats["fleet_restarts"],
+        "retries": stats["fleet_retries"],
+        "recovered": recovered,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=1000)
+    ap.add_argument("--skip-fleet", action="store_true")
     args = ap.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -147,6 +249,21 @@ def main(argv=None):
     print(f"overload (depth 4): shed {over['shed']} of "
           f"{over['offered']} offered", file=sys.stderr)
 
+    fleet = None
+    fleet_ok = True
+    if not args.skip_fleet:
+        fleet = bench_fleet(mx, serving)
+        ratio = (fleet["fleet_p99_killed_us"]
+                 / max(1, fleet["fleet_p99_healthy_us"]))
+        fleet_ok = (fleet["lost"] == 0 and fleet["recovered"]
+                    and fleet["restarts"] >= 1 and ratio <= 3.0)
+        print(f"fleet ({fleet['replicas']} replicas, {fleet['clients']} "
+              f"clients): p99 healthy {fleet['fleet_p99_healthy_us']} us, "
+              f"one-killed {fleet['fleet_p99_killed_us']} us "
+              f"({ratio:.2f}x, gate 3x), lost {fleet['lost']}, "
+              f"restarts {fleet['restarts']}, retries {fleet['retries']}, "
+              f"recovered {fleet['recovered']}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "serving_samples_per_s_b16",
         "value": round(batched, 1),
@@ -162,9 +279,12 @@ def main(argv=None):
             "p99_us_c8": sweeps[8]["p99_us"],
             "pad_waste_pct_c8": round(sweeps[8]["pad_waste_pct"], 1),
             "overload_shed": over["shed"],
+            "fleet": fleet,
+            "fleet_gate_ok": fleet_ok,
         },
     }))
+    return 0 if fleet_ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
