@@ -15,14 +15,72 @@ Two graph modes:
 Calibration modes: 'none' (runtime min/max), 'naive' (min/max over a
 calibration set), 'entropy' (KL-divergence-optimal clip threshold over
 activation histograms — calibrate.cc).
+
+Calibration is a product step (docs/quantization.md): :func:`calibrate`
+returns a :class:`CalibrationTable` (per-tensor thresholds + calib mode
++ sample count) that serving hosts ship next to the params file, so a
+`Predictor` quantizes WITHOUT calibration data; applying a table to a
+model it was not calibrated for raises :class:`CalibrationMismatchError`
+instead of silently serving mis-scaled answers. Collectors accumulate
+min/max and |activation| histograms ON DEVICE and pull one small result
+per monitored tensor per batch (not one full-tensor transfer per
+histogram), timed by the ``calib_*`` counters in
+``profiler.dispatch_stats()``.
 """
 from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
 
 import numpy as np
 
 from ..base import MXNetError
+from ..resilience import faults as _faults
 
-__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm"]
+__all__ = ["quantize_model", "quantize_graph", "fold_batch_norm",
+           "calibrate", "CalibrationTable", "CalibrationMismatchError",
+           "symbol_digest", "stats", "reset_stats"]
+
+# Calibration observability (merged into profiler.dispatch_stats()).
+_STATS = {
+    "calib_batches": 0,       # calibration batches fed through the graph
+    "calib_tensor_syncs": 0,  # device->host pulls (one per monitored
+                              # tensor per batch: a scalar pair or a
+                              # histogram, never the full activation)
+    "calib_ms": 0,            # cumulative wall-clock ms in the collectors
+    "calib_tables_saved": 0,  # CalibrationTable.save() calls
+    "calib_tables_loaded": 0, # CalibrationTable.load() calls
+    "calib_mismatches": 0,    # stale table/model pairs rejected
+}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+@contextlib.contextmanager
+def _calib_timer():
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _STATS["calib_ms"] += int((time.perf_counter() - t0) * 1e3)
+
+
+def _calib_bins(num_bins=None):
+    if num_bins is not None:
+        return int(num_bins)
+    v = os.environ.get("MXNET_TPU_INT8_CALIB_BINS", "").strip()
+    return int(v) if v else 2048
+
 
 _QUANTIZABLE = ("FullyConnected", "Convolution")
 
@@ -184,7 +242,7 @@ def _calibration_forward(sym, arg_params, aux_params, data_names,
     """Shared calibration loop: bind once with a monitor callback, feed
     each calib batch (labels synthesized as zeros), honor the example
     cutoff. `tap(mon_name, arr)` observes every node output; `on_batch`
-    observes the raw input batch."""
+    observes the raw input batch. Returns the number of examples seen."""
     from .. import context as ctx_mod
 
     seen = 0
@@ -211,26 +269,73 @@ def _calibration_forward(sym, arg_params, aux_params, data_names,
             ex.forward(is_train=False,
                        **{n: d for n, d in zip(data_names, batch.data)})
         seen += batch.data[0].shape[0]
+        _STATS["calib_batches"] += 1
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
+    return seen
+
+
+def _observed(arr):
+    """Concrete array of one observed tensor: NDArrays resolve through
+    ``_force()`` (a lazy bulk-segment placeholder must be flushed before
+    device math can see it), raw arrays pass through."""
+    if hasattr(arr, "_force"):
+        return arr._force()
+    return arr._data if hasattr(arr, "_data") else arr
+
+
+def _device_minmax(arr):
+    """(min, max) of one observed tensor with ONE small device->host
+    pull: the reduction runs on device and only the scalar pair crosses
+    the tunnel — never the full activation."""
+    import jax.numpy as jnp
+
+    a = _observed(arr)
+    if isinstance(a, np.ndarray):
+        _STATS["calib_tensor_syncs"] += 1
+        return float(a.min()), float(a.max())
+    pair = np.asarray(jnp.stack([jnp.min(a), jnp.max(a)]))
+    _STATS["calib_tensor_syncs"] += 1
+    return float(pair[0]), float(pair[1])
+
+
+def _device_abs_hist(arr, hi, num_bins):
+    """|activation| histogram of one observed tensor, accumulated on
+    device; only the ``num_bins`` counts cross to the host (one sync per
+    monitored tensor per batch — the eager-replay calibration cost fix
+    from PERF.md round 5)."""
+    import jax.numpy as jnp
+
+    a = _observed(arr)
+    if isinstance(a, np.ndarray):
+        _STATS["calib_tensor_syncs"] += 1
+        return np.histogram(np.abs(a).ravel(), bins=num_bins,
+                            range=(0.0, hi))[0].astype(np.int64)
+    counts, _edges = jnp.histogram(jnp.abs(a).ravel(), bins=num_bins,
+                                   range=(0.0, hi))
+    _STATS["calib_tensor_syncs"] += 1
+    return np.asarray(counts).astype(np.int64)
 
 
 def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
-                    calib_data, num_calib_examples, logger=None):
+                    calib_data, num_calib_examples, logger=None,
+                    seen_out=None):
     """Naive calibration: run the fp32 graph over calib batches recording
-    per-producer min/max (contrib/quantization.py _LayerOutputCollector)."""
+    per-producer min/max (contrib/quantization.py _LayerOutputCollector).
+    Reductions run on device; only scalar pairs cross to the host.
+    ``seen_out`` (a list) receives the example count when given."""
     targets = _quant_targets(sym)
     name_of = _monitor_names(targets)
     ranges = {}
 
-    def _expand(key, a):
+    def _expand(key, pair):
         lo, hi = ranges.get(key, (np.inf, -np.inf))
-        ranges[key] = (min(lo, float(a.min())), max(hi, float(a.max())))
+        ranges[key] = (min(lo, pair[0]), max(hi, pair[1]))
 
     def tap(mon_name, arr):
         key = name_of.get(mon_name)
         if key is not None:
-            _expand(key, arr.asnumpy())
+            _expand(key, _device_minmax(arr))
 
     # range of weights/vars straight from params
     for (name, slot) in targets:
@@ -240,11 +345,14 @@ def _collect_ranges(sym, arg_params, aux_params, data_names, label_names,
 
     def on_batch(batch):
         for n, d in zip(data_names, batch.data):
-            _expand((n, 0), d.asnumpy())
+            _expand((n, 0), _device_minmax(d))
 
-    _calibration_forward(sym, arg_params, aux_params, data_names,
-                         label_names, calib_data, num_calib_examples,
-                         tap, on_batch)
+    with _calib_timer():
+        seen = _calibration_forward(sym, arg_params, aux_params,
+                                    data_names, label_names, calib_data,
+                                    num_calib_examples, tap, on_batch)
+    if seen_out is not None:
+        seen_out.append(seen)
     return ranges
 
 
@@ -313,14 +421,18 @@ def _entropy_threshold(hist, edges, num_quantized_bins=255):
 
 def _collect_entropy_ranges(sym, arg_params, aux_params, data_names,
                             label_names, calib_data, num_calib_examples,
-                            num_bins=2048, logger=None):
+                            num_bins=None, logger=None, seen_out=None):
     """Two passes: (1) max|activation| per target via the naive collector,
     (2) |activation| histograms, then the KL threshold per target.
     Weight/bias params keep exact min/max (the reference also only
-    entropy-calibrates activations)."""
+    entropy-calibrates activations). Histograms accumulate ON DEVICE —
+    each monitored tensor costs one ``num_bins``-count pull per batch,
+    not a full-activation transfer per histogram (PERF.md round 5's
+    eager-replay calibration cost)."""
+    num_bins = _calib_bins(num_bins)
     naive = _collect_ranges(sym, arg_params, aux_params, data_names,
                             label_names, calib_data, num_calib_examples,
-                            logger)
+                            logger, seen_out=seen_out)
     param_keys = {k for k in naive if k[0] in arg_params}
     act_keys = [k for k in naive if k not in param_keys]
     max_abs = {k: max(abs(naive[k][0]), abs(naive[k][1]), 1e-20)
@@ -328,52 +440,267 @@ def _collect_entropy_ranges(sym, arg_params, aux_params, data_names,
     hists = {k: np.zeros(num_bins, np.int64) for k in act_keys}
     name_of = _monitor_names(act_keys)
 
-    def add_hist(key, a):
-        hists[key] += np.histogram(np.abs(a).ravel(), bins=num_bins,
-                                   range=(0.0, max_abs[key]))[0]
+    def add_hist(key, arr):
+        hists[key] += _device_abs_hist(arr, max_abs[key], num_bins)
 
     def tap(mon_name, arr):
         key = name_of.get(mon_name)
         if key is not None:
-            add_hist(key, arr.asnumpy())
+            add_hist(key, arr)
 
     def on_batch(batch):
         for n, d in zip(data_names, batch.data):
             if (n, 0) in hists:
-                add_hist((n, 0), d.asnumpy())
+                add_hist((n, 0), d)
 
-    _calibration_forward(sym, arg_params, aux_params, data_names,
-                         label_names, calib_data, num_calib_examples,
-                         tap, on_batch)
+    with _calib_timer():
+        _calibration_forward(sym, arg_params, aux_params, data_names,
+                             label_names, calib_data, num_calib_examples,
+                             tap, on_batch)
 
-    ranges = dict(naive)  # params keep exact min/max
-    for k in act_keys:
-        edges = np.linspace(0.0, max_abs[k], num_bins + 1)
-        t = _entropy_threshold(hists[k], edges)
-        ranges[k] = (-t, t)
-        if logger:
-            logger.info("entropy calib %s: max|x| %.4f -> threshold %.4f",
-                        k, max_abs[k], t)
+        ranges = dict(naive)  # params keep exact min/max
+        for k in act_keys:
+            edges = np.linspace(0.0, max_abs[k], num_bins + 1)
+            t = _entropy_threshold(hists[k], edges)
+            ranges[k] = (-t, t)
+            if logger:
+                logger.info(
+                    "entropy calib %s: max|x| %.4f -> threshold %.4f",
+                    k, max_abs[k], t)
     return ranges
+
+
+def symbol_digest(sym):
+    """Structural digest of a Symbol: the graph JSON with gensym'd
+    op-node names canonicalized (``fullyconnected0`` vs
+    ``fullyconnected1`` across builds of the same block), variable names
+    kept (they bind the params). One shared helper so the serving
+    Predictor's AOT fingerprint and CalibrationTable model-identity use
+    THE SAME notion of "same model"."""
+    graph = json.loads(sym.tojson())
+    for i, node in enumerate(graph.get("nodes", ())):
+        if node.get("op") != "null":
+            node["name"] = f"n{i}"
+    return hashlib.sha256(
+        json.dumps(graph, sort_keys=True).encode()).hexdigest()[:16]
+
+
+class CalibrationMismatchError(MXNetError):
+    """A CalibrationTable does not belong to the model it is being
+    applied to — different graph structure, missing thresholds, or
+    drifted parameter ranges. Raised instead of quantizing with stale
+    scales: mis-calibrated int8 answers are silently wrong, an error is
+    recoverable. Structured: ``model_digest`` (table's vs model's),
+    ``missing`` (quantization targets without thresholds), ``drifted``
+    (params whose current range left the table's)."""
+
+    def __init__(self, msg, model_digest=None, missing=(), drifted=()):
+        super().__init__(msg)
+        self.model_digest = model_digest
+        self.missing = tuple(missing)
+        self.drifted = tuple(drifted)
+
+
+class CalibrationTable:
+    """Shippable calibration result: per-tensor thresholds + calibration
+    provenance, saved as JSON next to the params file so serving hosts
+    quantize WITHOUT calibration data (docs/quantization.md).
+
+    ``thresholds``: ``{(producer_name, slot): (min, max)}`` — the keys
+    :func:`quantize_model` consumes as ``calib_ranges``. ``model_digest``
+    pins the table to the graph it was calibrated on (the BN-FOLDED
+    graph, when folding is part of the deploy flow)."""
+
+    VERSION = 1
+
+    def __init__(self, thresholds, calib_mode, num_examples=0,
+                 quantized_dtype="int8", model_digest=None, num_bins=None):
+        self.thresholds = {tuple(k): (float(v[0]), float(v[1]))
+                           for k, v in thresholds.items()}
+        self.calib_mode = calib_mode
+        self.num_examples = int(num_examples)
+        self.quantized_dtype = quantized_dtype
+        self.model_digest = model_digest
+        self.num_bins = num_bins
+
+    def digest(self):
+        """Digest of the quantization-relevant content (thresholds +
+        mode + dtype): the AOT compile-cache ingredient — a recalibrated
+        table can never false-hit a stale compiled program."""
+        blob = json.dumps({
+            "thresholds": sorted((f"{n}:{s}", lo, hi) for (n, s), (lo, hi)
+                                 in self.thresholds.items()),
+            "calib_mode": self.calib_mode,
+            "quantized_dtype": self.quantized_dtype,
+        }, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def to_json(self):
+        return json.dumps({
+            "version": self.VERSION,
+            "calib_mode": self.calib_mode,
+            "quantized_dtype": self.quantized_dtype,
+            "num_examples": self.num_examples,
+            "num_bins": self.num_bins,
+            "model_digest": self.model_digest,
+            "thresholds": {f"{n}:{s}": [lo, hi] for (n, s), (lo, hi)
+                           in sorted(self.thresholds.items())},
+        }, sort_keys=True, indent=1)
+
+    def save(self, path):
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        atomic_write_bytes(path, self.to_json().encode())
+        _STATS["calib_tables_saved"] += 1
+        return path
+
+    @classmethod
+    def from_json(cls, text):
+        d = json.loads(text)
+        if d.get("version") != cls.VERSION:
+            raise MXNetError(
+                f"CalibrationTable version {d.get('version')!r} is not "
+                f"supported (expected {cls.VERSION})")
+        thresholds = {}
+        for key, (lo, hi) in d["thresholds"].items():
+            name, _, slot = key.rpartition(":")
+            thresholds[(name, int(slot))] = (lo, hi)
+        return cls(thresholds, d["calib_mode"],
+                   num_examples=d.get("num_examples", 0),
+                   quantized_dtype=d.get("quantized_dtype", "int8"),
+                   model_digest=d.get("model_digest"),
+                   num_bins=d.get("num_bins"))
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            table = cls.from_json(f.read())
+        _STATS["calib_tables_loaded"] += 1
+        return table
+
+    def stale_clone(self):
+        """A copy whose model identity is wrong — the shape of a stale
+        table shipped against a newer model. Used by the
+        ``int8_calib_mismatch`` fault drill (resilience/faults.py) so
+        the detection path is exercisable deterministically."""
+        clone = CalibrationTable(
+            self.thresholds, self.calib_mode, self.num_examples,
+            self.quantized_dtype,
+            model_digest="0" * 16, num_bins=self.num_bins)
+        return clone
+
+    def validate_for(self, sym, arg_params=None, model_digest=None):
+        """Threshold-drift detection: raise
+        :class:`CalibrationMismatchError` unless this table matches
+        ``sym`` — same structural digest (when both sides carry one),
+        a threshold for every quantization target, and (when
+        ``arg_params`` is given) parameter value ranges still inside the
+        table's recorded ranges (a re-trained weight outside its
+        calibrated range would silently clip)."""
+        digest = model_digest or symbol_digest(sym)
+        problems = []
+        if self.model_digest is not None and digest != self.model_digest:
+            problems.append(
+                f"model digest {digest} != table digest "
+                f"{self.model_digest}")
+        targets = _quant_targets(sym)
+        missing = sorted(f"{n}[{s}]" for (n, s) in targets
+                         if (n, s) not in self.thresholds)
+        if missing:
+            problems.append(f"no thresholds for targets {missing}")
+        drifted = []
+        if arg_params is not None:
+            for (n, s) in sorted(targets):
+                if n not in arg_params or (n, s) not in self.thresholds:
+                    continue
+                # on-device reduction, scalar-pair pull — a fleet-replica
+                # rebuild must not ship every weight tensor to the host
+                # just to drift-check it
+                lo, hi = _device_minmax(arg_params[n])
+                tlo, thi = self.thresholds[(n, s)]
+                span = max(abs(tlo), abs(thi), 1e-20)
+                if lo < tlo - 1e-5 * span or hi > thi + 1e-5 * span:
+                    drifted.append(f"{n}[{s}] value range ({lo:.6g}, "
+                                   f"{hi:.6g}) left calibrated "
+                                   f"({tlo:.6g}, {thi:.6g})")
+        if drifted:
+            problems.append(f"param ranges drifted: {drifted}")
+        if problems:
+            _STATS["calib_mismatches"] += 1
+            raise CalibrationMismatchError(
+                "calibration table does not match this model — "
+                "re-calibrate instead of serving mis-scaled int8 "
+                "answers: " + "; ".join(problems),
+                model_digest=self.model_digest, missing=missing,
+                drifted=drifted)
+        return self
+
+
+def calibrate(sym, arg_params, aux_params, calib_data,
+              calib_mode="entropy", data_names=("data",),
+              label_names=("softmax_label",), num_calib_examples=None,
+              num_bins=None, logger=None):
+    """Run calibration as a standalone product step and return a
+    :class:`CalibrationTable` (thresholds + mode + sample count +
+    model digest) ready to ``save()`` and ship to serving hosts.
+
+    Calibrate the graph you will DEPLOY: if the serving flow folds
+    BatchNorm (``Predictor.quantize`` does), pass the folded symbol —
+    the table's model digest pins exactly that graph."""
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError(f"calibrate: calib_mode must be naive|entropy, "
+                         f"got {calib_mode!r}")
+    collect = (_collect_ranges if calib_mode == "naive"
+               else _collect_entropy_ranges)
+    kwargs = {} if calib_mode == "naive" else {"num_bins": num_bins}
+    seen_out = []
+    ranges = collect(sym, arg_params, aux_params, data_names, label_names,
+                     calib_data, num_calib_examples, logger=logger,
+                     seen_out=seen_out, **kwargs)
+    return CalibrationTable(ranges, calib_mode,
+                            num_examples=seen_out[0] if seen_out else 0,
+                            num_bins=_calib_bins(num_bins)
+                            if calib_mode == "entropy" else None,
+                            model_digest=symbol_digest(sym))
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), excluded_sym_names=(),
                    calib_mode="none", calib_data=None,
                    num_calib_examples=None, quantized_dtype="int8",
-                   quantize_mode="fake", logger=None):
+                   quantize_mode="fake", calib_table=None, logger=None):
     """Quantize a symbolic model (contrib/quantization.py:quantize_model).
 
     calib_mode: 'none' (runtime min/max), 'naive' (min/max over
     calib_data), or 'entropy' (KL-optimal clip thresholds,
     calibrate.cc). quantize_mode: 'fake' (int8 grid, fp32 compute) or
     'full' (real int8 kernels, int32 MXU accumulation — requires
-    calibration). Returns (quantized_symbol, arg_params, aux_params).
+    calibration). ``calib_table`` (a :class:`CalibrationTable` or a path
+    to a saved one) supplies thresholds WITHOUT calibration data — it is
+    validated against the model first (stale table -> structured
+    :class:`CalibrationMismatchError`, never silent accuracy loss).
+    Returns (quantized_symbol, arg_params, aux_params).
     """
     if quantized_dtype not in ("int8", "uint8"):
         raise MXNetError("quantized_dtype must be int8 or uint8")
     ranges = None
-    if calib_mode in ("naive", "entropy"):
+    if calib_table is not None and calib_data is not None:
+        # never silently prefer one: a stale configured table shadowing
+        # fresh calibration data is exactly the silent-accuracy-loss
+        # class the table validation exists to prevent
+        raise MXNetError(
+            "quantize_model: pass calib_table OR calib_data, not both "
+            "(a pre-shipped table and a fresh calibration run cannot "
+            "both win)")
+    if calib_table is not None:
+        if isinstance(calib_table, str):
+            calib_table = CalibrationTable.load(calib_table)
+        # the int8_calib_mismatch chaos drill swaps in a stale clone
+        # here, proving validation catches it on the REAL apply path
+        calib_table = _faults.maybe_calib_table_drift(calib_table)
+        calib_table.validate_for(sym, arg_params=arg_params)
+        ranges = dict(calib_table.thresholds)
+    elif calib_mode in ("naive", "entropy"):
         if calib_data is None:
             raise MXNetError(f"calib_mode={calib_mode!r} requires "
                              "calib_data")

@@ -10,6 +10,8 @@ contrib/quantization.py — the int8 *accuracy* flow; int8 *throughput*
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from .registry import register
@@ -17,6 +19,22 @@ from .registry import register
 
 def _int8_range(min_r, max_r):
     return jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+
+
+def _nan_poison_enabled():
+    """Calibrated ranges are trace-time constants — without a guard they
+    LAUNDER non-finite inputs: NaN rides ``round()`` into the int8 cast
+    and comes out as an ordinary integer, so a poisoned batch would
+    dequantize to finite-looking garbage the serving HealthSentinel can
+    never catch. When enabled (default), every calibrated boundary adds
+    a ``0 * sum(x)`` flag to its range outputs: 0 for finite data, NaN
+    otherwise — the poison rides the min/max chain through every
+    quantized op and surfaces as NaN in the dequantized fp32 outputs,
+    exactly like the un-calibrated (data-dependent min/max) path.
+    ``MXNET_TPU_INT8_NAN_POISON=0`` disables (saves one reduction per
+    quantize boundary per batch). Read at TRACE time."""
+    return os.environ.get("MXNET_TPU_INT8_NAN_POISON", "1") \
+        .strip().lower() not in ("0", "false", "off")
 
 
 @register("_contrib_quantize", num_outputs=3, no_grad=True,
@@ -57,6 +75,14 @@ def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
         if out_type == "auto":
             out_type = ("uint8" if float(min_calib_range) >= 0.0
                         else "int8")
+        if _nan_poison_enabled():
+            # non-finite inputs must not vanish into the clip: the flag
+            # is 0 for finite data, NaN otherwise, and rides the range
+            # outputs through the whole quantized graph to the boundary
+            # dequantize (see _nan_poison_enabled)
+            flag = 0.0 * jnp.sum(data.astype(jnp.float32))
+            min_r = min_r + flag
+            max_r = max_r + flag
     if out_type == "uint8":
         scale = 255.0 / jnp.maximum(max_r - min_r, 1e-20)
         q = jnp.clip(jnp.round((data - min_r) * scale), 0, 255)
@@ -98,6 +124,12 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     if min_calib_range is not None and max_calib_range is not None:
         out_min = jnp.asarray(min_calib_range, jnp.float32)
         out_max = jnp.asarray(max_calib_range, jnp.float32)
+        if _nan_poison_enabled():
+            # keep the incoming range's NaN poison alive across the
+            # calibrated re-scale (see _nan_poison_enabled)
+            flag = 0.0 * real_in
+            out_min = out_min + flag
+            out_max = out_max + flag
     else:
         out_max = jnp.max(jnp.abs(fp))
         out_min = -out_max
@@ -371,6 +403,8 @@ def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
     real_in = _int8_range(min_data.reshape(()), max_data.reshape(()))
     real_out = _int8_range(jnp.asarray(min_calib_range, jnp.float32),
                            jnp.asarray(max_calib_range, jnp.float32))
+    if _nan_poison_enabled():
+        real_out = real_out + 0.0 * real_in  # poison rides through
     g = jnp.ones_like(moving_var) if fix_gamma else gamma
     inv = g / jnp.sqrt(moving_var + eps)
     # float BN: y = (x - mean) * inv + beta; on the grid:

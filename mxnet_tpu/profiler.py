@@ -190,8 +190,14 @@ def dispatch_stats(reset=False):
       structured reason in the dispatch ring and capture.retrace_log()),
       capture_fallback_eager, aot_cache_hits/misses/stale/corrupt/
       writes/evictions (the persistent AOT compile cache)
+    - int8 calibration counters (docs/quantization.md): calib_batches/
+      calib_tensor_syncs (one device->host pull per monitored tensor per
+      batch), calib_ms (wall-clock in the collectors),
+      calib_tables_saved/loaded, calib_mismatches (stale table/model
+      pairs rejected); serving_quantized_predictors/compiles above
     """
     from . import capture, engine, resilience, serving
+    from .contrib import quantization
     from .gluon.data import dataloader
     from .ops import registry
 
@@ -201,6 +207,7 @@ def dispatch_stats(reset=False):
     stats.update(serving.stats())
     stats.update(dataloader.stats())
     stats.update(capture.stats())
+    stats.update(quantization.stats())
     if reset:
         reset_dispatch_stats()
     return stats
@@ -208,8 +215,9 @@ def dispatch_stats(reset=False):
 
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
-    serving + dataloader + capture)."""
+    serving + dataloader + capture + quantization)."""
     from . import capture, engine, resilience, serving
+    from .contrib import quantization
     from .gluon.data import dataloader
     from .ops import registry
 
@@ -220,6 +228,7 @@ def reset_dispatch_stats():
     serving.reset_stats()
     dataloader.reset_stats()
     capture.reset_stats()
+    quantization.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

@@ -60,6 +60,13 @@ Supported fault kinds (the hook that honours each is noted):
                                   ``times=N`` for an N-batch storm) so
                                   the sentinel fails them and the
                                   router's circuit breaker opens
+- ``int8_calib_mismatch``       — swap a stale CalibrationTable clone in
+                                  at quantize time (``contrib.quantization
+                                  .quantize_model(calib_table=...)``) so
+                                  table/model validation must reject it
+                                  with a structured
+                                  CalibrationMismatchError — never a
+                                  silently mis-scaled int8 model
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -85,7 +92,7 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
            "maybe_hang", "maybe_oom_step", "maybe_peer_death",
            "maybe_replica_crash", "maybe_replica_hang",
-           "maybe_replica_nan_storm"]
+           "maybe_replica_nan_storm", "maybe_calib_table_drift"]
 
 
 class SimulatedCrash(BaseException):
@@ -415,6 +422,21 @@ def maybe_replica_nan_storm(replica_id, feeds):
     if fault is None or int(replica_id) != _fault_replica_target():
         return feeds
     return _poison_first_float(fault, feeds, "replica_nan_storm")
+
+
+def maybe_calib_table_drift(table):
+    """Return a stale clone of ``table`` when ``int8_calib_mismatch``
+    fires (its model digest no longer matches any live model), else the
+    table unchanged. Hooked into ``contrib.quantization.quantize_model``'s
+    table-apply path, BEFORE validation — so the drill proves the real
+    detection logic turns a stale table into a structured
+    ``CalibrationMismatchError`` instead of silently mis-scaled int8."""
+    if not _ACTIVE:
+        return table
+    fault = _ACTIVE.get("int8_calib_mismatch")
+    if fault is None or not fault.should_fire():
+        return table
+    return table.stale_clone()
 
 
 def maybe_peer_death():

@@ -44,6 +44,8 @@ _STATS = {
     "serving_unbucketed": 0,       # exact-size compiles beyond max bucket
     "serving_batch_samples": 0,    # rows executed (bucket-padded)
     "serving_padded_samples": 0,   # of which padding (waste)
+    "serving_quantized_predictors": 0,  # Predictor.quantize() completions
+    "serving_quantized_compiles": 0,    # bucket executors built int8
     # BatchServer
     "serving_requests": 0,         # accepted submits
     "serving_batches": 0,          # coalesced batch executions

@@ -374,9 +374,15 @@ class BatchServer:
             healthy = True
             err = None
             if self.sentinel is not None:
+                # the check runs on the predictor's OUTPUTS — for a
+                # quantized predictor that is the dequantized fp32
+                # boundary, so int8 replicas get the same NaN policing
+                # as fp32 ones; tag the forensic message with the
+                # executable's dtype so crash reports name it
+                tag = getattr(self.predictor, "quant_tag", "")
                 try:
                     healthy = self.sentinel.check_finite(
-                        outs, what="serving batch outputs")
+                        outs, what=f"serving batch outputs{tag}")
                 except NumericHealthError as e:
                     healthy, err = False, e
             if not healthy:

@@ -108,6 +108,12 @@ def _failed_future(exc):
     return fut
 
 
+def _variant_key(model, variant):
+    """Replica-group name of one dtype variant (``model@variant``) —
+    the shared addressing between Fleet construction and routing."""
+    return f"{model}@{variant}" if variant is not None else model
+
+
 def _backoff_delay(base_s, cap_s, attempt, rng=None):
     """Capped jittered exponential backoff: uniform over the upper half
     of the exponential ceiling ``base * 2^(attempt-1)`` (the same
@@ -394,12 +400,14 @@ def _mp_worker(conn, factory, rid):
         name: np.zeros((1,) + tuple(t), pred._dtype)
         for name, t in tails.items()}
 
+    qtag = getattr(pred, "quant_tag", "")
+
     def run(feeds):
         outs, _n = pred.predict_raw(feeds)
         healthy, err = True, None
         try:
             healthy = sentinel.check_finite(
-                outs, what=f"replica {rid} batch outputs")
+                outs, what=f"replica {rid} batch outputs{qtag}")
         except NumericHealthError as e:
             healthy, err = False, e
         if not healthy:
@@ -1069,11 +1077,14 @@ class Router:
                                open_breakers, unhealthy, retry_after)
 
     # ------------------------------------------------------------------- submit
-    def submit(self, data, deadline_ms=None, model="default"):
+    def submit(self, data, deadline_ms=None, model="default",
+               variant=None):
         """Admit one request; returns a Future that ALWAYS terminates in
         a result or a structured error. ``deadline_ms`` is the total
         budget across every attempt — each attempt (and each retry's
-        backoff) sees only what remains of it."""
+        backoff) sees only what remains of it. ``variant`` addresses one
+        dtype variant of ``model`` (e.g. ``'int8'``)."""
+        model = _variant_key(model, variant)
         group = self._sup.group(model)
         _STATS["fleet_requests"] += 1
         now = time.monotonic()
@@ -1282,6 +1293,15 @@ class Fleet:
     artifact cache). In ``mode='process'`` the factory must be picklable
     (a module-level function).
 
+    A model may serve several DTYPE VARIANTS side by side — e.g. bf16
+    and calibrated-int8 replicas of the same network
+    (docs/quantization.md): nest the factories as
+    ``{model: {variant: factory}}`` and address them with
+    ``submit(..., model=m, variant=v)``. Each variant is its own replica
+    group (own breakers, probes, restarts); health probes and the NaN
+    sentinel run on the DEQUANTIZED fp32 outputs, so an int8 variant is
+    supervised exactly like its bf16 sibling.
+
     >>> fleet = serving.Fleet(make_predictor, replicas=4)
     >>> outs = fleet.submit(batch, deadline_ms=50.0).result()
     >>> fleet.close()
@@ -1294,6 +1314,17 @@ class Fleet:
                  drain_timeout=None, probe_strikes=2, server_kw=None):
         if callable(factories):
             factories = {"default": factories}
+        # dtype variants: {model: {variant: factory}} flattens to one
+        # replica group per "model@variant" (shared addressing with
+        # submit(model=, variant=))
+        flat = {}
+        for model, f in (factories or {}).items():
+            if isinstance(f, dict):
+                for variant, vf in f.items():
+                    flat[_variant_key(model, variant)] = vf
+            else:
+                flat[model] = f
+        factories = flat
         if not factories:
             raise MXNetError("Fleet needs at least one model factory")
         n = int(replicas if replicas is not None
@@ -1334,12 +1365,22 @@ class Fleet:
         _register_fleet(self)
 
     # ------------------------------------------------------------------ serving
-    def submit(self, data, deadline_ms=None, model="default"):
+    def submit(self, data, deadline_ms=None, model="default",
+               variant=None):
         """Route one request (array, or dict name -> array, WITH batch
         axis). Returns a Future of the output list; it always terminates
-        in a result or a structured error."""
+        in a result or a structured error. ``variant`` picks one dtype
+        variant of ``model`` (``{model: {variant: factory}}``
+        construction)."""
         return self._router.submit(data, deadline_ms=deadline_ms,
-                                   model=model)
+                                   model=model, variant=variant)
+
+    def variants(self, model="default"):
+        """Dtype variants served for ``model`` (empty when the model was
+        registered without variants)."""
+        prefix = f"{model}@"
+        return sorted(m[len(prefix):] for m in self._sup.models()
+                      if m.startswith(prefix))
 
     @property
     def supervisor(self):
@@ -1352,15 +1393,18 @@ class Fleet:
     def models(self):
         return self._sup.models()
 
-    def replicas(self, model="default"):
-        return self._sup.replicas(model)
+    def replicas(self, model="default", variant=None):
+        return self._sup.replicas(_variant_key(model, variant))
 
-    def replica_states(self, model="default"):
-        return [r.state for r in self._sup.replicas(model)]
+    def replica_states(self, model="default", variant=None):
+        return [r.state
+                for r in self._sup.replicas(_variant_key(model, variant))]
 
-    def fail_replica(self, rid=0, model="default", reason="operator"):
+    def fail_replica(self, rid=0, model="default", reason="operator",
+                     variant=None):
         """Operator hook: drain, restart and re-admit one replica (the
         same machinery a failure detection triggers)."""
+        model = _variant_key(model, variant)
         for r in self._sup.replicas(model):
             if r.rid == rid:
                 return self._sup.fail_replica(r, reason=reason)

@@ -20,6 +20,16 @@ Construction sources:
   (``arg:``/``aux:`` prefixes of ``model.save_checkpoint`` honored);
 - a gluon block via :meth:`Predictor.from_block` (traced symbolically the
   way ``HybridBlock.export`` does, skipping the filesystem round-trip).
+
+INT8 serving (docs/quantization.md): ``Predictor(..., quantize="int8",
+calib_data=...)`` — or ``calib_table=`` for hosts without calibration
+data — folds BatchNorm and rewrites the graph through
+``contrib.quantization.quantize_model(quantize_mode='full')`` at build
+time, so every bucket compiles ONE fused INT8 executable: fp32 in/out at
+the boundary, integer grid inside. The quantization config + calibration
+thresholds enter the AOT compile-cache fingerprint, so a recalibrated
+model can never false-hit a stale compiled program (the forced recompile
+is recorded as a structured retrace reason in ``capture.retrace_log()``).
 """
 from __future__ import annotations
 
@@ -91,11 +101,20 @@ class Predictor:
     group2ctx : dict group-name -> Context (manual placement, as in bind)
     warmup : bool — eagerly compile every declared bucket at construction
         (needs ``input_shapes``). ``warmup_ms`` records the cost.
+    quantize : None | "int8" — rewrite the graph to real int8 kernels at
+        build time (:meth:`quantize`); needs a calibration source:
+        ``calib_data`` (a DataIter; ``calib_mode`` naive|entropy, default
+        env ``MXNET_TPU_INT8_CALIB_MODE`` or entropy) or ``calib_table``
+        (a ``CalibrationTable`` / path; default env
+        ``MXNET_TPU_INT8_TABLE``). ``excluded_sym_names`` (plus env
+        ``MXNET_TPU_INT8_EXCLUDE``) keeps named nodes fp32.
     """
 
     def __init__(self, symbol, params=None, ctx=None, input_shapes=None,
                  batch_sizes=None, group2ctx=None, warmup=True,
-                 batch_axis=0, dtype=_np.float32):
+                 batch_axis=0, dtype=_np.float32, quantize=None,
+                 calib_data=None, calib_mode=None, calib_table=None,
+                 excluded_sym_names=None, num_calib_examples=None):
         from ..context import current_context
 
         if batch_axis != 0:
@@ -124,8 +143,17 @@ class Predictor:
         self._lock = threading.Lock()
         self._pending = {}         # MXPredSetInput state
         self._outputs = None
+        self._quant = None         # quantization identity (see quantize())
+        self._fp32_state = None    # pre-quantization (symbol, args, auxs)
+        self.calibration_table = None
         self.warmup_ms = 0.0
         self.warmup_cache_hits = 0
+        if quantize:
+            self.quantize(quantized_dtype=(quantize if isinstance(
+                quantize, str) else "int8"), calib_data=calib_data,
+                calib_mode=calib_mode, calib_table=calib_table,
+                excluded_sym_names=excluded_sym_names,
+                num_calib_examples=num_calib_examples)
         if warmup and self._input_tails is not None:
             from .. import capture as _capture
 
@@ -215,6 +243,176 @@ class Predictor:
 
         return NDArray(jax.device_put(v._data, tgt), self._ctx)
 
+    # ------------------------------------------------------------ quantization
+    @property
+    def quantization(self):
+        """Quantization identity of the served graph (dtype, calib mode,
+        table digest, excluded nodes), or None for an fp32/bf16
+        predictor. Feeds the AOT fingerprint and batcher forensics."""
+        return dict(self._quant) if self._quant else None
+
+    @property
+    def quant_tag(self):
+        """Forensic suffix naming the executable dtype (empty for
+        fp32/bf16) — the shared tag the BatchServer and process-replica
+        sentinels append to health-check messages."""
+        q = self._quant
+        return f" ({q['dtype']} executable)" if q else ""
+
+    def quantize(self, quantized_dtype="int8", calib_data=None,
+                 calib_mode=None, calib_table=None,
+                 excluded_sym_names=None, num_calib_examples=None,
+                 fold_bn=True):
+        """Make this Predictor serve REAL int8 executables: fold
+        BatchNorm, quantize the graph (``quantize_mode='full'`` —
+        int8 operands, int32 MXU accumulation, fp32 only at the
+        boundary), and rebuild every bucket executable from the
+        quantized symbol. Calibration comes from ``calib_data``
+        (running :func:`contrib.quantization.calibrate`; the resulting
+        table is kept on ``self.calibration_table`` for shipping) or
+        from a pre-shipped ``calib_table`` — which is validated against
+        THIS model first (stale table -> ``CalibrationMismatchError``,
+        docs/quantization.md).
+
+        Re-quantizing (recalibration) always starts from the original
+        fp32 graph, clears the executor cache, and records a structured
+        retrace reason — a recalibrated model never reuses a stale
+        compiled program."""
+        from .. import capture as _capture
+        from ..contrib import quantization as _q
+
+        if quantized_dtype != "int8":
+            raise MXNetError("Predictor.quantize serves symmetric int8 "
+                             f"kernels only, got {quantized_dtype!r}")
+        if self._fp32_state is None:
+            self._fp32_state = (self._symbol, dict(self._arg_params),
+                                dict(self._aux_params))
+        sym, args, auxs = self._fp32_state
+        if fold_bn:
+            sym, args, auxs = _q.fold_batch_norm(sym, args, auxs)
+        excluded = list(excluded_sym_names or ())
+        env_ex = os.environ.get("MXNET_TPU_INT8_EXCLUDE", "").strip()
+        if env_ex:
+            excluded += [x.strip() for x in env_ex.split(",") if x.strip()]
+        if calib_table is not None and calib_data is not None:
+            raise MXNetError(
+                "Predictor.quantize: pass calib_table OR calib_data, "
+                "not both (a pre-shipped table and a fresh calibration "
+                "run cannot both win)")
+        if calib_table is None and calib_data is None:
+            env_table = os.environ.get("MXNET_TPU_INT8_TABLE", "").strip()
+            if env_table:
+                calib_table = env_table
+        # a retained training head's label args are zero-filled during
+        # the calibration forward, exactly like _build_executor does
+        label_names = tuple(n for n in sym.list_arguments()
+                            if n.endswith("label"))
+        if calib_table is not None:
+            if isinstance(calib_table, str):
+                calib_table = _q.CalibrationTable.load(calib_table)
+            table = calib_table
+        elif calib_data is not None:
+            table = _q.calibrate(
+                sym, args, auxs, calib_data,
+                calib_mode=(calib_mode
+                            or os.environ.get("MXNET_TPU_INT8_CALIB_MODE",
+                                              "").strip() or "entropy"),
+                data_names=tuple(self.input_names),
+                label_names=label_names,
+                num_calib_examples=num_calib_examples)
+        else:
+            raise MXNetError(
+                "Predictor.quantize needs a calibration source: "
+                "calib_data, calib_table, or MXNET_TPU_INT8_TABLE")
+        qsym, qargs, qaux = _q.quantize_model(
+            sym, args, auxs, data_names=tuple(self.input_names),
+            label_names=label_names, excluded_sym_names=excluded,
+            quantized_dtype=quantized_dtype, quantize_mode="full",
+            calib_table=table)
+        base_digest = _q.symbol_digest(sym)  # the folded fp32 structure:
+        prev = self._quant                   # stable across recalibration
+        new_args = {k: self._place(v) for k, v in qargs.items()}
+        new_aux = {k: self._place(v) for k, v in qaux.items()}
+        with self._lock:
+            # atomic with the executor-cache clear: a concurrent predict
+            # building a bucket under this lock must never see the new
+            # symbol against the old params (or vice versa)
+            self._symbol = qsym
+            self._arg_params = new_args
+            self._aux_params = new_aux
+            self._arg_names = qsym.list_arguments()
+            self._aux_names = qsym.list_auxiliary_states()
+            self.output_names = qsym.list_outputs()
+            self._symbol_digest = None  # recompute for the new graph
+            self._quant = {
+                "dtype": quantized_dtype, "mode": "full",
+                "calib_mode": table.calib_mode,
+                "table_digest": table.digest(),
+                "excluded": tuple(sorted(excluded)),
+                "base_digest": base_digest,
+            }
+            self.calibration_table = table
+            self._execs.clear()
+        _STATS["serving_quantized_predictors"] += 1
+        self._note_threshold_drift(_capture, prev, base_digest,
+                                   table.digest())
+        return self
+
+    def _note_threshold_drift(self, _capture, prev, base_digest,
+                              table_digest):
+        """A recalibrated table must force a recompile WITH a structured
+        retrace reason — never a silent AOT miss, never a stale hit.
+        Two drift paths: in-process re-quantize (``prev`` carries the
+        old digest) and a fresh build against a populated AOT cache (a
+        sidecar in the cache dir remembers the digest the cached bucket
+        programs were compiled with)."""
+        label = f"serving_quant:{base_digest}"
+        noted = prev is not None and prev["table_digest"] != table_digest
+        if noted:
+            _capture.note_recapture(
+                label, prev["table_digest"], table_digest,
+                reason="int8 recalibration: calibration thresholds "
+                       "changed, bucket executables recompile")
+        cache = _capture.compile_cache()
+        if cache is None:
+            return
+        # one sidecar PER (model, table) — a digest-keyed marker set,
+        # not a single mutable slot: two legitimate calibrations of the
+        # same model sharing a cache dir (A/B canary, bf16/int8 host
+        # pair) must not ping-pong a shared file into spurious
+        # "thresholds changed" notes while the per-table artifacts are
+        # serving correctly
+        sidecar = os.path.join(
+            cache.programs, f"quant_{base_digest}.{table_digest}.table")
+        if os.path.exists(sidecar):
+            return  # this exact table already built here before
+        try:
+            import glob
+
+            others = glob.glob(os.path.join(
+                cache.programs, f"quant_{base_digest}.*.table"))
+        except OSError:
+            others = []
+        # the sidecar set catches CROSS-process drift (fresh build of a
+        # never-seen table against a cache populated by an earlier
+        # process); when the in-process diff above already noted this
+        # recalibration, don't count the same event twice
+        if others and not noted:
+            prev_digest = os.path.basename(
+                max(others, key=os.path.getmtime)).split(".")[1]
+            _capture.note_recapture(
+                label, prev_digest, table_digest,
+                reason="int8 calibration thresholds changed since the "
+                       "AOT-cached build: stale quantized programs "
+                       "cannot be served, recompiling")
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        try:
+            atomic_write_bytes(sidecar, table_digest.encode())
+        except OSError:
+            pass  # best-effort forensics: a full disk never fails
+                  # the quantize itself
+
     # ----------------------------------------------------------------- buckets
     def bucket_for(self, n):
         """Smallest declared bucket that fits ``n`` rows (``n`` itself —
@@ -258,14 +456,30 @@ class Predictor:
         from ..executor import _alloc_for_name
         from ..ndarray.ndarray import zeros as nd_zeros
 
-        known = {n: tuple(v.shape) for n, v in self._arg_params.items()}
-        known.update({n: tuple(v.shape) for n, v in self._aux_params.items()})
         input_shapes = {}
         for name, tail, dt in sig:
             input_shapes[name] = (bucket,) + tuple(tail)
-        known.update(input_shapes)
-        arg_shapes, _, aux_shapes = self._symbol._infer_shape_impl(
-            partial=True, **known)
+        # shape inference exists to size UNFED arguments (label inputs of
+        # a retained training head, auto-created aux). When every arg is
+        # a param or a declared input it is skipped entirely — which
+        # also keeps quantized graphs out of it (the fp32 dummy
+        # evaluation cannot type an int8 kernel, and a full-int8 graph
+        # always carries every weight offline)
+        need_infer = (
+            any(n not in self._arg_params and n not in input_shapes
+                for n in self._arg_names)
+            or any(n not in self._aux_params for n in self._aux_names))
+        if need_infer:
+            known = {n: tuple(v.shape)
+                     for n, v in self._arg_params.items()}
+            known.update({n: tuple(v.shape)
+                          for n, v in self._aux_params.items()})
+            known.update(input_shapes)
+            arg_shapes, _, aux_shapes = self._symbol._infer_shape_impl(
+                partial=True, **known)
+        else:
+            arg_shapes = [None] * len(self._arg_names)
+            aux_shapes = [None] * len(self._aux_names)
         arg_dict = {}
         for name, shape in zip(self._arg_names, arg_shapes):
             if name in self._arg_params:
@@ -303,6 +517,8 @@ class Predictor:
                     f"Predictor: auxiliary state '{name}' is missing "
                     "from params")
         _STATS["serving_compiles"] += 1
+        if self._quant is not None:
+            _STATS["serving_quantized_compiles"] += 1
         if bucket not in self._buckets:
             _STATS["serving_unbucketed"] += 1
         ex = self._symbol.bind(self._ctx, arg_dict, grad_req="null",
@@ -317,29 +533,22 @@ class Predictor:
 
     def _program_fingerprint(self, bucket, sig):
         """Structural identity of one bucket executable for the AOT
-        compile cache: the graph (symbol JSON), the bound param/aux
-        shapes+dtypes, the bucket and input signature. Param VALUES are
-        runtime operands — a re-trained params file reuses the artifact;
-        a changed architecture misses."""
-        import hashlib
-        import json
-
+        compile cache: the graph (symbol JSON, gensym'd op names
+        canonicalized by ``contrib.quantization.symbol_digest``), the
+        bound param/aux shapes+dtypes, the bucket and input signature,
+        and — for quantized predictors — the full quantization identity
+        (dtype, calib mode, CALIBRATION-THRESHOLD digest, exclusions).
+        Param VALUES are runtime operands — a re-trained params file
+        reuses the artifact; a changed architecture or a recalibrated
+        table misses."""
         from .. import capture as _capture
+        from ..contrib.quantization import symbol_digest
 
         base = getattr(self, "_symbol_digest", None)
         if base is None:
-            # canonicalize gensym'd op-node names (fullyconnected0 vs
-            # fullyconnected1 across builds of the same block) so the
-            # digest keys the structure; variable nodes keep their names
-            # (they bind the params)
-            graph = json.loads(self._symbol.tojson())
-            for i, node in enumerate(graph.get("nodes", ())):
-                if node.get("op") != "null":
-                    node["name"] = f"n{i}"
-            base = hashlib.sha256(json.dumps(
-                graph, sort_keys=True).encode()).hexdigest()[:16]
+            base = symbol_digest(self._symbol)
             self._symbol_digest = base
-        return _capture.fingerprint({
+        parts = {
             "symbol": base,
             "args": sorted((k, tuple(v.shape), str(v.dtype))
                            for k, v in self._arg_params.items()),
@@ -347,7 +556,21 @@ class Predictor:
                           for k, v in self._aux_params.items()),
             "bucket": int(bucket), "sig": repr(sig),
             "dtype": str(self._dtype),
-        })
+        }
+        if self._quant is not None:
+            from ..ops.quantization import _nan_poison_enabled
+
+            # quantization identity rides the key ONLY for quantized
+            # predictors (an unconditional key would invalidate every
+            # pre-existing fp32/bf16 artifact for nothing). The poison
+            # flag changes the TRACED program (an extra reduction at
+            # every calibrated boundary), so it keys the artifact too:
+            # a cache populated with poison off must never warm-load
+            # unguarded programs after an operator turns the sentinel
+            # protection on (and vice versa).
+            parts["quant"] = dict(self._quant,
+                                  nan_poison=_nan_poison_enabled())
+        return _capture.fingerprint(parts)
 
     def warmup(self, buckets=None, dtype=None):
         """Compile (bind + trace + XLA-compile) every declared bucket now,

@@ -59,3 +59,8 @@ def pytest_configure(config):
         "markers",
         "fleet: self-healing serving fleet (mxnet_tpu/serving/fleet.py, "
         "docs/serving.md); runs in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "int8: calibrated INT8 serving path (contrib/quantization.py + "
+        "serving, docs/quantization.md); fast cases run in tier-1, the "
+        "bench/accuracy gates carry the slow marker too")

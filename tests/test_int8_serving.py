@@ -1,0 +1,608 @@
+"""INT8 end-to-end serving path (ISSUE 9, docs/quantization.md):
+calibrated quantized Predictor executables — build-time quantization
+parity with the offline flow, bucket-padding exactness on the int8
+grid, AOT warm-start with threshold-change invalidation, CalibrationTable
+as a shippable artifact, NaN-poison visibility through calibrated
+boundaries, and fleet dtype-variant routing with an int8 NaN-storm
+drill."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import capture, profiler, serving
+from mxnet_tpu.contrib.quantization import (CalibrationMismatchError,
+                                            CalibrationTable, calibrate,
+                                            fold_batch_norm,
+                                            quantize_model, symbol_digest)
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.resilience.sentinel import NumericHealthError
+
+pytestmark = pytest.mark.int8
+
+RNG = np.random.RandomState(11)
+TAIL = (3, 8, 8)
+
+
+def _convnet(prefix="q"):
+    """Small quantizable net (conv/relu/pool/fc) with STABLE names so
+    AOT fingerprints survive rebuilds."""
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        name=f"{prefix}_c1")
+    r = sym.Activation(c, act_type="relu", name=f"{prefix}_r1")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name=f"{prefix}_p1")
+    return sym.FullyConnected(p, num_hidden=10, name=f"{prefix}_fc1")
+
+
+def _params(prefix="q", seed=0):
+    rng = np.random.RandomState(seed)
+    feat = 8 * (TAIL[1] // 2) * (TAIL[2] // 2)
+    return {
+        f"{prefix}_c1_weight": mx.nd.array(
+            (rng.randn(8, 3, 3, 3) * 0.2).astype(np.float32)),
+        f"{prefix}_c1_bias": mx.nd.zeros((8,)),
+        f"{prefix}_fc1_weight": mx.nd.array(
+            (rng.randn(10, feat) * 0.1).astype(np.float32)),
+        f"{prefix}_fc1_bias": mx.nd.zeros((10,)),
+    }
+
+
+def _bn_net(prefix="qbn", seed=0):
+    """Conv->BN->relu->FC: exercises the fold_batch_norm build step."""
+    rng = np.random.RandomState(seed)
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        no_bias=True, name=f"{prefix}_c1")
+    b = sym.BatchNorm(c, fix_gamma=False, name=f"{prefix}_bn1")
+    r = sym.Activation(b, act_type="relu", name=f"{prefix}_r1")
+    net = sym.FullyConnected(r, num_hidden=10, name=f"{prefix}_fc1")
+    feat = 8 * TAIL[1] * TAIL[2]
+    params = {
+        f"{prefix}_c1_weight": mx.nd.array(
+            (rng.randn(8, 3, 3, 3) * 0.2).astype(np.float32)),
+        f"{prefix}_bn1_gamma": mx.nd.array(
+            (1 + 0.2 * rng.rand(8)).astype(np.float32)),
+        f"{prefix}_bn1_beta": mx.nd.array(
+            (0.1 * rng.randn(8)).astype(np.float32)),
+        f"{prefix}_bn1_moving_mean": mx.nd.array(
+            (0.05 * rng.randn(8)).astype(np.float32)),
+        f"{prefix}_bn1_moving_var": mx.nd.array(
+            (1 + 0.1 * rng.rand(8)).astype(np.float32)),
+        f"{prefix}_fc1_weight": mx.nd.array(
+            (rng.randn(10, feat) * 0.1).astype(np.float32)),
+        f"{prefix}_fc1_bias": mx.nd.zeros((10,)),
+    }
+    return net, params
+
+
+def _calib_iter(n=16, batch=8, seed=3):
+    x = np.random.RandomState(seed).rand(n, *TAIL).astype(np.float32)
+    return mx.io.NDArrayIter(data=x, batch_size=batch), x
+
+
+# ------------------------------------------------- build-time quantization
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_predictor_int8_matches_offline_bitwise(calib_mode):
+    """Predictor(..., quantize='int8') == the offline quantize_model
+    flow, BITWISE — same thresholds (via the predictor's own
+    CalibrationTable), same graph rewrite, same executable math."""
+    s = _convnet()
+    params = _params()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, dict(params), input_shapes={"data": TAIL},
+                             batch_sizes=(8,), quantize="int8",
+                             calib_data=it, calib_mode=calib_mode)
+    assert pred.quantization["calib_mode"] == calib_mode
+    out = pred.predict(x[:8])[0].asnumpy()
+
+    qsym, qargs, qaux = quantize_model(
+        s, params, {}, calib_table=pred.calibration_table,
+        quantize_mode="full")
+    ex = qsym.bind(mx.cpu(), {**qargs, "data": mx.nd.array(x[:8])},
+                   grad_req="null")
+    ref = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_predictor_int8_folds_batchnorm():
+    """The build step folds BN before quantizing: no BatchNorm (and no
+    aux state) survives into the served graph, and the int8 outputs
+    track the fp32 ones."""
+    net, params = _bn_net()
+    it, x = _calib_iter()
+    fp32 = serving.Predictor(net, dict(params),
+                             input_shapes={"data": TAIL}, batch_sizes=(8,))
+    pred = serving.Predictor(net, dict(params),
+                             input_shapes={"data": TAIL}, batch_sizes=(8,),
+                             quantize="int8", calib_data=it,
+                             calib_mode="naive")
+    ops = {n.op for n in pred._symbol._topo_nodes() if not n.is_var}
+    assert "BatchNorm" not in ops
+    assert "_contrib_quantized_conv" in ops
+    assert pred._aux_params == {}
+    want = fp32.predict(x[:8])[0].asnumpy()
+    got = pred.predict(x[:8])[0].asnumpy()
+    scale = np.abs(want).max()
+    assert np.abs(got - want).max() < 0.2 * scale
+
+
+def test_int8_pad_rows_do_not_perturb_real_rows():
+    """Bucket padding at int8: calibrated thresholds are constants, so
+    the zero pad rows can never shift the quantization grid under the
+    real rows — a 3-row batch through the 8-bucket executable equals the
+    same rows of a full batch BITWISE. (Uncalibrated runtime min/max
+    would fail this: the pad zeros would enter the range.)"""
+    s = _convnet()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(8,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    full = pred.predict(x[:8])[0].asnumpy()
+    part = pred.predict(x[:3])[0].asnumpy()
+    assert part.shape[0] == 3
+    np.testing.assert_array_equal(part, full[:3])
+    # both went through the single bucket-8 executable
+    assert pred.compiled_buckets == [8]
+
+
+def test_predictor_quantize_requires_calibration_source():
+    s = _convnet()
+    with pytest.raises(mx.base.MXNetError, match="calibration source"):
+        serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                          batch_sizes=(4,), quantize="int8")
+
+
+def test_table_and_data_together_is_an_error():
+    """Review regression: a configured table must never silently shadow
+    fresh calibration data (or vice versa) — both together is rejected
+    at both entry points."""
+    s = _convnet()
+    it, _x = _calib_iter()
+    table = calibrate(s, _params(), {}, it, calib_mode="naive")
+    it2, _ = _calib_iter(seed=5)
+    with pytest.raises(mx.base.MXNetError, match="not both"):
+        quantize_model(s, _params(), {}, calib_table=table,
+                       calib_data=it2, quantize_mode="full")
+    with pytest.raises(mx.base.MXNetError, match="not both"):
+        serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                          batch_sizes=(4,), quantize="int8",
+                          calib_table=table, calib_data=it2)
+
+
+def test_int8_excluded_nodes_stay_fp32():
+    s = _convnet()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive",
+                             excluded_sym_names=("q_fc1",))
+    ops = [n.op for n in pred._symbol._topo_nodes() if not n.is_var]
+    assert "FullyConnected" in ops          # stayed fp32
+    assert "_contrib_quantized_conv" in ops  # conv still int8
+    assert pred.quantization["excluded"] == ("q_fc1",)
+    out = pred.predict(x[:4])[0].asnumpy()
+    assert np.isfinite(out).all()
+
+
+# ----------------------------------------------------- NaN poison boundary
+
+def test_int8_nan_input_reaches_dequantized_outputs():
+    """Calibrated quantize boundaries must not LAUNDER non-finite
+    inputs: a NaN-poisoned batch surfaces as NaN in the fp32 outputs
+    (what the serving HealthSentinel polices)."""
+    s = _convnet()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    clean = pred.predict(x[:4])[0].asnumpy()
+    assert np.isfinite(clean).all()
+    xp = x[:4].copy()
+    xp[0, 0, 0, 0] = np.nan
+    out = pred.predict(xp)[0].asnumpy()
+    assert not np.isfinite(out).all()
+
+
+def test_int8_nan_poison_knob_disables(monkeypatch):
+    """MXNET_TPU_INT8_NAN_POISON=0 removes the boundary flag (documented
+    trade: one reduction saved, NaN inputs quantize to ordinary ints)."""
+    monkeypatch.setenv("MXNET_TPU_INT8_NAN_POISON", "0")
+    s = _convnet()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    xp = x[:4].copy()
+    xp[0, 0, 0, 0] = np.nan
+    out = pred.predict(xp)[0].asnumpy()
+    assert np.isfinite(out).all()
+
+
+def test_int8_batch_server_sentinel_names_the_dtype():
+    """A poisoned batch through an int8 BatchServer fails with the
+    executable's dtype in the forensic message; the queue survives."""
+    s = _convnet()
+    it, x = _calib_iter()
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    with serving.BatchServer(pred, max_batch_size=4,
+                             batch_timeout_ms=1.0) as srv:
+        with faults.inject("nan_serving"):
+            fut = srv.submit(x[:1])
+            with pytest.raises(NumericHealthError, match="int8"):
+                fut.result(timeout=10)
+        ok = srv.submit(x[:1]).result(timeout=10)
+    assert np.isfinite(ok[0]).all()
+
+
+# ------------------------------------------------------- calibration table
+
+def test_calibration_table_save_load_roundtrip(tmp_path):
+    s = _convnet()
+    it, _x = _calib_iter()
+    table = calibrate(s, _params(), {}, it, calib_mode="entropy")
+    assert table.num_examples == 16
+    assert table.model_digest == symbol_digest(s)
+    path = str(tmp_path / "model.calib.json")
+    table.save(path)
+    loaded = CalibrationTable.load(path)
+    assert loaded.thresholds == table.thresholds
+    assert loaded.calib_mode == "entropy"
+    assert loaded.num_examples == table.num_examples
+    assert loaded.digest() == table.digest()
+    assert loaded.model_digest == table.model_digest
+
+
+def test_predictor_quantizes_from_shipped_table_without_data(tmp_path):
+    """The serving-host flow: quantize from a table file alone — no
+    calibration data anywhere near the host — and match the
+    calibration-host build bitwise."""
+    s = _convnet()
+    params = _params()
+    it, x = _calib_iter()
+    src = serving.Predictor(s, dict(params), input_shapes={"data": TAIL},
+                            batch_sizes=(8,), quantize="int8",
+                            calib_data=it, calib_mode="naive")
+    path = str(tmp_path / "t.json")
+    src.calibration_table.save(path)
+    dst = serving.Predictor(s, dict(params), input_shapes={"data": TAIL},
+                            batch_sizes=(8,), quantize="int8",
+                            calib_table=path)
+    np.testing.assert_array_equal(src.predict(x[:8])[0].asnumpy(),
+                                  dst.predict(x[:8])[0].asnumpy())
+
+
+def test_stale_table_is_an_error_not_silent_accuracy_loss():
+    """Threshold-drift detection: a table calibrated for one model
+    applied to another raises the structured CalibrationMismatchError
+    (model digest AND missing targets), and a re-trained weight that
+    left its calibrated range is caught too."""
+    a = _convnet("a")
+    b = _convnet("b")
+    it, _x = _calib_iter()
+    table = calibrate(a, _params("a"), {}, it, calib_mode="naive")
+    with pytest.raises(CalibrationMismatchError) as ei:
+        quantize_model(b, _params("b"), {}, calib_table=table,
+                       quantize_mode="full")
+    assert ei.value.missing  # structured: names the uncovered targets
+    # weight drift on the RIGHT model: scale one weight far out of range
+    drifted = _params("a")
+    drifted["a_c1_weight"] = drifted["a_c1_weight"] * 100.0
+    with pytest.raises(CalibrationMismatchError) as ei:
+        quantize_model(a, drifted, {}, calib_table=table,
+                       quantize_mode="full")
+    assert ei.value.drifted
+    assert profiler.dispatch_stats()["calib_mismatches"] >= 2
+
+
+def test_calibration_forces_lazy_bulk_values():
+    """Review regression: the device-side collectors must resolve lazy
+    bulk-segment placeholders (NDArray._force) before device math — a
+    table validated against params produced inside engine.bulk used to
+    hand jnp a placeholder."""
+    from mxnet_tpu import engine
+
+    s = _convnet()
+    it, _x = _calib_iter()
+    table = calibrate(s, _params(), {}, it, calib_mode="naive")
+    with engine.bulk(16):
+        lazy = {k: v * 1.0 for k, v in _params().items()}  # placeholders
+        table.validate_for(s, arg_params=lazy)  # must not blow up
+    qsym, qargs, _ = quantize_model(s, _params(), {}, calib_table=table,
+                                    quantize_mode="full")
+    assert qsym is not None
+
+
+def test_calib_counters_surface_in_dispatch_stats():
+    profiler.reset_dispatch_stats()
+    s = _convnet()
+    it, _x = _calib_iter()
+    calibrate(s, _params(), {}, it, calib_mode="entropy")
+    st = profiler.dispatch_stats()
+    assert st["calib_batches"] >= 2
+    assert st["calib_tensor_syncs"] >= 4
+    assert st["calib_ms"] >= 0
+    for k in ("calib_tables_saved", "calib_tables_loaded",
+              "calib_mismatches", "serving_quantized_predictors",
+              "serving_quantized_compiles"):
+        assert k in st
+
+
+# ------------------------------------------------------------ AOT round-trip
+
+def test_int8_aot_warm_start_and_recalibration_miss(tmp_path, monkeypatch):
+    """The acceptance-criteria round trip: (1) a rebuilt int8 Predictor
+    warm-loads every bucket executable from the AOT cache
+    (warmup_cache_hits >= 1); (2) a RECALIBRATED table can never hit the
+    stale artifacts — fresh compiles, plus a structured retrace reason
+    naming the threshold change."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+    s = _convnet()
+    params = _params()
+    it1, x = _calib_iter(seed=3)
+    t1 = calibrate(s, params, {}, it1, calib_mode="naive")
+
+    def build(table):
+        return serving.Predictor(_convnet(), _params(),
+                                 input_shapes={"data": TAIL},
+                                 batch_sizes=(2, 4), quantize="int8",
+                                 calib_table=table)
+
+    capture.reset_stats()
+    cold = build(t1)
+    st = capture.stats()
+    assert st["aot_cache_writes"] >= 2   # one artifact per bucket
+    assert cold.warmup_cache_hits == 0
+
+    capture.reset_stats()
+    warm = build(t1)
+    st = capture.stats()
+    assert warm.warmup_cache_hits >= 1   # fleet-restart warm start
+    assert st["aot_cache_hits"] >= 2
+    assert st["aot_cache_misses"] == 0
+    np.testing.assert_array_equal(cold.predict(x[:4])[0].asnumpy(),
+                                  warm.predict(x[:4])[0].asnumpy())
+
+    # recalibrate on different data -> different thresholds -> miss
+    scaled = mx.io.NDArrayIter(
+        data=(np.random.RandomState(99).rand(16, *TAIL) * 3)
+        .astype(np.float32), batch_size=8)
+    t2 = calibrate(s, params, {}, scaled, calib_mode="naive")
+    assert t2.digest() != t1.digest()
+    capture.reset_stats()
+    capture.clear_retrace_log()
+    recal = build(t2)
+    st = capture.stats()
+    assert recal.warmup_cache_hits == 0  # never a stale-program hit
+    assert st["aot_cache_hits"] == 0
+    assert st["aot_cache_misses"] >= 2
+    reasons = [e["reason"] for e in capture.retrace_log()
+               if e["label"].startswith("serving_quant:")]
+    assert any("calibration thresholds changed" in r for r in reasons)
+
+
+def test_int8_requantize_in_process_records_retrace(tmp_path, monkeypatch):
+    """Recalibrating a LIVE predictor clears its executors and records
+    the threshold change as a structured retrace."""
+    s = _convnet()
+    it, x = _calib_iter(seed=3)
+    pred = serving.Predictor(s, _params(), input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    first = pred.predict(x[:4])[0].asnumpy()
+    d1 = pred.quantization["table_digest"]
+    capture.clear_retrace_log()
+    scaled = mx.io.NDArrayIter(data=(x * 5).astype(np.float32),
+                               batch_size=8)
+    pred.quantize(calib_data=scaled, calib_mode="naive")
+    assert pred.quantization["table_digest"] != d1
+    assert pred.compiled_buckets == []   # stale executables dropped
+    reasons = [e["reason"] for e in capture.retrace_log()]
+    assert any("recalibration" in r for r in reasons)
+    out = pred.predict(x[:4])[0].asnumpy()
+    assert np.isfinite(out).all()
+    assert not np.array_equal(out, first)  # new grid, new rounding
+
+
+def test_nan_poison_knob_keys_the_aot_fingerprint(tmp_path, monkeypatch):
+    """Review regression: the poison flag changes the traced program, so
+    a cache populated with poison ON must not serve its artifacts to a
+    poison-OFF build (and vice versa) — flipping the knob recompiles
+    with the correct semantics instead of warm-loading the other
+    variant."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+    it, x = _calib_iter()
+    table = calibrate(_convnet(), _params(), {}, it, calib_mode="naive")
+
+    def build():
+        return serving.Predictor(_convnet(), _params(),
+                                 input_shapes={"data": TAIL},
+                                 batch_sizes=(4,), quantize="int8",
+                                 calib_table=table)
+
+    monkeypatch.setenv("MXNET_TPU_INT8_NAN_POISON", "1")
+    build()                       # populate the cache, poison ON
+    monkeypatch.setenv("MXNET_TPU_INT8_NAN_POISON", "0")
+    capture.reset_stats()
+    off = build()
+    assert capture.stats()["aot_cache_hits"] == 0  # no cross-knob hit
+    xp = x[:4].copy()
+    xp[0, 0, 0, 0] = np.nan
+    assert np.isfinite(off.predict(xp)[0].asnumpy()).all()  # OFF semantics
+    monkeypatch.setenv("MXNET_TPU_INT8_NAN_POISON", "1")
+    capture.reset_stats()
+    on = build()
+    assert capture.stats()["aot_cache_hits"] >= 1  # poison-ON cache warm
+    assert not np.isfinite(on.predict(xp)[0].asnumpy()).all()
+
+
+def test_requantize_records_exactly_one_retrace(tmp_path, monkeypatch):
+    """Review regression: one in-process recalibration is ONE forensic
+    event even with the compile cache (and its sidecar) enabled — the
+    cross-process sidecar note must not double-count it."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+    it, x = _calib_iter(seed=3)
+    pred = serving.Predictor(_convnet(), _params(),
+                             input_shapes={"data": TAIL},
+                             batch_sizes=(4,), quantize="int8",
+                             calib_data=it, calib_mode="naive")
+    capture.clear_retrace_log()
+    scaled = mx.io.NDArrayIter(data=(x * 5).astype(np.float32),
+                               batch_size=8)
+    pred.quantize(calib_data=scaled, calib_mode="naive")
+    entries = [e for e in capture.retrace_log()
+               if e["label"].startswith("serving_quant:")]
+    assert len(entries) == 1, entries
+
+
+def test_alternating_tables_do_not_ping_pong_retraces(tmp_path,
+                                                      monkeypatch):
+    """Review regression: two legitimate calibrations of the same model
+    sharing one cache dir (A/B canary) note a threshold change at most
+    once per never-seen table — rebuilding either afterwards is quiet
+    (the per-table artifacts are serving correctly)."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+    it, x = _calib_iter(seed=3)
+    params = _params()
+    t1 = calibrate(_convnet(), params, {}, it, calib_mode="naive")
+    scaled = mx.io.NDArrayIter(data=(x * 5).astype(np.float32),
+                               batch_size=8)
+    t2 = calibrate(_convnet(), params, {}, scaled, calib_mode="naive")
+
+    def build(table):
+        return serving.Predictor(_convnet(), _params(),
+                                 input_shapes={"data": TAIL},
+                                 batch_sizes=(4,), quantize="int8",
+                                 calib_table=table)
+
+    capture.clear_retrace_log()
+    build(t1)
+    build(t2)   # never-seen table: one note
+    build(t1)   # known table: quiet
+    build(t2)   # known table: quiet
+    entries = [e for e in capture.retrace_log()
+               if e["label"].startswith("serving_quant:")]
+    assert len(entries) == 1, entries
+
+
+# ------------------------------------------------------------------- fleet
+
+CALIB_X = RNG.rand(16, *TAIL).astype(np.float32)
+
+
+def _int8_factory():
+    calib = mx.io.NDArrayIter(data=CALIB_X, batch_size=8)
+    return serving.Predictor(_convnet("fleet"), _params("fleet"),
+                             input_shapes={"data": TAIL},
+                             batch_sizes=(2,), quantize="int8",
+                             calib_data=calib, calib_mode="naive")
+
+
+def _fp32_factory():
+    return serving.Predictor(_convnet("fleet"), _params("fleet"),
+                             input_shapes={"data": TAIL},
+                             batch_sizes=(2,))
+
+
+@pytest.mark.fleet
+def test_fleet_dtype_variants_route_independently():
+    x = np.ones((1, *TAIL), np.float32) * 0.5
+    with serving.Fleet({"m": {"fp32": _fp32_factory,
+                              "int8": _int8_factory}},
+                       replicas=1, probe_interval_ms=200,
+                       server_kw={"batch_timeout_ms": 1.0}) as fleet:
+        assert fleet.models() == ["m@fp32", "m@int8"]
+        assert fleet.variants("m") == ["fp32", "int8"]
+        r32 = fleet.submit(x, deadline_ms=10000, model="m",
+                           variant="fp32").result(timeout=30)
+        r8 = fleet.submit(x, deadline_ms=10000, model="m",
+                          variant="int8").result(timeout=30)
+        scale = np.abs(r32[0]).max()
+        assert np.abs(r32[0] - r8[0]).max() < 0.2 * scale
+        # operator surfaces accept variant addressing too (review
+        # regression: replicas()/replica_states() used to require the
+        # internal 'm@int8' key)
+        assert fleet.replica_states("m", variant="int8") == ["HEALTHY"]
+        assert len(fleet.replicas("m", variant="fp32")) == 1
+        with pytest.raises(mx.base.MXNetError, match="serves models"):
+            fleet.submit(x, model="m", variant="fp16").result(timeout=5)
+
+
+@pytest.mark.fleet
+def test_fleet_nan_storm_on_int8_replica(monkeypatch, tmp_path):
+    """The replica_nan_storm drill on an INT8 replica: the poison flows
+    through the quantized executable (boundary NaN flag), the sentinel
+    fails only the victim's batches, the router retries them onto the
+    healthy sibling, and the victim is recycled and warm-restarted from
+    the AOT cache."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+    serving.reset_stats()
+    x = np.ones((1, *TAIL), np.float32) * 0.5
+    with serving.Fleet(_int8_factory, replicas=2, probe_interval_ms=50,
+                       breaker_k=2, retries=2, backoff_ms=1,
+                       breaker_cooldown_ms=100,
+                       server_kw={"batch_timeout_ms": 1.0}) as fleet:
+        baseline = fleet.submit(x, deadline_ms=10000).result(timeout=30)
+        victim_rid = fleet.replicas()[0].rid
+        monkeypatch.setenv("MXNET_TPU_FAULT_REPLICA", str(victim_rid))
+        with faults.inject("replica_nan_storm", times=3) as f:
+            futs = [fleet.submit(x, deadline_ms=10000) for _ in range(8)]
+            results = [fu.result(timeout=30) for fu in futs]
+        assert f.fired >= 1
+        for r in results:  # every retried answer is CORRECT, not just done
+            np.testing.assert_array_equal(r[0], baseline[0])
+        assert fleet.wait_healthy(timeout=30)
+        victim = fleet.replicas()[0]
+        assert victim.predictor.quantization is not None
+        # the rebuilt replica warm-loaded its quantized bucket executables
+        warm_hits = getattr(victim.predictor, "warmup_cache_hits", 0)
+    st = serving.stats()
+    assert st["serving_poisoned_batches"] >= 1
+    assert st["fleet_restarts"] >= 1
+    assert warm_hits >= 1
+
+
+# -------------------------------------------------------------- chaos kind
+
+def test_int8_calib_mismatch_fault_kind_is_structured():
+    """The chaos drill's core assertion, in-process: an armed
+    int8_calib_mismatch turns a valid table apply into the structured
+    mismatch error; disarmed, the same apply succeeds."""
+    s = _convnet()
+    it, _x = _calib_iter()
+    table = calibrate(s, _params(), {}, it, calib_mode="naive")
+    with faults.inject("int8_calib_mismatch") as f:
+        with pytest.raises(CalibrationMismatchError):
+            quantize_model(s, _params(), {}, calib_table=table,
+                           quantize_mode="full")
+    assert f.fired == 1
+    qsym, qargs, qaux = quantize_model(s, _params(), {},
+                                       calib_table=table,
+                                       quantize_mode="full")
+    assert qsym is not None
+
+
+# --------------------------------------------------------------- slow gates
+
+@pytest.mark.slow
+def test_parity_sweep_int8_accuracy_gate():
+    """ROADMAP item 1 acceptance: int8 top-1 agreement vs fp32 >= 0.99
+    on the calibration-held-out batch, both calib modes (the same gate
+    tools/parity_sweep.py --int8 enforces)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import parity_sweep
+    finally:
+        sys.path.pop(0)
+    code, result = parity_sweep.int8_gate()
+    assert code == 0, result
+    assert result["value"] >= 0.99

@@ -56,7 +56,7 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
               "peer_death_recover", "oom_step", "dist_connect_timeout",
               "capture_step", "replica_crash", "replica_hang",
-              "replica_nan_storm")
+              "replica_nan_storm", "int8_calib_mismatch")
 
 
 def _mx():
@@ -473,6 +473,53 @@ def _drill_replica_fault(mx, workdir, kind):
             os.environ["MXNET_TPU_COMPILE_CACHE"] = saved_cache
 
 
+def _drill_int8_calib_mismatch(mx, workdir):
+    """A stale calibration table reaches an int8 quantize (the shipped
+    table no longer matches the model): the apply path must reject it
+    with a STRUCTURED CalibrationMismatchError — mis-scaled int8 serves
+    silently wrong answers, an error is recoverable. Disarmed, the same
+    table applies cleanly and the quantized model serves finite
+    outputs."""
+    import numpy as np
+
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import (CalibrationMismatchError,
+                                                calibrate, quantize_model)
+    from mxnet_tpu.resilience import faults
+
+    rng = np.random.RandomState(3)
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                        name="chaos_c1")
+    r = sym.Activation(c, act_type="relu", name="chaos_r1")
+    net = sym.FullyConnected(r, num_hidden=4, name="chaos_fc1")
+    args = {"chaos_c1_weight": mx.nd.array(
+                (rng.randn(4, 2, 3, 3) * 0.2).astype(np.float32)),
+            "chaos_c1_bias": mx.nd.zeros((4,)),
+            "chaos_fc1_weight": mx.nd.array(
+                (rng.randn(4, 4 * 6 * 6) * 0.1).astype(np.float32)),
+            "chaos_fc1_bias": mx.nd.zeros((4,))}
+    x = rng.rand(8, 2, 6, 6).astype(np.float32)
+    table = calibrate(net, args, {}, mx.io.NDArrayIter(data=x, batch_size=4),
+                      calib_mode="naive")
+    with faults.inject("int8_calib_mismatch") as f:
+        try:
+            quantize_model(net, args, {}, calib_table=table,
+                           quantize_mode="full")
+            return False, "stale table was accepted silently"
+        except CalibrationMismatchError as e:
+            structured = e.model_digest is not None
+    # disarmed: the true table applies and the int8 model serves
+    qsym, qargs, qaux = quantize_model(net, args, {}, calib_table=table,
+                                       quantize_mode="full")
+    ex = qsym.bind(mx.cpu(), {**qargs, "data": mx.nd.array(x)},
+                   grad_req="null")
+    out = ex.forward(is_train=False)[0].asnumpy()
+    ok = f.fired == 1 and structured and np.isfinite(out).all()
+    return ok, (f"fired={f.fired} structured={structured} "
+                f"recovered_finite={bool(np.isfinite(out).all())}")
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -527,6 +574,8 @@ def run_kind(kind, workdir=None):
             return _drill_capture_step(mx, tmp)
         if kind in ("replica_crash", "replica_hang", "replica_nan_storm"):
             return _drill_replica_fault(mx, tmp, kind)
+        if kind == "int8_calib_mismatch":
+            return _drill_int8_calib_mismatch(mx, tmp)
         raise ValueError(f"unknown chaos kind {kind!r}")
     finally:
         faults.reset()

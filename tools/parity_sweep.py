@@ -18,6 +18,13 @@ gradients, in TWO precision modes:
 Requires a TPU-visible jax (skips with a message otherwise). The same
 battery runs in CI via tests/test_tpu_parity.py when
 MXNET_TPU_TEST_PLATFORM lists a TPU platform plus cpu (e.g. 'axon,cpu').
+
+``--int8`` runs the INT8 accuracy gate instead (ROADMAP item 1,
+docs/quantization.md; any backend — it is a numerics gate, not a perf
+one): ResNet-18, BN-folded and quantized through the full int8-grid
+path, must keep top-1 agreement with fp32 >= 0.99 on a
+calibration-held-out synthetic batch, for BOTH calibration modes
+(naive and entropy). One JSON line, non-zero exit on regression.
 """
 from __future__ import annotations
 
@@ -118,6 +125,90 @@ def battery():
     ]
 
 
+# ---------------------------------------------------------------------------
+# INT8 accuracy gate (ROADMAP item 1): the deploy-blocking check that a
+# calibrated full-int8 ResNet agrees with fp32 on held-out data. Runs on
+# any backend — quantization numerics are backend-portable by design
+# (symmetric int8 grid, int32 accumulation).
+# ---------------------------------------------------------------------------
+
+INT8_AGREEMENT_GATE = 0.99
+
+
+def int8_gate(classes=10, hw=32, calib_n=64, holdout_n=128, seed=0):
+    """Top-1 agreement of the full-int8 ResNet-18 vs fp32, per calib
+    mode, on a synthetic batch HELD OUT from calibration. Returns
+    (exit_code, result dict) and prints the one-line JSON.
+
+    The synthetic batch is GAUSSIAN (the distribution of normalized
+    images) — entropy/KL calibration clips distribution tails by
+    design, which is exactly right for gaussian-tailed data but
+    pathological on tail-free uniform noise (it would clip real mass;
+    the repo's own calibration tests document the same effect). Model
+    init is seeded so the gate is a deterministic regression check."""
+    import mxnet_tpu as mx
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.contrib.quantization import (calibrate, fold_batch_norm,
+                                                quantize_model)
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    net = vision.resnet18_v1(classes=classes, thumbnail=True)
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 3, hw, hw)))
+    s = net(sym.Variable("data"))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    fargs = {k: v for k, v in params.items() if k in s.list_arguments()}
+    fauxs = {k: v for k, v in params.items()
+             if k in s.list_auxiliary_states()}
+    fs, fargs, fauxs = fold_batch_norm(s, fargs, fauxs)
+
+    calib_x = rng.randn(calib_n, 3, hw, hw).astype(np.float32)
+    holdout = rng.randn(holdout_n, 3, hw, hw).astype(np.float32)
+    ref = fs.bind(mx.cpu(), {**fargs, "data": mx.nd.array(holdout)},
+                  grad_req="null").forward(is_train=False)[0].asnumpy()
+
+    agreement = {}
+    ok_all = True
+    for mode in ("naive", "entropy"):
+        t0 = time.time()
+        table = calibrate(fs, fargs, fauxs,
+                          mx.io.NDArrayIter(data=calib_x, batch_size=32),
+                          calib_mode=mode)
+        qsym, qargs, qaux = quantize_model(fs, fargs, fauxs,
+                                           calib_table=table,
+                                           quantize_mode="full")
+        got = qsym.bind(mx.cpu(), {**qargs, "data": mx.nd.array(holdout)},
+                        grad_req="null") \
+            .forward(is_train=False)[0].asnumpy()
+        agree = float((ref.argmax(1) == got.argmax(1)).mean())
+        agreement[mode] = round(agree, 4)
+        ok = agree >= INT8_AGREEMENT_GATE
+        ok_all = ok_all and ok
+        print(f"[int8] {mode:8s} top-1 agreement {agree:.4f} "
+              f"(gate {INT8_AGREEMENT_GATE}) "
+              f"{'ok' if ok else 'FAIL'} ({time.time() - t0:.0f}s)",
+              file=sys.stderr, flush=True)
+
+    result = {
+        "metric": "int8_top1_agreement_min",
+        "value": min(agreement.values()),
+        "unit": "fraction",
+        "vs_baseline": INT8_AGREEMENT_GATE,  # the gate itself
+        "extra": {
+            "agreement": agreement,
+            "gate": INT8_AGREEMENT_GATE,
+            "model": f"resnet18_v1 thumbnail {hw}x{hw}, "
+                     f"{classes} classes",
+            "calib_examples": calib_n,
+            "holdout_examples": holdout_n,
+        },
+    }
+    print(json.dumps(result))
+    return (0 if ok_all else 1), result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--report", default="PARITY_TPU.json")
@@ -126,7 +217,14 @@ def main():
                          "CPU via the test suite, replay cpu-vs-tpu)")
     ap.add_argument("--catalog", default="/tmp/mxnet_tpu_opcatalog",
                     help="recorded-call dir for --full (reused if present)")
+    ap.add_argument("--int8", action="store_true",
+                    help="INT8-vs-fp32 top-1 agreement gate (>= 0.99 on "
+                         "the calibration-held-out batch, both calib "
+                         "modes); runs on any backend")
     args = ap.parse_args()
+
+    if args.int8:
+        return int8_gate()[0]
 
     if args.full:
         if not os.path.isdir(args.catalog) or not os.listdir(args.catalog):

@@ -19,9 +19,16 @@ Sections (details on stderr):
            requests (every future resolves to a result or a structured
            error) and degraded p99 <= 3x the healthy baseline; the
            victim must be auto-restarted and re-admitted.
+- int8:    int8-vs-bf16 sweep (docs/quantization.md) — the SAME convnet
+           served as a calibrated-int8 Predictor vs a bf16 one at batch
+           128, plus a 2-variant Fleet ({model: {bf16, int8}}) proving
+           per-model dtype-variant routing end to end. Gate (chip only;
+           CPU has no int8 MXU path): int8 >= 1.25x bf16 model-level —
+           the ROADMAP item-1 serving gate, measured 1.45x on ResNet-18
+           by tools/bench_int8.py.
 
 Run: JAX_PLATFORMS=cpu python tools/serving_bench.py [--iters N]
-     [--skip-fleet]
+     [--skip-fleet] [--skip-int8]
 """
 from __future__ import annotations
 
@@ -213,10 +220,125 @@ def bench_fleet(mx, serving, replicas=4, clients=8, per_client=40):
     }
 
 
+# the int8-vs-bf16 release gate lives in ONE place (bench_int8.py owns
+# the model-level measurement; this sweep enforces the same bar on the
+# Predictor path) so a retune can never fork the threshold
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_int8 import GATE_INT8_VS_BF16  # noqa: E402
+
+
+def _int8_sym_params(mx, channels=16, hidden=10, hw=16):
+    """A quantizable convnet (conv/relu/pool/fc — the int8-grid op set)
+    with deterministic params; big enough that the int8 matmul path
+    dominates at batch 128."""
+    import numpy as np
+
+    s = mx.sym.Convolution(mx.sym.var("data"), kernel=(3, 3), pad=(1, 1),
+                           num_filter=channels, name="qc1")
+    s = mx.sym.Activation(s, act_type="relu", name="qr1")
+    s = mx.sym.Pooling(s, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="qp1")
+    s = mx.sym.FullyConnected(s, num_hidden=hidden, name="qfc1")
+    rng = np.random.RandomState(0)
+    feat = channels * (hw // 2) * (hw // 2)
+    params = {
+        "qc1_weight": (rng.randn(channels, 3, 3, 3) * 0.2)
+        .astype(np.float32),
+        "qc1_bias": np.zeros(channels, np.float32),
+        "qfc1_weight": (rng.randn(hidden, feat) * 0.1).astype(np.float32),
+        "qfc1_bias": np.zeros(hidden, np.float32),
+    }
+    return s, params, (3, hw, hw)
+
+
+def _int8_variant_factories(mx, serving, batch, hw=16):
+    """(bf16 factory, int8 factory) over the SAME model — module-level
+    params so restarts rebuild identically (AOT-cache friendly)."""
+    import numpy as np
+
+    s, params, tail = _int8_sym_params(mx, hw=hw)
+    calib_x = np.random.RandomState(1).rand(64, *tail).astype(np.float32)
+
+    def bf16_factory():
+        import jax.numpy as jnp
+
+        p16 = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+        return serving.Predictor(s, p16, input_shapes={"data": tail},
+                                 batch_sizes=(batch,), dtype=jnp.bfloat16)
+
+    def int8_factory():
+        calib = mx.io.NDArrayIter(data=calib_x, batch_size=32)
+        return serving.Predictor(s, dict(params),
+                                 input_shapes={"data": tail},
+                                 batch_sizes=(batch,), quantize="int8",
+                                 calib_data=calib, calib_mode="entropy")
+
+    return bf16_factory, int8_factory, tail
+
+
+def bench_int8(mx, serving, batch=128, iters=30, on_tpu=False):
+    """int8-vs-bf16 Predictor throughput at batch 128 plus the
+    dtype-variant fleet routing proof. Returns the result dict; the
+    throughput gate applies on a chip only."""
+    import numpy as np
+
+    bf16_factory, int8_factory, tail = _int8_variant_factories(
+        mx, serving, batch)
+    x = np.random.RandomState(2).rand(batch, *tail).astype(np.float32)
+
+    def run(pred):
+        pred.predict(x)  # warm / compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = pred.predict(x)
+        np.asarray(out[0].asnumpy())  # force the chain to the host
+        return iters * batch / (time.perf_counter() - t0)
+
+    p16 = bf16_factory()
+    p8 = int8_factory()
+    bf16_sps = run(p16)
+    int8_sps = run(p8)
+    ratio = int8_sps / bf16_sps
+
+    # dtype-variant fleet: one model, two variants, routed explicitly
+    fleet = serving.Fleet({"convnet": {"bf16": bf16_factory,
+                                       "int8": int8_factory}},
+                          replicas=1, probe_interval_ms=200,
+                          server_kw={"batch_timeout_ms": 1.0})
+    try:
+        r16 = fleet.submit(x[:1], deadline_ms=30000, model="convnet",
+                           variant="bf16").result(timeout=60)
+        r8 = fleet.submit(x[:1], deadline_ms=30000, model="convnet",
+                          variant="int8").result(timeout=60)
+        variants = fleet.variants("convnet")
+        scale = float(np.abs(np.asarray(r16[0],
+                                        np.float32)).max()) or 1.0
+        variant_close = bool(np.abs(
+            np.asarray(r16[0], np.float32)
+            - np.asarray(r8[0], np.float32)).max() < 0.25 * scale)
+    finally:
+        fleet.close()
+    gate_ok = (not on_tpu) or ratio >= GATE_INT8_VS_BF16
+    return {
+        "batch": batch,
+        "bf16_samples_per_s": round(bf16_sps, 1),
+        "int8_samples_per_s": round(int8_sps, 1),
+        "int8_vs_bf16": round(ratio, 3),
+        "gate_int8_vs_bf16": GATE_INT8_VS_BF16,
+        "gate": ("ok" if ratio >= GATE_INT8_VS_BF16 else "FAIL")
+                if on_tpu else "skipped (no chip)",
+        "fleet_variants": variants,
+        "variant_outputs_close": variant_close,
+        "int8_warmup_cache_hits": p8.warmup_cache_hits,
+        "gate_ok": gate_ok,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=1000)
     ap.add_argument("--skip-fleet", action="store_true")
+    ap.add_argument("--skip-int8", action="store_true")
     args = ap.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -248,6 +370,21 @@ def main(argv=None):
                         shed_policy="reject_new")
     print(f"overload (depth 4): shed {over['shed']} of "
           f"{over['offered']} offered", file=sys.stderr)
+
+    int8 = None
+    int8_ok = True
+    if not args.skip_int8:
+        import jax
+
+        on_tpu = any(d.platform != "cpu" for d in jax.devices())
+        int8 = bench_int8(mx, serving, on_tpu=on_tpu)
+        int8_ok = int8.pop("gate_ok") and int8["variant_outputs_close"]
+        print(f"int8 (batch {int8['batch']}): bf16 "
+              f"{int8['bf16_samples_per_s']:.0f} vs int8 "
+              f"{int8['int8_samples_per_s']:.0f} samples/s "
+              f"({int8['int8_vs_bf16']:.2f}x, gate "
+              f"{int8['gate_int8_vs_bf16']}x -> {int8['gate']}), "
+              f"variants {int8['fleet_variants']}", file=sys.stderr)
 
     fleet = None
     fleet_ok = True
@@ -281,9 +418,11 @@ def main(argv=None):
             "overload_shed": over["shed"],
             "fleet": fleet,
             "fleet_gate_ok": fleet_ok,
+            "int8": int8,
+            "int8_gate_ok": int8_ok,
         },
     }))
-    return 0 if fleet_ok else 1
+    return 0 if (fleet_ok and int8_ok) else 1
 
 
 if __name__ == "__main__":
