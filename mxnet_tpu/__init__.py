@@ -62,7 +62,7 @@ def __getattr__(name):
         "engine": ".engine", "operator": ".operator",
         "npx": ".numpy_extension", "numpy_extension": ".numpy_extension",
         "resilience": ".resilience", "serving": ".serving",
-        "capture": ".capture",
+        "capture": ".capture", "observability": ".observability",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

@@ -64,6 +64,8 @@ import threading
 import time
 
 from . import profiler as _profiler
+from .observability import flight as _obs_flight
+from .observability import trace as _obs_trace
 
 __all__ = ["capture", "CapturedTrainerStep", "CapturedShardedStep",
            "CapturedExec", "CaptureError", "enabled", "aot_enabled",
@@ -185,6 +187,7 @@ def _note_retrace(label, prev_sig, new_sig, reason=None):
         if len(_RETRACE_LOG) > _RETRACE_LOG_CAP:
             del _RETRACE_LOG[:-_RETRACE_LOG_CAP]
     _profiler.record_dispatch(f"capture_retrace:{label}:{reason}")
+    _obs_flight.record("retrace", label=label, reason=reason)
     return entry
 
 
@@ -1135,12 +1138,16 @@ class CapturedTrainerStep:
         self._step_count += 1
         _watchdog.note_step(self._step_count)
         try:
-            with _watchdog.guard("step", detail="capture.CapturedTrainerStep",
-                                 step=self._step_count):
+            with _obs_trace.span("train.captured_step",
+                                 step=self._step_count), \
+                    _watchdog.guard("step",
+                                    detail="capture.CapturedTrainerStep",
+                                    step=self._step_count):
                 _faults.maybe_hang("hang_step")
-                outs, new_state = entry["fn"](
-                    [x_nd.data_, y_nd.data_],
-                    [c._data for c in entry["cells"]], dyn)
+                with _obs_trace.span("captured.execute"):
+                    outs, new_state = entry["fn"](
+                        [x_nd.data_, y_nd.data_],
+                        [c._data for c in entry["cells"]], dyn)
         except _watchdog.StallError as e:
             if not self._stall_rollback(e):
                 # the stalled step never applied: un-advance the replay's

@@ -22,6 +22,7 @@ import threading
 import numpy as np
 
 from ... import ndarray as nd
+from ...observability import trace as _obs_trace
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn",
@@ -199,6 +200,19 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __iter__(self):
+        # each next() is spanned as the step timeline's data-wait phase:
+        # the time the training loop stalls on input, not the time the
+        # consumer spends using the batch (docs/observability.md)
+        it = self._iter_impl()
+        while True:
+            with _obs_trace.span("step.data_wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for batch_idx in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch_idx])

@@ -14,6 +14,7 @@ import warnings
 
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from ..observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 from .parameter import Parameter
@@ -114,13 +115,7 @@ class Trainer:
             with _watchdog.guard(
                     "step", detail="gluon.Trainer.step",
                     step=getattr(self._sentinel, "_step", None)):
-                _faults.maybe_hang("hang_step")
-                self._allreduce_grads()
-                _faults.maybe_nan_grads(self._params)
-                if self._sentinel is not None \
-                        and not self._sentinel.before_update(self):
-                    return  # skipped or rolled back per the sentinel policy
-                self._update(ignore_stale_grad)
+                self._update_phases(ignore_stale_grad, allreduce=True)
         except _watchdog.PeerLostError:
             raise  # a dead peer won't come back next step: rolling back
             # and retrying would spin forever; surface the rank instead
@@ -175,17 +170,33 @@ class Trainer:
             with _watchdog.guard(
                     "step", detail="gluon.Trainer.update",
                     step=getattr(self._sentinel, "_step", None)):
-                _faults.maybe_hang("hang_step")
-                _faults.maybe_nan_grads(self._params)
-                if self._sentinel is not None \
-                        and not self._sentinel.before_update(self):
-                    return
-                self._update(ignore_stale_grad)
+                self._update_phases(ignore_stale_grad, allreduce=False)
         except _watchdog.PeerLostError:
             raise  # see step(): dead peers are not transient stalls
         except _watchdog.StallError as e:
             if not self._stall_rollback(e):
                 raise
+
+    def _update_phases(self, ignore_stale_grad, allreduce):
+        """The guarded step body, shared by step() and update(), with
+        each phase under a trace span (docs/observability.md): one
+        training step yields a phase-labeled ``train.step`` timeline —
+        allreduce, sentinel check, optimizer sweep."""
+        with _obs_trace.span("train.step",
+                             entry="step" if allreduce else "update",
+                             step=getattr(self._sentinel, "_step", None)):
+            _faults.maybe_hang("hang_step")
+            if allreduce:
+                with _obs_trace.span("step.allreduce"):
+                    self._allreduce_grads()
+            _faults.maybe_nan_grads(self._params)
+            if self._sentinel is not None:
+                with _obs_trace.span("step.sentinel"):
+                    healthy = self._sentinel.before_update(self)
+                if not healthy:
+                    return  # skipped or rolled back per the sentinel policy
+            with _obs_trace.span("step.update"):
+                self._update(ignore_stale_grad)
 
     def _bulk_size(self):
         """Ops to bulk per lazy segment during _update (0 = eager).

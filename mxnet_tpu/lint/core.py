@@ -48,6 +48,8 @@ RULES = {
     "RD001": "every MXNET_TPU_* env knob read in code is documented",
     "RD002": "every counter mutated is declared in its module's _STATS",
     "RD003": "every fault kind is exercised by tools/chaos_run.py",
+    "RD004": "every registered metric name is documented and every "
+             "trace.span literal name is unique per module",
 }
 
 _WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")

@@ -1,12 +1,16 @@
-"""Registry-drift passes (RD001-RD003).
+"""Registry-drift passes (RD001-RD004).
 
-Three registries drift silently as the codebase grows: env knobs
+Four registries drift silently as the codebase grows: env knobs
 (``MXNET_TPU_*``) appear in code faster than in docs, counters get
 incremented that no ``_STATS`` literal declares (so ``reset`` misses
 them and ``profiler.dispatch_stats()`` only shows them after first
-fire), and fault kinds get added to ``resilience/faults.py`` that
+fire), fault kinds get added to ``resilience/faults.py`` that
 ``tools/chaos_run.py`` never drills — an untested recovery path is an
-untrusted one. These passes pin each registry to its consumers.
+untrusted one — and observability names decay: a metric registered but
+documented nowhere is a dashboard nobody can interpret, and one span
+name opened at two sites makes timelines (and the per-name
+``mxnet_tpu_span_ms`` series) unattributable. These passes pin each
+registry to its consumers.
 
 Policy: RD findings describe *repository state*, not a single line, so
 the acceptance bar is zero — they are fixed (document the knob, declare
@@ -226,9 +230,100 @@ def _check_rd003(project, findings):
             "untrusted one"))
 
 
+# ------------------------------------------------------------------- RD004
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+
+def _documented_token(token, doc_text):
+    """Whole-token occurrence for lowercase identifiers (the metric-name
+    counterpart of RD001's ``_documented``)."""
+    return re.search(r"(?<![A-Za-z0-9_])" + re.escape(token)
+                     + r"(?![A-Za-z0-9_])", doc_text) is not None
+
+
+def _metric_registrations(mod):
+    """``(name, node)`` for metric registrations in one module: calls of
+    ``counter(`` / ``gauge(`` / ``histogram(`` with a literal name,
+    either through a metrics-ish receiver (``metrics.gauge(...)``,
+    ``_obs_metrics.counter(...)``) anywhere, or bare inside
+    ``observability/metrics.py`` itself. ``np.histogram(arr)`` and
+    ``collections.Counter()`` never match: the receiver is not a
+    metrics module and/or the first argument is not a metric-name
+    string literal."""
+    is_metrics_mod = mod.relpath.replace("\\", "/").endswith(
+        "observability/metrics.py")
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and _METRIC_NAME_RE.match(first.value)):
+            continue
+        parts = call_name(node).split(".")
+        if parts[-1] not in _METRIC_FACTORIES:
+            continue
+        if len(parts) == 1:
+            if not is_metrics_mod:
+                continue
+        elif "metrics" not in parts[-2]:
+            continue
+        out.append((first.value, node))
+    return out
+
+
+def _span_sites(mod):
+    """``(name, node)`` for every ``*.span("literal", ...)`` call."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                call_name(node).split(".")[-1] == "span" and node.args \
+                and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def _check_rd004(project, findings):
+    doc_text = project.doc_text()
+    seen_metrics = set()
+    for mod in project.modules():
+        for name, node in _metric_registrations(mod):
+            if name in seen_metrics or _documented_token(name, doc_text):
+                continue
+            if mod.waived("RD004", node.lineno):
+                continue
+            seen_metrics.add(name)
+            findings.append(Finding(
+                "RD004", mod.relpath, node.lineno, "<module>", name,
+                f"metric `{name}` is registered but documented nowhere "
+                "under docs/ (add it to docs/observability.md's metric "
+                "catalog)"))
+        seen_spans = {}
+        for name, node in _span_sites(mod):
+            prev = seen_spans.get(name)
+            if prev is None:
+                seen_spans[name] = node
+                continue
+            if mod.waived("RD004", node.lineno):
+                continue
+            findings.append(Finding(
+                "RD004", mod.relpath, node.lineno, "<module>",
+                f"span:{name}",
+                f"trace span name `{name}` is opened at more than one "
+                f"site in this module (first at line {prev.lineno}) — a "
+                "span name must identify one site per module or its "
+                "timeline entries and mxnet_tpu_span_ms series become "
+                "unattributable"))
+
+
 def run(project):
     findings = []
     _check_rd001(project, findings)
     _check_rd002(project, findings)
     _check_rd003(project, findings)
+    _check_rd004(project, findings)
     return findings

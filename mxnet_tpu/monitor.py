@@ -13,6 +13,9 @@ import re
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from .observability import flight as _obs_flight
+from .observability import metrics as _obs_metrics
+from .observability import trace as _obs_trace
 
 __all__ = ["Monitor"]
 
@@ -26,14 +29,26 @@ class Monitor:
     stat_func : callable(NDArray)->NDArray, default |x|/size (asum_stat).
     pattern : regex matched against tapped names.
     sort : sort output statistics by name.
+    emit : 'print' (reference parity: ``toc_print`` writes to stdout) or
+        'metrics' — stats route through the observability layer instead
+        of ad-hoc prints: each scalar stat sets the
+        ``mxnet_tpu_monitor_stat`` gauge (label: tapped name) and leaves
+        a ``monitor`` flight-recorder event, and each tic()..toc()
+        collection window is one ``monitor.collect`` trace span. The
+        returned ``(step, name, stat_str)`` tuples are identical in both
+        modes — emission is a sink choice, not a semantics change.
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 emit="print"):
         if stat_func is None:
             def asum_stat(x):
                 return x.norm() / x.size ** 0.5
 
             stat_func = asum_stat
+        if emit not in ("print", "metrics"):
+            raise ValueError(f"emit must be 'print' or 'metrics', "
+                             f"got {emit!r}")
         self.stat_func = stat_func
         self.interval = interval
         self.activated = False
@@ -42,6 +57,12 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self.emit = emit
+        self._gauge = _obs_metrics.gauge(
+            "mxnet_tpu_monitor_stat",
+            "latest Monitor tensor statistic, by tapped name",
+            labels=("name",)) if emit == "metrics" else None
+        self._span = None
 
         def stat_helper(name, arr):
             if not self.activated or not self.re_prog.match(name):
@@ -61,6 +82,9 @@ class Monitor:
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
+            if self.emit == "metrics":
+                self._span = _obs_trace.start_span("monitor.collect",
+                                                   step=self.step)
         self.step += 1
 
     def toc(self):
@@ -91,14 +115,30 @@ class Monitor:
                 if not isinstance(v, NDArray):
                     raise MXNetError("the elements of stat function "
                                      "should be NDArray")
-                s += str(float(v.asnumpy().reshape(-1)[0])) + "\t" \
-                    if v.size == 1 else str(v.asnumpy()) + "\t"
+                if v.size == 1:
+                    value = float(v.asnumpy().reshape(-1)[0])
+                    s += str(value) + "\t"
+                    if self._gauge is not None:
+                        self._gauge.set(value, name=k)
+                        _obs_flight.record("monitor", step=n, name=k,
+                                           value=value)
+                else:
+                    s += str(v.asnumpy()) + "\t"
             res.append((n, k, s))
         self.queue = []
+        if self._span is not None:
+            self._span.end(stats=len(res))
+            self._span = None
         return res
 
     def toc_print(self):
-        """Collect and print the stats (monitor.py toc_print)."""
+        """Collect the stats and emit them: reference-parity stdout in
+        ``emit='print'`` mode, metrics/flight-recorder (no print) in
+        ``emit='metrics'`` mode. Returns the collected tuples either
+        way (the reference returns None; callers that want the data
+        without printing used to have no entry point at all)."""
         res = self.toc()
-        for n, k, v in res:
-            print(f"Batch: {n:7d} {k:30s} {v}")
+        if self.emit == "print":
+            for n, k, v in res:
+                print(f"Batch: {n:7d} {k:30s} {v}")
+        return res

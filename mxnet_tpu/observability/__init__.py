@@ -1,0 +1,93 @@
+"""mxnet_tpu.observability — the unified runtime-introspection layer.
+
+The TensorFlow and MXNet systems papers both treat first-class runtime
+introspection — per-step timelines, queue/latency telemetry, exportable
+metrics — as a prerequisite for operating at production scale. This
+package is that layer for the whole runtime (docs/observability.md):
+
+- :mod:`trace` — structured span tracing. ``trace.span(name, **attrs)``
+  opens one timed span (``perf_counter_ns``) under a thread-local
+  trace context; spans nest, propagate across threads
+  (``trace.context``) and across the serving fleet's process-replica
+  pipe (span records ship back with the reply), and land in a bounded
+  ring. One serving request or one training step yields a complete
+  parent/child timeline under one trace id. Off by default
+  (``MXNET_TPU_OBS_TRACE``) with a near-zero disabled cost — the
+  ``tools/obs_bench.py`` gate holds tracing to <= 2% step overhead
+  enabled and ~0 disabled.
+- :mod:`metrics` — a typed metrics registry (counters / gauges /
+  histograms with labels) generalizing the flat ``_STATS`` counter
+  dicts, with a ring-buffered time series and two exporters: JSON-lines
+  (``MXNET_TPU_METRICS_FILE``, flushed on a cadence) and Prometheus
+  text exposition (``metrics.render_prometheus()`` + an optional
+  stdlib-http endpoint). Fleet SLO series (per-model deadline hit-rate,
+  shed rate, p50/p99, breaker state) are derived automatically.
+- :mod:`flight` — the always-on flight recorder: one bounded
+  chronological event log unifying span ends, fault injections,
+  watchdog stalls, capture retrace reasons, checkpoint publishes and
+  fleet state transitions. Watchdog crash reports embed its tail;
+  ``observability.dump()`` / ``tools/obs_dump.py`` dump it on demand.
+
+Everything here is stdlib-only at import so the hot paths (trainer,
+registry, serving) can instrument without dragging in jax.
+"""
+from __future__ import annotations
+
+# Counters are defined BEFORE the submodule imports at the bottom so
+# trace.py / metrics.py / flight.py can `from . import _STATS` during
+# package init (the serving-package pattern; RD002 resolves it).
+_STATS = {
+    "obs_spans": 0,            # span records placed in the local ring
+    "obs_spans_shipped": 0,    # span records ingested from replica pipes
+    "obs_flight_events": 0,    # flight-recorder events recorded
+    "obs_metric_flushes": 0,   # JSON-lines exporter flushes
+    "obs_metric_samples": 0,   # time-series ring samples taken
+    "obs_dumps": 0,            # observability.dump() calls
+}
+
+
+def stats():
+    """All observability counters as one flat dict (merged into
+    ``profiler.dispatch_stats()``)."""
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+from . import trace  # noqa: E402
+from . import metrics  # noqa: E402
+from . import flight  # noqa: E402
+
+# operator story: exporting metrics needs ONLY the env knob — with
+# MXNET_TPU_METRICS_FILE set, the background JSON-lines flusher arms
+# itself the moment the runtime imports this layer (no-op otherwise)
+metrics.maybe_start_flusher()
+
+
+def dump(limit=None):
+    """One self-describing snapshot of the whole layer: the flight
+    recorder (chronological, oldest first), the ended-span ring, the
+    metrics registry and its time series, and the runtime counter dict.
+    This is the payload ``tools/obs_dump.py`` prints and the on-demand
+    counterpart of the crash report's embedded flight tail."""
+    from .. import profiler
+
+    _STATS["obs_dumps"] += 1
+    try:
+        counters = profiler.dispatch_stats()
+    except Exception:
+        counters = {}
+    return {
+        "schema_version": 1,
+        "flight": flight.snapshot(limit=limit),
+        "spans": trace.spans(),
+        "metrics": metrics.snapshot(),
+        "series": metrics.series(),
+        "counters": counters,
+    }
+
+
+__all__ = ["trace", "metrics", "flight", "dump", "stats", "reset_stats"]

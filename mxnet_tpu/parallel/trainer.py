@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import re
 
+from ..observability import trace as _obs_trace
 from .functional import functional_call, param_arrays, aux_arrays, RNG_KEY
 from .mesh import create_mesh
 from .optim import make_update_fn
@@ -391,6 +392,11 @@ class ShardedTrainer:
         manifest so schedule-aware drivers can rewind their data
         pipeline when the checkpoint cadence is coarser than one step).
         """
+        with _obs_trace.span("train.sharded_step",
+                             step=self._step_count + 1):
+            return self._step_impl(x, y, microbatches)
+
+    def _step_impl(self, x, y, microbatches):
         import warnings
 
         import jax
@@ -406,32 +412,34 @@ class ShardedTrainer:
             x = x.data_
         if isinstance(y, NDArray):
             y = y.data_
-        if self._multiproc:
-            import numpy as np
+        with _obs_trace.span("sharded.h2d"):
+            if self._multiproc:
+                import numpy as np
 
-            def assemble(a):
-                # a single-device local array (NDArray.data_) is still a
-                # process-local shard: pull to host and assemble globally
-                if isinstance(a, jax.Array) and \
-                        a.sharding.num_devices > 1:
-                    return a  # already a global array
-                return jax.make_array_from_process_local_data(
-                    self._batch_sharding, np.asarray(a))
+                def assemble(a):
+                    # a single-device local array (NDArray.data_) is still
+                    # a process-local shard: pull to host and assemble
+                    # globally
+                    if isinstance(a, jax.Array) and \
+                            a.sharding.num_devices > 1:
+                        return a  # already a global array
+                    return jax.make_array_from_process_local_data(
+                        self._batch_sharding, np.asarray(a))
 
-            x = assemble(x)
-            y = assemble(y)
-        else:
-            # skip the put when the batch already sits on the mesh with
-            # the right sharding (the steady-state training loop) — the
-            # redundant device_put costs ~0.5% of step time (PERF.md
-            # round-5 wrapper A/B)
-            bs = self._batch_sharding
-            if not (isinstance(x, jax.Array) and
-                    x.sharding.is_equivalent_to(bs, x.ndim)):
-                x = jax.device_put(x, bs)
-            if not (isinstance(y, jax.Array) and
-                    y.sharding.is_equivalent_to(bs, y.ndim)):
-                y = jax.device_put(y, bs)
+                x = assemble(x)
+                y = assemble(y)
+            else:
+                # skip the put when the batch already sits on the mesh
+                # with the right sharding (the steady-state training
+                # loop) — the redundant device_put costs ~0.5% of step
+                # time (PERF.md round-5 wrapper A/B)
+                bs = self._batch_sharding
+                if not (isinstance(x, jax.Array) and
+                        x.sharding.is_equivalent_to(bs, x.ndim)):
+                    x = jax.device_put(x, bs)
+                if not (isinstance(y, jax.Array) and
+                        y.sharding.is_equivalent_to(bs, y.ndim)):
+                    y = jax.device_put(y, bs)
         self._step_count += 1
         _watchdog.note_step(self._step_count)
         rows = int(x.shape[0])
@@ -470,14 +478,16 @@ class ShardedTrainer:
                         detail="parallel.ShardedTrainer.step")
                     _faults.maybe_hang("hang_step")
                     _faults.maybe_oom_step()
-                    if n <= 1:
-                        if self._step is None:  # mesh rebound mid-retry
-                            self._build_step()
-                        self.params, self.aux, self.opt_state, loss = \
-                            self._step(self.params, self.aux,
-                                       self.opt_state, x, y)
-                    else:
-                        loss = self._accum_step(n, x, y)
+                    with _obs_trace.span("sharded.execute",
+                                         microbatches=n):
+                        if n <= 1:
+                            if self._step is None:  # mesh rebound mid-retry
+                                self._build_step()
+                            self.params, self.aux, self.opt_state, loss = \
+                                self._step(self.params, self.aux,
+                                           self.opt_state, x, y)
+                        else:
+                            loss = self._accum_step(n, x, y)
                 break
             except _watchdog.PeerLostError as e:
                 # a dead peer is unrecoverable in place — but with a

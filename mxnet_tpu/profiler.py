@@ -149,7 +149,7 @@ def dump(finished=True, profile_process="worker"):
         json.dump({"traceEvents": events}, f)
 
 
-def dispatch_stats(reset=False):
+def dispatch_stats(reset=False, lock_timeout=None):
     """Eager-dispatch observability counters as a flat dict: per-op
     executable cache hits/misses, jax retraces, donated-buffer dispatches,
     device_put skips, and bulk-segment stats from mxnet_tpu.engine.
@@ -195,28 +195,58 @@ def dispatch_stats(reset=False):
       batch), calib_ms (wall-clock in the collectors),
       calib_tables_saved/loaded, calib_mismatches (stale table/model
       pairs rejected); serving_quantized_predictors/compiles above
+    - observability counters (docs/observability.md): obs_spans/
+      obs_spans_shipped (trace spans recorded locally / ingested from
+      process replicas), obs_flight_events, obs_metric_flushes/
+      obs_metric_samples (JSON-lines exporter), obs_dumps
+
+    The snapshot (and an optional ``reset=True``) runs under the
+    profiler lock, so two concurrent callers — or a caller racing
+    ``reset_dispatch_stats()`` — can never observe a torn snapshot
+    mixing pre- and post-reset counters. ``lock_timeout`` (seconds)
+    bounds the wait for that lock: on expiry the call degrades to an
+    UNLOCKED best-effort snapshot (and skips any requested reset)
+    instead of blocking — the watchdog's crash-report writer uses this,
+    because the stalled thread it is reporting on may be wedged while
+    holding the profiler lock, and forensics beat atomicity there.
     """
-    from . import capture, engine, resilience, serving
+    from . import capture, engine, observability, resilience, serving
     from .contrib import quantization
     from .gluon.data import dataloader
     from .ops import registry
 
-    stats = registry.dispatch_stats()
-    stats.update(engine.bulk_stats())
-    stats.update(resilience.stats())
-    stats.update(serving.stats())
-    stats.update(dataloader.stats())
-    stats.update(capture.stats())
-    stats.update(quantization.stats())
-    if reset:
-        reset_dispatch_stats()
+    if lock_timeout is None:
+        locked = _LOCK.acquire()
+    else:
+        locked = _LOCK.acquire(timeout=lock_timeout)
+    try:
+        stats = registry.dispatch_stats()
+        stats.update(engine.bulk_stats())
+        stats.update(resilience.stats())
+        stats.update(serving.stats())
+        stats.update(dataloader.stats())
+        stats.update(capture.stats())
+        stats.update(quantization.stats())
+        stats.update(observability.stats())
+        if reset and locked:
+            _reset_dispatch_stats_locked()
+    finally:
+        if locked:
+            _LOCK.release()
     return stats
 
 
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
-    serving + dataloader + capture + quantization)."""
-    from . import capture, engine, resilience, serving
+    serving + dataloader + capture + quantization + observability).
+    Takes the profiler lock so a concurrent ``dispatch_stats()`` sees
+    either the pre-reset or the post-reset world, never a mix."""
+    with _LOCK:
+        _reset_dispatch_stats_locked()
+
+
+def _reset_dispatch_stats_locked():
+    from . import capture, engine, observability, resilience, serving
     from .contrib import quantization
     from .gluon.data import dataloader
     from .ops import registry
@@ -229,6 +259,7 @@ def reset_dispatch_stats():
     dataloader.reset_stats()
     capture.reset_stats()
     quantization.reset_stats()
+    observability.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

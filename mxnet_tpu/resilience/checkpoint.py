@@ -77,6 +77,8 @@ import zlib
 
 import numpy as _np
 
+from ..observability import flight as _obs_flight
+from ..observability import trace as _obs_trace
 from . import faults
 
 __all__ = ["CheckpointManager", "CheckpointCorruptError", "atomic_write_bytes"]
@@ -522,7 +524,10 @@ class CheckpointManager:
             # the writer's tobytes() is the one unavoidable copy
             snap = self._snapshot(step, net, trainer, epoch, extra, tag,
                                   copy=False)
-            return self._write_snapshot(snap, tag, final)
+            with _obs_trace.span("ckpt.save", step=int(step), mode="sync"):
+                path = self._write_snapshot(snap, tag, final)
+            _obs_flight.record("ckpt", op="save", step=int(step), tag=tag)
+            return path
         mode = _async_mode()
         snap = self._snapshot(step, net, trainer, epoch, extra, tag,
                               copy=(mode != "fork"))
@@ -539,6 +544,8 @@ class CheckpointManager:
             info["thread"] = thread
             self._async = info
             thread.start()
+        _obs_flight.record("ckpt", op="save_async", step=int(step),
+                           tag=tag)
         return final
 
     def _fork_writer(self, snap, tag, final):
@@ -591,12 +598,23 @@ class CheckpointManager:
         False (plus a warning and ``ckpt_async_failures``) when the
         writer failed or crashed — its debris is left for the GC exactly
         like a killed process's."""
-        import time as _time
-        import warnings
-
         info = self._async
         if info is None:
             return True
+        # the barrier is a real step-stall source: span it (the
+        # "ckpt-stall" phase of the step timeline) and leave the
+        # publish/drop outcome in the flight recorder
+        with _obs_trace.span("step.ckpt_stall", tag=info["tag"]):
+            ok = self._wait_for_async_impl(info, timeout)
+        _obs_flight.record(
+            "ckpt", op="async_published" if ok else "async_failed",
+            tag=info["tag"])
+        return ok
+
+    def _wait_for_async_impl(self, info, timeout):
+        import time as _time
+        import warnings
+
         error = None
         if info["pid"] is not None:
             _STATS["ckpt_async_waits"] += 1
@@ -856,7 +874,15 @@ class CheckpointManager:
             return self._apply(manifest, payloads, net, trainer)
 
     def _apply(self, manifest, payloads, net, trainer):
-        """Apply already-verified payload bytes (one disk read total)."""
+        """Apply already-verified payload bytes (one disk read total),
+        spanned and flight-recorded as one restore."""
+        with _obs_trace.span("ckpt.restore", step=manifest.get("step")):
+            out = self._apply_impl(manifest, payloads, net, trainer)
+        _obs_flight.record("ckpt", op="restore", step=manifest.get("step"),
+                           tag=manifest.get("tag"))
+        return out
+
+    def _apply_impl(self, manifest, payloads, net, trainer):
         kind = manifest.get("kind", "gluon")
         version = manifest.get("format_version", 1)
         if version >= 2:

@@ -86,6 +86,8 @@ import os
 import threading
 import time
 
+from ..observability import flight as _obs_flight
+
 __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "inject", "arm", "disarm", "reset", "active", "get", "stats",
            "reset_stats", "maybe_nan_grads", "checkpoint_write_filter",
@@ -143,7 +145,11 @@ class _Fault:
                 return False
             self.fired += 1
             _STATS["faults_fired"] += 1
-            return True
+        # outside _LOCK: the flight recorder has its own lock, and every
+        # fired fault must leave a chronological event for chaos_run's
+        # "every drill leaves a recorder trail" gate
+        _obs_flight.record("fault", fault=self.kind, call=step)
+        return True
 
     def __repr__(self):
         return (f"_Fault({self.kind!r}, at_step={self.at_step}, "

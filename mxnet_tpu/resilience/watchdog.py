@@ -60,6 +60,7 @@ import tempfile
 import threading
 import time
 
+from ..observability import flight as _obs_flight
 from . import faults as _faults
 
 __all__ = ["StallError", "PeerLostError", "guard", "collective_guard",
@@ -135,11 +136,14 @@ _DEAD_PEERS: set = set()
 def mark_peer_dead(rank):
     """Record that worker ``rank`` is gone. Every subsequent collective
     fails fast with PeerLostError instead of blocking on it."""
+    rank = int(rank)
     with _PEER_LOCK:
-        rank = int(rank)
-        if rank not in _DEAD_PEERS:
+        newly_dead = rank not in _DEAD_PEERS
+        if newly_dead:
             _DEAD_PEERS.add(rank)
             _STATS["watchdog_peer_lost"] += 1
+    if newly_dead:
+        _obs_flight.record("peer", rank=rank, status="dead")
 
 
 def dead_peers():
@@ -374,6 +378,10 @@ def note_peer_recovery(err, manifest=None, old_axes=None, new_axes=None):
     report is the operator's record that the job kept going on fewer
     chips (capacity silently halved is an incident too)."""
     _STATS["watchdog_peer_recoveries"] += 1
+    _obs_flight.record("peer", status="recovered",
+                       ranks=list(getattr(err, "ranks", ()) or ()),
+                       restored_step=None if manifest is None
+                       else manifest.get("step"))
     info = {
         "ranks": list(getattr(err, "ranks", ()) or ()),
         "old_mesh_axes": old_axes,
@@ -477,6 +485,8 @@ def _fire(g):
     writer.join(_REPORT_BUDGET)
     report_path = box.get("path")
     _STATS["watchdog_stalls"] += 1
+    _obs_flight.record("stall", phase=g.phase, detail=g.detail,
+                       timeout_s=g.timeout, step=g.step)
     dead = dead_peers()
     if g.phase == "collective" and dead:
         cls = PeerLostError
@@ -560,9 +570,19 @@ def _write_crash_report(g):
         except Exception:
             ring = []
         try:
-            counters = profiler.dispatch_stats()
+            # bounded lock wait: the stalled thread this report is FOR
+            # may be wedged holding the profiler lock — degrade to an
+            # unlocked snapshot rather than lose the report
+            counters = profiler.dispatch_stats(lock_timeout=1.0)
         except Exception:
             counters = {}
+        try:
+            # the unified event log's tail: spans, faults, retraces,
+            # fleet transitions interleaved in time, oldest first —
+            # the "what happened before the stall" story in one list
+            flight_tail = _obs_flight.snapshot(limit=256)
+        except Exception:
+            flight_tail = []
         report = {
             "schema_version": 1,
             "kind": "stall",
@@ -576,6 +596,7 @@ def _write_crash_report(g):
             "dead_peers": dead_peers(),
             "rng_state": _rng_snapshot(),
             "dispatch_ring": ring,
+            "flight_recorder": flight_tail,
             "counters": counters,
             "env": _env_snapshot(),
         }

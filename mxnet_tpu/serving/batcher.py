@@ -41,6 +41,7 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as _np
 
 from ..base import MXNetError
+from ..observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 from ..resilience.sentinel import HealthSentinel, NumericHealthError
@@ -63,7 +64,8 @@ class ServerClosed(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("feeds", "rows", "sig", "future", "t_submit", "deadline")
+    __slots__ = ("feeds", "rows", "sig", "future", "t_submit", "deadline",
+                 "ctx")
 
     def __init__(self, feeds, rows, sig, deadline):
         self.feeds = feeds
@@ -72,6 +74,9 @@ class _Request:
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline  # absolute perf_counter time, or None
+        # the submitter's trace context: the worker thread re-enters it
+        # so the batch's spans parent under the request/attempt span
+        self.ctx = _obs_trace.current()
 
 
 def _try_resolve(future, result=None, exc=None):
@@ -355,58 +360,75 @@ class BatchServer:
     def _execute(self, batch):
         with self._cond:
             self._inflight = tuple(batch)
+        rows = sum(r.rows for r in batch)
+        bsp = None
         try:
-            # the batch watchdog (MXNET_TPU_WATCHDOG_BATCH_TIMEOUT) bounds
-            # the executable launch: a wedged batch raises StallError into
-            # this worker thread, failing ONLY its own futures below —
-            # the queue keeps serving
-            with _watchdog.guard(
-                    "batch",
-                    detail=f"BatchServer batch "
-                           f"({sum(r.rows for r in batch)} rows, "
-                           f"{len(batch)} request(s))"):
-                _faults.maybe_hang("hang_batch")
-                fused = {name: (batch[0].feeds[name] if len(batch) == 1
-                                else _np.concatenate(
-                                    [r.feeds[name] for r in batch], axis=0))
-                         for name in batch[0].feeds}
-                outs, _n = self.predictor.predict_raw(fused)
-            healthy = True
-            err = None
-            if self.sentinel is not None:
-                # the check runs on the predictor's OUTPUTS — for a
-                # quantized predictor that is the dequantized fp32
-                # boundary, so int8 replicas get the same NaN policing
-                # as fp32 ones; tag the forensic message with the
-                # executable's dtype so crash reports name it
-                tag = getattr(self.predictor, "quant_tag", "")
-                try:
-                    healthy = self.sentinel.check_finite(
-                        outs, what=f"serving batch outputs{tag}")
-                except NumericHealthError as e:
-                    healthy, err = False, e
-            if not healthy:
-                _STATS["serving_poisoned_batches"] += 1
-                err = err or NumericHealthError(
-                    self.sentinel.last_reason or
-                    "non-finite values in serving batch outputs")
+            # spans: re-enter the oldest request's trace context so the
+            # batch timeline (batch-form wait, execute, sentinel)
+            # parents under the submitting request/attempt span — one
+            # connected tree per request (docs/observability.md)
+            with _obs_trace.context(batch[0].ctx), \
+                    _obs_trace.span("serve.batch", rows=rows,
+                                    requests=len(batch)) as bsp:
+                t0_ns = int(batch[0].t_submit * 1e9)
+                _obs_trace.record(
+                    "serve.batch_form", t0_ns,
+                    max(0, time.perf_counter_ns() - t0_ns))
+                # the batch watchdog (MXNET_TPU_WATCHDOG_BATCH_TIMEOUT)
+                # bounds the executable launch: a wedged batch raises
+                # StallError into this worker thread, failing ONLY its
+                # own futures below — the queue keeps serving
+                with _watchdog.guard(
+                        "batch",
+                        detail=f"BatchServer batch "
+                               f"({rows} rows, "
+                               f"{len(batch)} request(s))"):
+                    _faults.maybe_hang("hang_batch")
+                    fused = {name: (batch[0].feeds[name] if len(batch) == 1
+                                    else _np.concatenate(
+                                        [r.feeds[name] for r in batch],
+                                        axis=0))
+                             for name in batch[0].feeds}
+                    with _obs_trace.span("serve.execute"):
+                        outs, _n = self.predictor.predict_raw(fused)
+                healthy = True
+                err = None
+                if self.sentinel is not None:
+                    # the check runs on the predictor's OUTPUTS — for a
+                    # quantized predictor that is the dequantized fp32
+                    # boundary, so int8 replicas get the same NaN
+                    # policing as fp32 ones; tag the forensic message
+                    # with the executable's dtype so crash reports name
+                    # it
+                    tag = getattr(self.predictor, "quant_tag", "")
+                    with _obs_trace.span("serve.sentinel"):
+                        try:
+                            healthy = self.sentinel.check_finite(
+                                outs, what=f"serving batch outputs{tag}")
+                        except NumericHealthError as e:
+                            healthy, err = False, e
+                if not healthy:
+                    _STATS["serving_poisoned_batches"] += 1
+                    err = err or NumericHealthError(
+                        self.sentinel.last_reason or
+                        "non-finite values in serving batch outputs")
+                    for r in batch:
+                        _try_resolve(r.future, exc=err)
+                    return
+                np_outs = [_np.asarray(o) for o in outs]
+                _STATS["serving_batches"] += 1
+                offset = 0
+                t_done = time.perf_counter()
                 for r in batch:
-                    _try_resolve(r.future, exc=err)
-                return
-            np_outs = [_np.asarray(o) for o in outs]
-            _STATS["serving_batches"] += 1
-            offset = 0
-            t_done = time.perf_counter()
-            for r in batch:
-                sl = slice(offset, offset + r.rows)
-                # close() may have failed this future already — first
-                # writer wins
-                if _try_resolve(r.future, result=[
-                        o[sl].copy()
-                        if o.ndim and o.shape[0] == _n else o.copy()
-                        for o in np_outs]):
-                    record_latency(t_done - r.t_submit)
-                offset += r.rows
+                    sl = slice(offset, offset + r.rows)
+                    # close() may have failed this future already — first
+                    # writer wins
+                    if _try_resolve(r.future, result=[
+                            o[sl].copy()
+                            if o.ndim and o.shape[0] == _n else o.copy()
+                            for o in np_outs]):
+                        record_latency(t_done - r.t_submit)
+                    offset += r.rows
         except Exception as e:  # never wedge the queue on a bad batch
             if isinstance(e, _watchdog.StallError):
                 _STATS["serving_stalled_batches"] += 1
@@ -424,6 +446,19 @@ class BatchServer:
                 _try_resolve(r.future, exc=err)
             raise
         finally:
+            if bsp is not None and bsp.ctx is not None and len(batch) > 1:
+                # the batch span parents under the HEAD request only (a
+                # span has one parent); every coalesced FOLLOWER gets a
+                # retroactive serve.coalesced span in its own tree
+                # covering the same execution window and naming the
+                # head's trace — no request timeline dead-ends
+                dur_ns = time.perf_counter_ns() - bsp.t0_ns
+                for r in batch[1:]:
+                    if r.ctx is not None and r.ctx != batch[0].ctx:
+                        _obs_trace.record(
+                            "serve.coalesced", bsp.t0_ns, dur_ns,
+                            parent=r.ctx, batch_trace=bsp.trace_id,
+                            rows=rows, requests=len(batch))
             with self._cond:
                 self._inflight = ()
 

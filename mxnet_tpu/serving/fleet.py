@@ -60,6 +60,8 @@ from collections import deque
 from concurrent.futures import Future
 
 from ..base import MXNetError
+from ..observability import flight as _obs_flight
+from ..observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 from ..resilience.sentinel import HealthSentinel, NumericHealthError
@@ -427,7 +429,12 @@ def _mp_worker(conn, factory, rid):
             os._exit(0)
         if msg is None:
             os._exit(0)
-        req_id, data = msg
+        # messages are (req_id, data[, trace_ctx]): the parent ships the
+        # attempt's trace context with a traced request, and this worker
+        # ships its span records back with the reply — one connected
+        # span tree per request even across the process boundary
+        req_id, data = msg[0], msg[1]
+        ctx = msg[2] if len(msg) > 2 else None
         if isinstance(data, str) and data == "__ping__":
             try:
                 if probe_feeds is not None:
@@ -438,18 +445,29 @@ def _mp_worker(conn, factory, rid):
             except BaseException as e:  # noqa: BLE001
                 reply = _safe_exc(e)
             try:
-                conn.send((req_id, reply))
+                conn.send((req_id, reply, None))
             except Exception:
                 os._exit(19)
             continue
+        col = None
         try:
-            reply = run(data)
+            if ctx is not None:
+                # force=True: a shipped context IS the authorization to
+                # trace this request — the child's own MXNET_TPU_OBS_TRACE
+                # may be unset (set_enabled in the parent does not cross
+                # the spawn)
+                with _obs_trace.context(ctx, force=True), \
+                        _obs_trace.collect() as col:
+                    with _obs_trace.span("serve.replica", replica=rid):
+                        reply = run(data)
+            else:
+                reply = run(data)
         except _faults.ReplicaCrash:
             os._exit(23)
         except BaseException as e:  # noqa: BLE001 - must answer or die
             reply = _safe_exc(e)
         try:
-            conn.send((req_id, reply))
+            conn.send((req_id, reply, col))
         except Exception:
             os._exit(19)
 
@@ -551,11 +569,16 @@ class _ProcessReplica(_ThreadReplica):
     def _read_loop(self, conn):
         while True:
             try:
-                req_id, payload = conn.recv()
+                msg = conn.recv()
             except (EOFError, OSError):
                 break
+            req_id, payload = msg[0], msg[1]
             if req_id == "__fatal__":
                 break
+            if len(msg) > 2 and msg[2]:
+                # span records traced in the child: merge them into the
+                # local ring so the request's tree is connected
+                _obs_trace.ingest(msg[2])
             with self._plock:
                 fut = self._pending.pop(req_id, None)
             if fut is None:
@@ -594,9 +617,9 @@ class _ProcessReplica(_ThreadReplica):
                 except Exception:
                     pass
                 return
-            req_id, payload = item
+            req_id, payload, ctx = item
             try:
-                conn.send((req_id, payload))
+                conn.send((req_id, payload, ctx))
             except Exception as e:
                 with self._plock:
                     fut = self._pending.pop(req_id, None)
@@ -605,7 +628,7 @@ class _ProcessReplica(_ThreadReplica):
                         f"pipe send to replica {self.model}/{self.rid} "
                         f"failed: {e}"))
 
-    def _send(self, req_id, payload):
+    def _send(self, req_id, payload, ctx=None):
         fut = Future()
         with self._plock:
             self._pending[req_id] = fut
@@ -620,7 +643,7 @@ class _ProcessReplica(_ThreadReplica):
                     f"replica {self.model}/{self.rid} send queue at its "
                     f"high-water mark {self._sendq_depth}")
             else:
-                self._sendq.append((req_id, payload))
+                self._sendq.append((req_id, payload, ctx))
                 self._send_cond.notify_all()
         if err is not None:
             with self._plock:
@@ -639,7 +662,8 @@ class _ProcessReplica(_ThreadReplica):
             payload = {k: np.asarray(v) for k, v in data.items()}
         else:
             payload = np.asarray(data)
-        return self._send(f"r{next(self._req_ids)}", payload)
+        return self._send(f"r{next(self._req_ids)}", payload,
+                          ctx=_obs_trace.current())
 
     def probe_start(self, timeout):
         if not self.alive():
@@ -673,7 +697,7 @@ class _ProcessReplica(_ThreadReplica):
         with self._send_cond:
             stale = [i for i in self._sendq if i is not None]
             self._sendq.clear()
-        for req_id, _payload in stale:
+        for req_id, _payload, _ctx in stale:
             with self._plock:
                 fut = self._pending.pop(req_id, None)
             if fut is not None:
@@ -795,6 +819,9 @@ class ReplicaSupervisor:
             replica.state = state
             replica.transitions.append(
                 (time.monotonic(), prev, state, reason))
+        _obs_flight.record("fleet", model=replica.model,
+                           replica=replica.rid, prev=prev, state=state,
+                           reason=reason)
 
     # ------------------------------------------------------------------ probing
     def _probe_loop(self):
@@ -863,6 +890,9 @@ class ReplicaSupervisor:
             self._workers = [t for t in self._workers if t.is_alive()]
             self._workers.append(worker)
         _STATS["fleet_drains"] += 1
+        _obs_flight.record("fleet", model=replica.model,
+                           replica=replica.rid, prev="HEALTHY",
+                           state="DRAINING", reason=reason)
         if self._kv is not None:
             _watchdog.mark_peer_dead(replica.rid)
         worker.start()
@@ -989,7 +1019,7 @@ class _Tracked:
     """Router-side bookkeeping for one admitted request."""
 
     __slots__ = ("future", "model", "data", "deadline", "t0", "retries_left",
-                 "backoff_attempt", "resolved", "inflight", "tried")
+                 "backoff_attempt", "resolved", "inflight", "tried", "span")
 
     def __init__(self, model, data, deadline, retries):
         self.future = Future()
@@ -1002,6 +1032,7 @@ class _Tracked:
         self.resolved = False
         self.inflight = []            # [(replica, attempt future, is_hedge)]
         self.tried = set()            # rids that have seen this request
+        self.span = None              # the serve.request root trace span
 
 
 def _charges_breaker(exc):
@@ -1095,8 +1126,16 @@ class Router:
                 "at admission"))
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         t = _Tracked(model, data, deadline, self._retries)
+        # the request's root trace span: every attempt, the replica's
+        # batch, and (for process replicas) the child's spans parent
+        # under it — one connected tree per request; ended by _resolve.
+        # Created BEFORE t joins _outstanding: a close() racing this
+        # submit must find the span it is about to end, never a None it
+        # would skip (leaving the root span open forever)
+        t.span = _obs_trace.start_span("serve.request", model=model)
         with self._lock:
             if self._closed:
+                t.span.end(outcome="FleetClosed")
                 return _failed_future(FleetClosed("fleet is closed"))
             self._outstanding.add(t)
         replica = self._pick(group)
@@ -1126,9 +1165,17 @@ class Router:
             data = t.data  # snapshot under the lock: _resolve nulls it
             replica.outstanding += 1
             t.tried.add(replica.rid)
+        asp = _obs_trace.start_span(
+            "serve.attempt",
+            parent=t.span.ctx if t.span is not None else None,
+            model=t.model, replica=replica.rid, hedge=bool(is_hedge))
         try:
-            fut = replica.submit(data, deadline_ms=remaining_ms)
+            # enter the attempt's context so the replica path (batcher
+            # request, or the process-replica pipe) inherits it
+            with _obs_trace.context(asp.ctx):
+                fut = replica.submit(data, deadline_ms=remaining_ms)
         except Exception as e:
+            asp.end(error=type(e).__name__)
             with self._lock:
                 replica.outstanding -= 1
             self._attempt_failed(t, replica, e)
@@ -1140,20 +1187,27 @@ class Router:
                 entry = (replica, fut, is_hedge)
                 t.inflight.append(entry)
         if entry is None:
+            asp.end(outcome="cancelled")
             fut.cancel()
             with self._lock:
                 replica.outstanding -= 1
             return
         fut.add_done_callback(
-            lambda f, t=t, r=replica, h=is_hedge: self._on_done(t, r, f, h))
+            lambda f, t=t, r=replica, h=is_hedge, sp=asp:
+                self._on_done(t, r, f, h, sp))
 
-    def _on_done(self, t, replica, fut, is_hedge):
+    def _on_done(self, t, replica, fut, is_hedge, asp=None):
         if fut.cancelled():
+            if asp is not None:
+                asp.end(outcome="cancelled")
             with self._lock:
                 replica.outstanding -= 1
                 t.inflight = [e for e in t.inflight if e[1] is not fut]
             return
         exc = fut.exception()
+        if asp is not None:
+            asp.end(**({} if exc is None
+                       else {"error": type(exc).__name__}))
         with self._lock:
             replica.outstanding -= 1
             t.inflight = [e for e in t.inflight if e[1] is not fut]
@@ -1252,6 +1306,8 @@ class Router:
             losers = list(t.inflight)  # to the full deadline: don't let
             t.inflight = []            # it pin the request payload too
             self._outstanding.discard(t)
+        if t.span is not None:
+            t.span.end(outcome="ok" if exc is None else type(exc).__name__)
         for _r, f, _h in losers:
             f.cancel()
         _try_resolve(t.future, result=result, exc=exc)
@@ -1274,6 +1330,8 @@ class Router:
                 t.data = None
                 losers = list(t.inflight)
                 t.inflight = []
+            if t.span is not None:
+                t.span.end(outcome="FleetClosed")
             for _r, f, _h in losers:
                 f.cancel()
             _try_resolve(t.future, exc=err)

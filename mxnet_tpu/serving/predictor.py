@@ -40,6 +40,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError
+from ..observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from . import _STATS
 
@@ -662,7 +663,8 @@ class Predictor:
         feeds = _faults.maybe_nan_batch(feeds)
         padded = {name: self._pad(a, bucket) for name, a in feeds.items()}
         ex = self._executor_for(bucket, self._sig_of(padded))
-        outs = ex.forward_batch(padded, raw=True)
+        with _obs_trace.span("serve.predict", rows=n, bucket=bucket):
+            outs = ex.forward_batch(padded, raw=True)
         _STATS["serving_batch_samples"] += bucket
         _STATS["serving_padded_samples"] += bucket - n
         if bucket != n:

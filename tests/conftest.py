@@ -64,3 +64,8 @@ def pytest_configure(config):
         "int8: calibrated INT8 serving path (contrib/quantization.py + "
         "serving, docs/quantization.md); fast cases run in tier-1, the "
         "bench/accuracy gates carry the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "obs: unified observability layer (mxnet_tpu/observability/, "
+        "docs/observability.md); fast cases run in tier-1, the "
+        "obs_bench overhead gate carries the slow marker too")

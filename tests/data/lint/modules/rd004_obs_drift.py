@@ -1,0 +1,50 @@
+"""RD004 fixture: one undocumented metric registration and one
+duplicate span literal must fire; everything else is a clean near-miss
+(numpy's ``histogram``, regex ``Match.span``, unique span names,
+dynamic span names, a waived duplicate)."""
+import re
+
+import numpy as np
+
+from mxnet_tpu.observability import metrics
+from mxnet_tpu.observability import trace as _trace
+
+# fires: registered through the metrics registry, documented nowhere
+# (the fixture project has no docs/ at all)
+_G = metrics.gauge("fixture_undocumented_metric", "no docs anywhere")
+
+# clean: numpy's histogram is not the metrics registry (receiver is not
+# a metrics module, first arg is not a metric-name literal)
+_H = np.histogram([1.0, 2.0, 3.0])
+
+
+def clean_unique_spans():
+    with _trace.span("fixture.one"):
+        pass
+    with _trace.span("fixture.two"):
+        pass
+
+
+def bad_duplicate_span():
+    with _trace.span("fixture.dup"):
+        pass
+    with _trace.span("fixture.dup"):  # fires: second site, same module
+        pass
+
+
+def clean_waived_duplicate():
+    with _trace.span("fixture.waived"):
+        pass
+    # graftlint: disable=RD004
+    with _trace.span("fixture.waived"):
+        pass
+
+
+def clean_regex_span():
+    m = re.match("a", "a")
+    return m.span(0)
+
+
+def clean_dynamic_span(name):
+    with _trace.span(name):
+        pass

@@ -102,6 +102,33 @@ def test_rd002_exact(fixture_findings):
     assert got == [("RD002", "drift", "undeclared")], got
 
 
+def test_rd004_exact(fixture_findings):
+    # one undocumented metric registration and one duplicate span
+    # literal fire; np.histogram, re.Match.span, unique/dynamic span
+    # names and the waived duplicate stay clean
+    got = _in_file(fixture_findings, "rd004_obs_drift.py")
+    assert got == sorted([
+        ("RD004", "<module>", "fixture_undocumented_metric"),
+        ("RD004", "<module>", "span:fixture.dup"),
+    ]), got
+
+
+def test_rd004_documented_metric_is_clean(tmp_path):
+    # a registered metric whose name appears in the docs does not fire
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from observability import metrics\n"
+        '_C = metrics.counter("documented_metric_total", "help")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `documented_metric_total` | counter | — | covered |\n")
+    project = core.Project(str(tmp_path))
+    got = [f for f in core.run_all(project, rules={"RD004"})]
+    assert got == [], got
+
+
 def test_rd001_rd003_miniproject():
     # the mini-project mirrors the repo's default layout, so this is
     # also a test of the CLI's zero-config Project defaults
@@ -137,7 +164,8 @@ def test_no_unexpected_fixture_findings(fixture_findings):
     claimed = {"ts001_host_sync.py": 9, "ts002_raw_jit.py": 3,
                "ts002_capture.py": 1, "ts003_donated_read.py": 1,
                "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
-               "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1}
+               "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1,
+               "rd004_obs_drift.py": 2}
     per_file = {}
     for f in fixture_findings:
         per_file[os.path.basename(f.path)] = \

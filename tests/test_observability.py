@@ -1,0 +1,814 @@
+"""Unified observability layer (ISSUE 10, docs/observability.md).
+
+Covers: span nesting/propagation across threads AND the fleet's
+process-replica pipe (one connected tree per trace id), the
+phase-labeled training-step timeline, both metric exporters
+(JSON-lines round-trip + Prometheus text parse), the flight recorder
+inside a watchdog crash report, the tracing-off no-op guarantee, the
+profiler snapshot-atomicity fix, Monitor(emit='metrics') parity, and
+the counter key-stability extension. Marker: obs (tier-1; the
+obs_bench overhead gate is slow-marked).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.observability as obs
+from mxnet_tpu import profiler, serving
+from mxnet_tpu.observability import flight, metrics, trace
+from mxnet_tpu.resilience import faults, watchdog
+
+pytestmark = pytest.mark.obs
+
+IN_UNITS = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_layer():
+    """Each test starts with tracing off and empty rings; faults/peers
+    reset like the watchdog suite."""
+    trace.set_enabled(False)
+    trace.clear()
+    faults.reset()
+    watchdog.reset_peers()
+    yield
+    trace.set_enabled(False)
+    trace.clear()
+    faults.reset()
+    watchdog.reset_peers()
+
+
+def _tree(trace_id):
+    """{span_id: record} for one trace, asserting parent links resolve
+    within the trace (a single connected tree rooted at parent=None)."""
+    recs = trace.spans(trace_id=trace_id)
+    by_id = {r["span"]: r for r in recs}
+    roots = [r for r in recs if r["parent"] is None]
+    for r in recs:
+        if r["parent"] is not None:
+            assert r["parent"] in by_id, \
+                f"span {r['name']} has a dangling parent: {r}"
+    return by_id, roots
+
+
+def _wait_for_spans(trace_id, names, timeout=5.0):
+    """Span records land after futures resolve (the batch span closes
+    just after its futures); poll briefly."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = {s["name"] for s in trace.spans(trace_id=trace_id)}
+        if names <= got:
+            return got
+        time.sleep(0.02)
+    return {s["name"] for s in trace.spans(trace_id=trace_id)}
+
+
+def _gluon_trainer(seed=11):
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(4, in_units=IN_UNITS)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+
+    def step(k=0):
+        x = mx.nd.array(np.ones((2, IN_UNITS), np.float32) + k)
+        y = mx.nd.ones((2, 4))
+        with mx.autograd.record():
+            loss = ((net(x) - y) ** 2).sum()
+        loss.backward()
+        trainer.step(2)
+
+    return net, trainer, step
+
+
+# ---------------------------------------------------------------- span basics
+
+def test_span_nesting_single_thread():
+    trace.set_enabled(True)
+    with trace.span("t.root", step=7) as root:
+        with trace.span("t.child"):
+            with trace.span("t.grandchild"):
+                pass
+        with trace.span("t.sibling"):
+            pass
+    by_id, roots = _tree(root.trace_id)
+    assert len(roots) == 1 and roots[0]["name"] == "t.root"
+    names = {r["name"]: r for r in by_id.values()}
+    assert names["t.child"]["parent"] == roots[0]["span"]
+    assert names["t.sibling"]["parent"] == roots[0]["span"]
+    assert names["t.grandchild"]["parent"] == names["t.child"]["span"]
+    assert roots[0]["attrs"]["step"] == 7
+    assert all(r["trace"] == root.trace_id for r in by_id.values())
+    assert all(r["dur_ns"] >= 0 for r in by_id.values())
+
+
+def test_span_propagation_across_threads():
+    trace.set_enabled(True)
+    with trace.span("x.producer") as sp:
+        ctx = trace.current()
+
+    def consumer():
+        with trace.context(ctx):
+            with trace.span("x.consumer"):
+                pass
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    t.join(5)
+    by_id, _roots = _tree(sp.trace_id)
+    names = {r["name"]: r for r in by_id.values()}
+    assert names["x.consumer"]["parent"] == sp.span_id
+    assert names["x.consumer"]["trace"] == sp.trace_id
+    assert names["x.consumer"]["thread"] != names["x.producer"]["thread"]
+
+
+def test_span_error_attr_and_exception_passthrough():
+    trace.set_enabled(True)
+    with pytest.raises(ValueError):
+        with trace.span("t.err") as sp:
+            raise ValueError("boom")
+    rec = trace.spans(trace_id=sp.trace_id)[0]
+    assert rec["attrs"]["error"] == "ValueError"
+
+
+def test_root_span_reserved_attr_names_do_not_break_flight():
+    # review fix: an attr literally named "name"/"trace"/"dur_ns" (set
+    # via Span.set, the path that can carry arbitrary keys) must not
+    # TypeError the span end — it is dropped from the flight event
+    trace.set_enabled(True)
+    mark = flight.last_seq()
+    with trace.span("rsv.root", model="m1") as sp:
+        sp.set(**{"name": "resnet", "trace": "x", "dur_ns": 7})
+    ev = flight.events("span", since_seq=mark)
+    assert ev and ev[0]["name"] == "rsv.root" and ev[0]["model"] == "m1"
+    rec = trace.spans(name="rsv.root")[0]
+    assert rec["attrs"]["name"] == "resnet"  # kept on the span itself
+
+
+def test_prometheus_label_values_are_escaped():
+    g = metrics.gauge("x_obs_escape_gauge", labels=("m",))
+    g.set(1, m='bad"value\\with\nnewline')
+    text = metrics.render_prometheus(include_runtime_counters=False)
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("x_obs_escape_gauge{")][0]
+    assert line == 'x_obs_escape_gauge{m="bad\\"value\\\\with\\nnewline"} 1'
+
+
+def test_noop_when_disabled():
+    # disabled tracing returns ONE shared no-op: no allocation, no
+    # record — the whole-instrumentation no-op guarantee
+    assert trace.span("a.b") is trace.span("c.d")
+    before = len(trace.spans())
+    _net, _trainer, step = _gluon_trainer()
+    step()
+    assert len(trace.spans()) == before
+    assert trace.current() is None
+
+
+def test_collect_and_ingest_round_trip():
+    trace.set_enabled(True)
+    with trace.span("i.root") as root:
+        ctx = trace.current()
+    with trace.context(ctx, force=True), trace.collect() as col:
+        with trace.span("i.remote"):
+            pass
+    assert len(col) == 1 and col[0]["parent"] == root.span_id
+    trace.clear()
+    n = trace.ingest(col)
+    assert n == 1
+    assert trace.spans(trace_id=root.trace_id)[0]["name"] == "i.remote"
+    assert profiler.dispatch_stats()["obs_spans_shipped"] >= 1
+
+
+def test_context_force_enables_tracing_for_shipped_ctx():
+    # a process replica with MXNET_TPU_OBS_TRACE unset must still trace
+    # a request that shipped a context
+    assert not trace.enabled()
+    with trace.context(("sometrace", "parentspan"), force=True):
+        with trace.span("f.forced"):
+            pass
+    rec = trace.spans(name="f.forced")
+    assert rec and rec[0]["trace"] == "sometrace" \
+        and rec[0]["parent"] == "parentspan"
+
+
+# ------------------------------------------------------------ training spans
+
+def test_gluon_step_phase_timeline():
+    trace.set_enabled(True)
+    _net, _trainer, step = _gluon_trainer()
+    step()
+    roots = [s for s in trace.spans(name="train.step")]
+    assert roots and roots[-1]["parent"] is None
+    tid = roots[-1]["trace"]
+    names = {s["name"] for s in trace.spans(trace_id=tid)}
+    assert {"train.step", "step.allreduce", "step.update"} <= names
+
+
+def test_sharded_step_phase_timeline():
+    import jax
+
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mx.random.seed(5)
+    net = mx.gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = ShardedTrainer(net, lambda p, l: ((p - l) ** 2),
+                             optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=create_mesh({"dp": 2},
+                                              jax.devices()[:2]))
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    trainer.step(x, y)  # compile outside the traced step
+    trace.set_enabled(True)
+    trainer.step(x, y)
+    roots = trace.spans(name="train.sharded_step")
+    assert roots and roots[-1]["parent"] is None
+    tid = roots[-1]["trace"]
+    by_name = {s["name"]: s for s in trace.spans(trace_id=tid)}
+    assert {"train.sharded_step", "sharded.h2d",
+            "sharded.execute"} <= set(by_name)
+    assert by_name["sharded.h2d"]["parent"] == roots[-1]["span"]
+    assert by_name["sharded.execute"]["parent"] == roots[-1]["span"]
+    assert by_name["sharded.execute"]["attrs"]["microbatches"] == 1
+    assert by_name["train.sharded_step"]["attrs"]["step"] == 2
+
+
+def test_captured_step_span():
+    from mxnet_tpu import capture
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).sum()
+
+    net, trainer, _ = _gluon_trainer()
+    step = capture.capture(trainer, net=net, loss_fn=loss_fn)
+    x = mx.nd.array(np.ones((2, IN_UNITS), np.float32))
+    y = mx.nd.ones((2, 4))
+    step(x, y, batch_size=2)  # compile outside the traced window
+    trace.set_enabled(True)
+    step(x, y, batch_size=2)
+    roots = trace.spans(name="train.captured_step")
+    assert roots and roots[-1]["parent"] is None
+    names = {s["name"] for s in trace.spans(trace_id=roots[-1]["trace"])}
+    assert "captured.execute" in names
+
+
+def test_data_wait_span():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(np.arange(8, dtype=np.float32).reshape(4, 2),
+                      np.arange(4, dtype=np.float32))
+    trace.set_enabled(True)
+    loader = DataLoader(ds, batch_size=2, num_workers=0)
+    batches = list(loader)
+    assert len(batches) == 2
+    waits = trace.spans(name="step.data_wait")
+    assert len(waits) >= 2
+
+
+def test_ckpt_spans_and_flight_events(tmp_path):
+    from mxnet_tpu.resilience import CheckpointManager
+
+    net, trainer, step = _gluon_trainer()
+    step()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_n=2)
+    trace.set_enabled(True)
+    mark = flight.last_seq()
+    mgr.save(1, net=net, trainer=trainer)
+    manifest = mgr.restore_latest(net=net, trainer=trainer)
+    assert manifest["step"] == 1
+    assert trace.spans(name="ckpt.save")
+    assert trace.spans(name="ckpt.restore")
+    ops = [e["op"] for e in flight.events("ckpt", since_seq=mark)]
+    assert "save" in ops and "restore" in ops
+
+
+# ------------------------------------------------------------- serving spans
+
+def _serving_factory(prefix="obs_fleet_"):
+    mx.random.seed(5)
+    net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix=prefix)
+    net.initialize()
+    return serving.Predictor.from_block(
+        net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(2,))
+
+
+def test_batchserver_request_span_tree():
+    trace.set_enabled(True)
+    pred = _serving_factory()
+    x = np.ones((1, IN_UNITS), np.float32)
+    with serving.BatchServer(pred, max_batch_size=2,
+                             batch_timeout_ms=1.0) as srv:
+        with trace.span("req.client") as sp:
+            fut = srv.submit(x)
+        fut.result(timeout=10)
+        got = _wait_for_spans(sp.trace_id,
+                              {"serve.batch", "serve.batch_form",
+                               "serve.execute", "serve.sentinel",
+                               "serve.predict"})
+    assert {"serve.batch", "serve.batch_form", "serve.execute",
+            "serve.sentinel", "serve.predict"} <= got
+    by_id, roots = _tree(sp.trace_id)
+    names = {r["name"]: r for r in by_id.values()}
+    assert len(roots) == 1 and roots[0]["name"] == "req.client"
+    assert names["serve.batch"]["parent"] == sp.span_id
+    assert names["serve.execute"]["parent"] == names["serve.batch"]["span"]
+    assert names["serve.predict"]["parent"] == \
+        names["serve.execute"]["span"]
+
+
+def test_coalesced_follower_requests_get_a_span():
+    """When requests coalesce, the batch span parents under the HEAD
+    request; every FOLLOWER's tree must still reach the execution via a
+    retroactive serve.coalesced span naming the head's trace."""
+    trace.set_enabled(True)
+    pred = _serving_factory()
+    x = np.ones((1, IN_UNITS), np.float32)
+    with serving.BatchServer(pred, max_batch_size=2,
+                             batch_timeout_ms=100.0) as srv:
+        with trace.span("co.head") as head:
+            f1 = srv.submit(x)
+        with trace.span("co.follower") as follow:
+            f2 = srv.submit(x)
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        got = _wait_for_spans(follow.trace_id, {"serve.coalesced"})
+    assert "serve.coalesced" in got, got
+    rec = trace.spans(trace_id=follow.trace_id, name="serve.coalesced")[0]
+    assert rec["parent"] == follow.span_id
+    assert rec["attrs"]["batch_trace"] == head.trace_id
+    assert rec["attrs"]["requests"] == 2
+    # the head's tree carries the real batch subtree
+    assert {"serve.batch", "serve.execute"} <= \
+        _wait_for_spans(head.trace_id, {"serve.batch", "serve.execute"})
+
+
+def test_fleet_thread_mode_single_connected_tree():
+    """Acceptance: one serving request traced Router -> replica ->
+    batcher -> executor is ONE connected span tree under one trace id
+    (thread mode)."""
+    trace.set_enabled(True)
+    with serving.Fleet(_serving_factory, replicas=2,
+                       probe_interval_ms=5000,
+                       server_kw={"batch_timeout_ms": 1.0}) as fleet:
+        fut = fleet.submit(np.ones((1, IN_UNITS), np.float32),
+                           deadline_ms=30000)
+        fut.result(timeout=30)
+        reqs = trace.spans(name="serve.request")
+        assert reqs, "router did not open a serve.request root span"
+        tid = reqs[-1]["trace"]
+        got = _wait_for_spans(tid, {"serve.request", "serve.attempt",
+                                    "serve.batch", "serve.execute",
+                                    "serve.predict"})
+    assert {"serve.request", "serve.attempt", "serve.batch",
+            "serve.batch_form", "serve.execute", "serve.sentinel",
+            "serve.predict"} <= got
+    by_id, roots = _tree(tid)
+    assert len(roots) == 1 and roots[0]["name"] == "serve.request"
+    assert roots[0]["attrs"]["outcome"] == "ok"
+    # connectivity: every span walks up to the single root
+    for rec in by_id.values():
+        cur = rec
+        hops = 0
+        while cur["parent"] is not None and hops < 20:
+            cur = by_id[cur["parent"]]
+            hops += 1
+        assert cur is roots[0]
+
+
+def _obs_process_factory():
+    """Module-level (picklable) factory for spawn-mode replicas."""
+    return _serving_factory(prefix="obs_proc_")
+
+
+@pytest.mark.fleet
+def test_fleet_process_mode_tree_crosses_the_pipe():
+    """Acceptance: the span tree stays connected across the
+    process-replica boundary — the child's spans ship back over the
+    pipe and parent under the request's attempt."""
+    trace.set_enabled(True)
+    shipped_before = profiler.dispatch_stats()["obs_spans_shipped"]
+    with serving.Fleet(_obs_process_factory, replicas=1, mode="process",
+                       probe_interval_ms=5000,
+                       probe_timeout=30.0) as fleet:
+        fut = fleet.submit(np.ones((1, IN_UNITS), np.float32),
+                           deadline_ms=60000)
+        fut.result(timeout=60)
+        reqs = trace.spans(name="serve.request")
+        assert reqs
+        tid = reqs[-1]["trace"]
+        got = _wait_for_spans(tid, {"serve.request", "serve.attempt",
+                                    "serve.replica", "serve.predict"})
+    assert {"serve.request", "serve.attempt", "serve.replica",
+            "serve.predict"} <= got
+    by_id, roots = _tree(tid)
+    assert len(roots) == 1 and roots[0]["name"] == "serve.request"
+    names = {r["name"]: r for r in by_id.values()}
+    assert names["serve.replica"]["parent"] == \
+        names["serve.attempt"]["span"]
+    assert names["serve.predict"]["parent"] == \
+        names["serve.replica"]["span"]
+    assert profiler.dispatch_stats()["obs_spans_shipped"] > shipped_before
+
+
+def test_fleet_transitions_land_in_flight_recorder():
+    mark = flight.last_seq()
+    with serving.Fleet(_serving_factory, replicas=1,
+                       probe_interval_ms=5000) as fleet:
+        assert fleet.wait_healthy(timeout=10)
+    events = flight.events("fleet", since_seq=mark)
+    assert any(e["state"] == "HEALTHY" and e["reason"] == "initial build"
+               for e in events)
+    assert any(e["state"] == "DEAD" and e["reason"] == "fleet closed"
+               for e in events)
+
+
+# ------------------------------------------------------------------- metrics
+
+def test_counter_gauge_histogram_semantics():
+    c = metrics.counter("x_obs_test_total", "t", labels=("m",))
+    c.inc(2, m="a")
+    c.inc(3, m="a")
+    c.inc(1, m="b")
+    assert c.value(m="a") == 5 and c.value(m="b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, m="a")
+    with pytest.raises(ValueError):
+        c.inc(1, wrong="a")
+    with pytest.raises(ValueError):
+        metrics.gauge("x_obs_test_total")  # same name, different type
+    g = metrics.gauge("x_obs_test_gauge")
+    g.set(4.5)
+    g.inc(0.5)
+    assert g.value() == 5.0
+    h = metrics.histogram("x_obs_test_ms", buckets=(1, 10, 100))
+    for v in (0.5, 5, 5, 50, 5000):
+        h.observe(v)
+    cell = h.value()
+    assert cell["count"] == 5 and cell["buckets"] == [1, 2, 1, 1]
+    assert h.percentile(0.5) == 10.0
+    assert h.percentile(1.0) == float("inf")
+    assert metrics.counter("x_obs_test_total", labels=("m",)) is c
+
+
+def test_span_histogram_feeds_from_trace():
+    trace.set_enabled(True)
+    with trace.span("h.timed"):
+        time.sleep(0.002)
+    h = metrics.get("mxnet_tpu_span_ms")
+    cell = h.value(name="h.timed")
+    assert cell["count"] >= 1 and cell["sum"] >= 1.0  # >= 1 ms spent
+
+
+def test_render_prometheus_parses():
+    trace.set_enabled(True)
+    with trace.span("p.sample"):
+        pass
+    text = metrics.render_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE mxnet_tpu_span_ms histogram" in lines
+    assert "# TYPE mxnet_tpu_fleet_deadline_hit_rate gauge" in lines
+    # histogram exposition: cumulative buckets, _sum/_count present
+    buckets = [ln for ln in lines
+               if ln.startswith('mxnet_tpu_span_ms_bucket{name="p.sample"')]
+    assert buckets and buckets[-1].split("le=")[1].startswith('"+Inf"')
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)  # cumulative and monotone
+    count_line = [ln for ln in lines if ln.startswith(
+        'mxnet_tpu_span_ms_count{name="p.sample"')][0]
+    assert int(count_line.rsplit(" ", 1)[1]) == counts[-1]
+    # the flat runtime counters ride along as mxnet_tpu_<counter>
+    assert any(ln.startswith("mxnet_tpu_obs_spans ") for ln in lines)
+    # the summary STRING counter must not appear as a sample
+    assert not any("fleet_replica_latency_us" in ln and
+                   not ln.startswith("#") for ln in lines)
+
+
+def test_json_lines_exporter_round_trip(tmp_path, monkeypatch):
+    path = str(tmp_path / "metrics.jsonl")
+    monkeypatch.setenv("MXNET_TPU_METRICS_FILE", path)
+    c = metrics.counter("x_obs_jsonl_total")
+    c.inc(3)
+    assert metrics.flush_json() == path
+    metrics.flush_json()
+    with open(path) as f:
+        records = [json.loads(ln) for ln in f.read().splitlines()]
+    assert len(records) == 2
+    rec = records[-1]
+    assert rec["metrics"]["x_obs_jsonl_total"]["values"][""] == 3
+    assert rec["counters"]["obs_metric_flushes"] >= 1
+
+
+def test_background_flusher_cadence(tmp_path):
+    path = str(tmp_path / "flush.jsonl")
+    assert metrics.start_flusher(path=path, cadence_s=0.05)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not (
+                os.path.exists(path) and os.path.getsize(path) > 0):
+            time.sleep(0.05)
+    finally:
+        metrics.stop_flusher()
+    with open(path) as f:
+        records = [json.loads(ln) for ln in f.read().splitlines()]
+    assert records, "flusher wrote nothing"
+    assert metrics.series(), "flusher took no time-series samples"
+
+
+def test_fleet_slo_gauges_derive():
+    with serving.Fleet(_serving_factory, replicas=2,
+                       probe_interval_ms=5000,
+                       server_kw={"batch_timeout_ms": 1.0}) as fleet:
+        fleet.submit(np.ones((1, IN_UNITS), np.float32),
+                     deadline_ms=30000).result(timeout=30)
+        metrics.update_slo()
+        healthy = metrics.get("mxnet_tpu_fleet_healthy_replicas")
+        assert healthy.value(model="default") == 2
+        p99 = metrics.get("mxnet_tpu_fleet_p99_us")
+        assert p99.value(model="default") >= 0
+        hit = metrics.get("mxnet_tpu_fleet_deadline_hit_rate")
+        assert hit.value() == 1.0
+
+
+def test_http_endpoint_serves_metrics_and_dump():
+    import urllib.request
+
+    server = metrics.serve_http(port=0)
+    try:
+        port = server.server_port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+        assert b"mxnet_tpu_span_ms" in body
+        dump = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/obs", timeout=10).read())
+        assert dump["schema_version"] == 1
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------- flight recorder
+
+def test_flight_recorder_orders_and_filters():
+    mark = flight.last_seq()
+    flight.record("fault", fault="x_test", call=0)
+    flight.record("stall", phase="step")
+    events = flight.events(since_seq=mark)
+    assert [e["kind"] for e in events] == ["fault", "stall"]
+    assert events[0]["seq"] < events[1]["seq"]
+    assert flight.events("fault", since_seq=mark)[0]["fault"] == "x_test"
+
+
+def test_flight_recorder_disable_and_resize():
+    prev = flight.set_ring(0)
+    try:
+        assert flight.record("fault", fault="nope") == 0
+        assert flight.events() == []
+    finally:
+        flight.set_ring(prev)
+    assert flight.record("fault", fault="yes") > 0
+
+
+def test_fired_faults_leave_flight_events():
+    mark = flight.last_seq()
+    with faults.inject("nan_grad"):
+        _net, _trainer, step = _gluon_trainer()
+        from mxnet_tpu.resilience import HealthSentinel
+
+        HealthSentinel(policy="skip_batch").attach(_trainer)
+        step()
+    fired = [e for e in flight.events("fault", since_seq=mark)
+             if e["fault"] == "nan_grad"]
+    assert len(fired) == 1
+
+
+def test_crash_report_embeds_flight_tail(tmp_path, monkeypatch):
+    """Acceptance: watchdog crash reports contain the flight-recorder
+    tail, with the injected fault visible in it."""
+    monkeypatch.setenv("MXNET_TPU_CRASH_DIR", str(tmp_path))
+    with pytest.raises(watchdog.StallError) as ei:
+        with faults.inject("hang_step"):
+            with watchdog.guard("step", timeout=0.3,
+                                detail="obs-test stall"):
+                faults.maybe_hang("hang_step")
+    report_path = ei.value.report_path
+    assert report_path and os.path.isfile(report_path)
+    with open(report_path) as f:
+        report = json.load(f)
+    tail = report["flight_recorder"]
+    assert isinstance(tail, list) and tail
+    assert any(e["kind"] == "fault" and e.get("fault") == "hang_step"
+               for e in tail)
+    # the stall itself is recorded too (by the monitor, just after the
+    # report snapshot — so it appears in the ring, not necessarily in
+    # this report's tail)
+    assert flight.events("stall")
+
+
+def test_dump_has_all_sections():
+    trace.set_enabled(True)
+    with trace.span("d.root"):
+        pass
+    d = obs.dump()
+    assert d["schema_version"] == 1
+    assert {"flight", "spans", "metrics", "series", "counters"} <= set(d)
+    assert any(s["name"] == "d.root" for s in d["spans"])
+    assert d["counters"]["obs_dumps"] >= 1
+    json.dumps(d, default=str)  # JSON-serializable end to end
+
+
+def test_obs_dump_tool_inspects_a_crash_report(tmp_path):
+    import importlib.util
+
+    trace.set_enabled(True)
+    with trace.span("tool.root"):
+        pass
+    path = str(tmp_path / "dump.json")
+    with open(path, "w") as f:
+        json.dump(obs.dump(), f, default=str)
+    spec = importlib.util.spec_from_file_location(
+        "obs_dump_tool", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "obs_dump.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    assert tool.main(["--input", path]) == 0
+    assert tool.main(["--input", str(tmp_path / "missing.json")]) == 1
+
+
+# ------------------------------------------------------ monitor (satellite)
+
+def test_monitor_metrics_mode_parity(capsys):
+    """Monitor(emit='metrics') keeps reference Monitor semantics —
+    identical (step, name, stat_str) tuples from the same taps — but
+    routes emission through the metrics registry + flight recorder
+    instead of stdout."""
+    from mxnet_tpu.monitor import Monitor
+
+    arr = mx.nd.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+    m_print = Monitor(1)
+    m_metrics = Monitor(1, emit="metrics")
+    for m in (m_print, m_metrics):
+        m.tic()
+        m.stat_helper("act0", arr)
+    res_p = m_print.toc_print()
+    assert "act0" in capsys.readouterr().out  # reference parity: prints
+    mark = flight.last_seq()
+    res_m = m_metrics.toc_print()
+    assert capsys.readouterr().out == ""      # metrics mode: no prints
+    assert res_m == res_p                     # identical stat tuples
+    expected = float(np.linalg.norm(np.arange(4))) / 2.0  # asum_stat
+    g = metrics.get("mxnet_tpu_monitor_stat")
+    assert abs(g.value(name="act0") - expected) < 1e-5
+    ev = flight.events("monitor", since_seq=mark)
+    assert ev and ev[0]["name"] == "act0" \
+        and abs(ev[0]["value"] - expected) < 1e-5
+
+
+def test_monitor_rejects_unknown_emit():
+    from mxnet_tpu.monitor import Monitor
+
+    with pytest.raises(ValueError):
+        Monitor(1, emit="telegraph")
+
+
+# ------------------------------------------- profiler: counters + atomicity
+
+OBS_KEYS = frozenset({
+    "obs_spans", "obs_spans_shipped", "obs_flight_events",
+    "obs_metric_flushes", "obs_metric_samples", "obs_dumps",
+})
+
+
+def test_dispatch_stats_key_stability_obs_extension():
+    s = profiler.dispatch_stats()
+    missing = OBS_KEYS - set(s)
+    assert not missing, f"missing obs counters: {sorted(missing)}"
+    for k in OBS_KEYS:
+        assert isinstance(s[k], int), k
+    assert set(obs.stats()) == OBS_KEYS
+
+
+def test_obs_counters_reset_through_profiler():
+    trace.set_enabled(True)
+    with trace.span("r.count"):
+        pass
+    assert profiler.dispatch_stats()["obs_spans"] >= 1
+    profiler.reset_dispatch_stats()
+    assert profiler.dispatch_stats()["obs_spans"] == 0
+
+
+def test_dispatch_stats_snapshot_is_atomic_vs_reset():
+    """Satellite fix: the full snapshot (and reset) holds the profiler
+    lock — a reader can never interleave with a reset mid-copy."""
+    got = []
+
+    def reader():
+        got.append(profiler.dispatch_stats())
+
+    with profiler._LOCK:
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join(0.3)
+        assert not got, "dispatch_stats() ignored the profiler lock"
+    t.join(10)
+    assert got and "obs_spans" in got[0]
+
+    got2 = []
+
+    def resetter():
+        profiler.reset_dispatch_stats()
+        got2.append(True)
+
+    with profiler._LOCK:
+        t = threading.Thread(target=resetter)
+        t.start()
+        t.join(0.3)
+        assert not got2, "reset_dispatch_stats() ignored the profiler lock"
+    t.join(10)
+    assert got2
+
+
+def test_dispatch_stats_lock_timeout_degrades_instead_of_blocking():
+    """Review fix: the crash-report writer passes lock_timeout so a
+    stalled thread wedged while HOLDING the profiler lock cannot cost
+    the run its crash report — the snapshot degrades to unlocked."""
+    got = []
+
+    def reader():
+        got.append(profiler.dispatch_stats(lock_timeout=0.2))
+
+    with profiler._LOCK:
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join(5)
+        assert got, "lock_timeout snapshot still blocked on the lock"
+    assert OBS_KEYS <= set(got[0])
+
+
+def test_note_span_survives_concurrent_reset_semantics():
+    """Review fix: a cell cached by note_span can never outlive a
+    metrics.reset() as a ghost — observations after a reset are always
+    visible in the registry."""
+    metrics.note_span("reset.victim", 2_000_000)
+    metrics.reset()
+    metrics.note_span("reset.victim", 2_000_000)
+    cell = metrics.get("mxnet_tpu_span_ms").value(name="reset.victim")
+    assert cell and cell["count"] == 1
+
+
+def test_router_close_ends_request_spans():
+    """Review fix: the serve.request span is created before the request
+    joins the outstanding set, so a submit racing close() always gets
+    its root span ended (outcome=FleetClosed), never left open."""
+    trace.set_enabled(True)
+    fleet = serving.Fleet(_serving_factory, replicas=1,
+                          probe_interval_ms=5000)
+    fleet.close()
+    fut = fleet.router.submit(np.ones((1, IN_UNITS), np.float32))
+    with pytest.raises(serving.FleetClosed):
+        fut.result(timeout=10)
+    reqs = trace.spans(name="serve.request")
+    assert reqs and reqs[-1]["attrs"]["outcome"] == "FleetClosed"
+
+
+def test_dispatch_stats_concurrent_reset_never_tears():
+    """Hammer: concurrent snapshot(reset=True) callers always see the
+    complete key set and never raise."""
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                s = profiler.dispatch_stats(reset=True)
+                assert OBS_KEYS <= set(s)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+
+
+# -------------------------------------------------------------- slow gates
+
+@pytest.mark.slow
+def test_obs_bench_gate():
+    """The ISSUE-10 overhead gate: <=2% step overhead with tracing on,
+    ~0 (sub-2us per site) disabled."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_bench_tool", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "obs_bench.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    assert tool.main(["--steps", "100", "--trials", "3"]) == 0

@@ -58,6 +58,39 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch")
 
+# Flight-recorder contract (docs/observability.md): every drill must
+# leave a matching event trail — a drill whose injection leaves no
+# forensic record is a regression. Specs are (event kind, field,
+# value); the default is the drill's own `fault` event. Exceptions:
+# drills arming a different underlying kind, and ckpt_async_crash,
+# whose fault fires inside the forked writer CHILD — the parent-side
+# trail is the barrier's `ckpt: async_failed` event.
+EXPECTED_FLIGHT_EVENTS = {
+    "peer_death_recover": (("fault", "fault", "peer_death"),),
+    "capture_step": (("fault", "fault", "nan_grad"),
+                     ("fault", "fault", "hang_step")),
+    "ckpt_async_crash": (("ckpt", "op", "async_failed"),),
+}
+
+
+def _flight_missing(kind, mark):
+    """Event specs the drill should have left in the flight recorder
+    (events after bookmark ``mark``) but did not; None when the
+    recorder is disabled (nothing to assert against)."""
+    from mxnet_tpu.observability import flight
+
+    if flight.ring_size() == 0:
+        return None
+    events = flight.events(since_seq=mark)
+    expected = EXPECTED_FLIGHT_EVENTS.get(
+        kind, (("fault", "fault", kind),))
+    missing = []
+    for ekind, field, value in expected:
+        if not any(e["kind"] == ekind and e.get(field) == value
+                   for e in events):
+            missing.append(f"{ekind}:{field}={value}")
+    return missing
+
 
 def _mx():
     import mxnet_tpu as mx
@@ -535,9 +568,46 @@ def _drill_dist_connect_timeout(mx, workdir):
     return elapsed < 5.0, f"elapsed={elapsed:.2f}s"
 
 
+def _dispatch_drill(mx, kind, tmp):
+    if kind == "nan_grad":
+        return _drill_nan_grad(mx, tmp)
+    if kind in ("ckpt_enospc", "ckpt_partial_write",
+                "ckpt_shard_corrupt", "ckpt_crash_before_manifest"):
+        return _drill_ckpt(mx, tmp, kind)
+    if kind == "ckpt_async_crash":
+        return _drill_ckpt_async_crash(mx, tmp)
+    if kind == "peer_death_recover":
+        return _drill_peer_death_recover(mx, tmp)
+    if kind == "hang_step":
+        return _drill_hang_step(mx, tmp)
+    if kind == "hang_collective":
+        return _drill_hang_collective(mx, tmp)
+    if kind == "hang_batch":
+        return _drill_hang_batch(mx, tmp)
+    if kind == "nan_serving":
+        return _drill_nan_serving(mx, tmp)
+    if kind == "peer_death":
+        return _drill_peer_death(mx, tmp)
+    if kind == "oom_step":
+        return _drill_oom_step(mx, tmp)
+    if kind == "dist_connect_timeout":
+        return _drill_dist_connect_timeout(mx, tmp)
+    if kind == "capture_step":
+        return _drill_capture_step(mx, tmp)
+    if kind in ("replica_crash", "replica_hang", "replica_nan_storm"):
+        return _drill_replica_fault(mx, tmp, kind)
+    if kind == "int8_calib_mismatch":
+        return _drill_int8_calib_mismatch(mx, tmp)
+    raise ValueError(f"unknown chaos kind {kind!r}")
+
+
 def run_kind(kind, workdir=None):
     """Run one chaos drill; returns (recovered: bool, detail: str).
-    Faults/peers/env are reset around the drill."""
+    Faults/peers/env are reset around the drill. On top of the drill's
+    own recovery check, the fault must have left a matching
+    flight-recorder event (docs/observability.md) — no silent
+    injections."""
+    from mxnet_tpu.observability import flight as _obs_flight
     from mxnet_tpu.resilience import faults, watchdog
 
     mx = _mx()
@@ -546,37 +616,17 @@ def run_kind(kind, workdir=None):
     faults.reset()
     watchdog.reset_peers()
     tmp = workdir or tempfile.mkdtemp(prefix="chaos_")
+    mark = _obs_flight.last_seq()
     try:
-        if kind == "nan_grad":
-            return _drill_nan_grad(mx, tmp)
-        if kind in ("ckpt_enospc", "ckpt_partial_write",
-                    "ckpt_shard_corrupt", "ckpt_crash_before_manifest"):
-            return _drill_ckpt(mx, tmp, kind)
-        if kind == "ckpt_async_crash":
-            return _drill_ckpt_async_crash(mx, tmp)
-        if kind == "peer_death_recover":
-            return _drill_peer_death_recover(mx, tmp)
-        if kind == "hang_step":
-            return _drill_hang_step(mx, tmp)
-        if kind == "hang_collective":
-            return _drill_hang_collective(mx, tmp)
-        if kind == "hang_batch":
-            return _drill_hang_batch(mx, tmp)
-        if kind == "nan_serving":
-            return _drill_nan_serving(mx, tmp)
-        if kind == "peer_death":
-            return _drill_peer_death(mx, tmp)
-        if kind == "oom_step":
-            return _drill_oom_step(mx, tmp)
-        if kind == "dist_connect_timeout":
-            return _drill_dist_connect_timeout(mx, tmp)
-        if kind == "capture_step":
-            return _drill_capture_step(mx, tmp)
-        if kind in ("replica_crash", "replica_hang", "replica_nan_storm"):
-            return _drill_replica_fault(mx, tmp, kind)
-        if kind == "int8_calib_mismatch":
-            return _drill_int8_calib_mismatch(mx, tmp)
-        raise ValueError(f"unknown chaos kind {kind!r}")
+        ok, detail = _dispatch_drill(mx, kind, tmp)
+        missing = _flight_missing(kind, mark)
+        if missing:
+            ok = False
+            detail += (f"; NO flight-recorder fault event for {missing} "
+                       "(every injected fault must leave a trail)")
+        elif missing is not None:
+            detail += "; flight=ok"
+        return ok, detail
     finally:
         faults.reset()
         watchdog.reset_peers()

@@ -1,0 +1,130 @@
+"""Dump / inspect the observability layer (docs/observability.md).
+
+Two modes:
+
+- **demo dump** (no ``--input``): run a tiny traced workload in-process
+  — two gluon training steps and one BatchServer request — then take
+  ``observability.dump()`` and summarize it. This is the smoke-test
+  form: the summary proves spans, the flight recorder and the metric
+  registry are all live.
+- **inspect** (``--input PATH``): read an existing JSON file — a
+  watchdog crash report (its ``flight_recorder`` tail) or a dump
+  written by ``--out`` — and summarize its flight events.
+
+``--out PATH`` writes the full dump JSON (demo mode only).
+
+Prints ONE JSON line (the repo-wide tool contract):
+
+    {"metric": "obs_dump_events", "value": <n>, "unit": "events",
+     "extra": {"by_kind": {...}, "spans": ..., "metrics": ..., ...}}
+
+Exit code is non-zero when the dump/input yields no events (an empty
+flight recorder from the demo workload, or an unreadable input, means
+the observability layer is broken).
+
+Run: JAX_PLATFORMS=cpu python tools/obs_dump.py [--input f] [--out f]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _demo_dump():
+    """Run a tiny traced train + serve workload and dump the layer."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    import mxnet_tpu.observability as obs
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import trace
+
+    prev = trace.set_enabled(True)
+    try:
+        mx.random.seed(11)
+        net = mx.gluon.nn.Dense(4, in_units=3)
+        net.initialize()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1})
+        for k in range(2):
+            x = mx.nd.array(np.ones((2, 3), np.float32) + k)
+            y = mx.nd.ones((2, 4))
+            with mx.autograd.record():
+                loss = ((net(x) - y) ** 2).sum()
+            loss.backward()
+            trainer.step(2)
+        pred = serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+        with serving.BatchServer(pred, max_batch_size=2,
+                                 batch_timeout_ms=1.0) as srv:
+            srv.submit(np.ones((1, 3), np.float32)).result(timeout=10)
+        return obs.dump()
+    finally:
+        trace.set_enabled(prev)
+
+
+def _summarize_events(events):
+    by_kind = {}
+    for e in events:
+        by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+    return by_kind
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default=None,
+                    help="existing crash report or dump JSON to inspect")
+    ap.add_argument("--out", default=None,
+                    help="write the full demo dump JSON here")
+    args = ap.parse_args(argv)
+
+    if args.input is not None:
+        try:
+            with open(args.input, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs_dump: cannot read {args.input}: {e}",
+                  file=sys.stderr)
+            print(json.dumps({"metric": "obs_dump_events", "value": 0,
+                              "unit": "events",
+                              "extra": {"error": str(e)}}))
+            return 1
+        # a crash report embeds the tail as "flight_recorder"; a dump
+        # carries the ring as "flight"
+        events = data.get("flight", data.get("flight_recorder", []))
+        extra = {
+            "source": args.input,
+            "by_kind": _summarize_events(events),
+            "spans": len(data.get("spans", [])),
+            "schema_version": data.get("schema_version"),
+        }
+        n = len(events)
+    else:
+        dump = _demo_dump()
+        events = dump["flight"]
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(dump, f, indent=1, default=str)
+            print(f"full dump -> {args.out}", file=sys.stderr)
+        extra = {
+            "by_kind": _summarize_events(events),
+            "spans": len(dump["spans"]),
+            "metrics": len(dump["metrics"]),
+            "counters": {k: v for k, v in dump["counters"].items()
+                         if k.startswith("obs_")},
+        }
+        n = len(events)
+
+    for kind, count in sorted(extra["by_kind"].items()):
+        print(f"{kind}: {count} event(s)", file=sys.stderr)
+    print(json.dumps({"metric": "obs_dump_events", "value": n,
+                      "unit": "events", "extra": extra}, default=str))
+    return 0 if n > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
