@@ -65,6 +65,7 @@ import time
 
 from . import profiler as _profiler
 from .observability import flight as _obs_flight
+from .observability import perf as _obs_perf
 from .observability import trace as _obs_trace
 
 __all__ = ["capture", "CapturedTrainerStep", "CapturedShardedStep",
@@ -653,15 +654,32 @@ def aot_compile(fn, *, label, fingerprint, example_args, sig=None,
     jit_kwargs = {"in_shardings": in_shardings,
                   "out_shardings": out_shardings,
                   "donate_argnums": donate_argnums or None}
+    t0 = time.perf_counter()
+    perf_fp = _perf_identity(fingerprint, example_args, sig)
+
+    def _ledger(compiled, aot_hit=False):
+        # static perf attribution (observability.perf): every compile
+        # through this site — captured steps, sharded programs, serving
+        # buckets — lands one ledger entry (cost/memory analysis + wall
+        # compile time) under the SAME (fingerprint, signature)
+        # identity that keys the AOT artifact, so the perf gate and the
+        # compile cache agree on identity by construction and two
+        # programs can never merge into one entry
+        _obs_perf.note_compile(label, perf_fp, compiled,
+                               time.perf_counter() - t0, aot_hit=aot_hit)
+        return compiled
+
     cache = compile_cache()
     if cache is None or not enabled():
-        return _precompile(_compile_jit(fn, jit_kwargs), example_args)
+        return _ledger(_precompile(_compile_jit(fn, jit_kwargs),
+                                   example_args))
     key = cache.key(label, fingerprint, sig if sig is not None
                     else _avals_sig(example_args))
     # load() counts the outcome: absent -> misses, version/backend
     # mismatch -> stale, unreadable -> corrupt (each a distinct series,
     # so cold-cache misses never masquerade as invalidation churn)
     exported = cache.load(key)
+    aot_hit = exported is not None
     if exported is None:
         jitted = _compile_jit(fn, jit_kwargs)
         try:
@@ -673,13 +691,13 @@ def aot_compile(fn, *, label, fingerprint, example_args, sig=None,
             # program not exportable (callbacks, unsupported primitive):
             # serve the plain executable; persistence is best-effort
             with cache.xla_subcache():
-                return _precompile(jitted, example_args)
+                return _ledger(_precompile(jitted, example_args))
     else:
         _STATS["aot_cache_hits"] += 1
     wrapped = _compile_jit(exported.call,
                            {"donate_argnums": donate_argnums or None})
     with cache.xla_subcache():
-        return _precompile(wrapped, example_args)
+        return _ledger(_precompile(wrapped, example_args), aot_hit=aot_hit)
 
 
 def _avals_sig(args):
@@ -693,6 +711,16 @@ def _avals_sig(args):
         sh = getattr(leaf, "sharding", None)
         out.append((shape, dtype, repr(sh) if sh is not None else None))
     return tuple(out)
+
+
+def _perf_identity(fingerprint, example_args, sig=None):
+    """The perf-ledger identity of one compiled program: the caller's
+    structural fingerprint folded with its aval signature — exactly the
+    pair the AOT cache key hashes. Execution sites recompute this from
+    the same inputs so their timings land on the entry their compile
+    created."""
+    full_sig = sig if sig is not None else _avals_sig(example_args)
+    return _obs_perf.combined_fingerprint(fingerprint, repr(full_sig))
 
 
 # ------------------------------------------------------------- CapturedExec
@@ -718,6 +746,7 @@ class CapturedExec:
         self._donate = tuple(donate_argnums or ())
         self._sig_argnums = tuple(sig_argnums)
         self._entries = {}
+        self._entry_fps = {}  # sig -> perf-ledger identity (fp ⊕ avals)
         self._last_sig = None
         self._lock = threading.Lock()
 
@@ -735,18 +764,27 @@ class CapturedExec:
                     if self._last_sig is not None or self._entries:
                         _note_retrace(self.label, self._last_sig, sig)
                     _STATS["capture_misses"] += 1
+                    avals = _avals_sig(args)
                     entry = aot_compile(
                         self._fn, label=self.label,
                         fingerprint=self.fingerprint,
-                        example_args=args, sig=_avals_sig(args),
+                        example_args=args, sig=avals,
                         in_shardings=self._in_shardings,
                         out_shardings=self._out_shardings,
                         donate_argnums=self._donate)
+                    self._entry_fps[sig] = _perf_identity(
+                        self.fingerprint, args, avals)
                     self._entries[sig] = entry
                     self._last_sig = sig
         else:
             _STATS["capture_hits"] += 1
-        return entry(*args)
+        # dynamic perf attribution: with MXNET_TPU_OBS_DEVICE_TIME on,
+        # every call blocks on its outputs (dependency-chained timing,
+        # PERF.md) and feeds THIS signature's ledger entry (the same
+        # fp ⊕ avals identity its compile registered); off, this is one
+        # global check around a plain call
+        return _obs_perf.timed_call(entry, args, self.label,
+                                    self._entry_fps[sig])
 
     @property
     def compiled_signatures(self):
@@ -1019,6 +1057,9 @@ class CapturedTrainerStep:
             "has_gate": has_gate, "has_norm": has_norm,
             "states_ref": self.trainer._updaters[0].states,
             "ctx": x_nd.context,
+            # the same fp ⊕ avals identity aot_compile just ledgered,
+            # so the per-step device timings land on this program's entry
+            "fingerprint": _perf_identity(fingerprint, example),
         }
         self._entries[sig] = entry
         self._last_sig = sig
@@ -1145,9 +1186,11 @@ class CapturedTrainerStep:
                                     step=self._step_count):
                 _faults.maybe_hang("hang_step")
                 with _obs_trace.span("captured.execute"):
-                    outs, new_state = entry["fn"](
-                        [x_nd.data_, y_nd.data_],
-                        [c._data for c in entry["cells"]], dyn)
+                    outs, new_state = _obs_perf.timed_call(
+                        entry["fn"],
+                        ([x_nd.data_, y_nd.data_],
+                         [c._data for c in entry["cells"]], dyn),
+                        self.label, entry["fingerprint"])
         except _watchdog.StallError as e:
             if not self._stall_rollback(e):
                 # the stalled step never applied: un-advance the replay's

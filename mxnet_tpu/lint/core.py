@@ -50,6 +50,8 @@ RULES = {
     "RD003": "every fault kind is exercised by tools/chaos_run.py",
     "RD004": "every registered metric name is documented and every "
              "trace.span literal name is unique per module",
+    "RD005": "every declared perf-ledger field and perf-gate baseline "
+             "metric is documented",
 }
 
 _WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")

@@ -1,16 +1,20 @@
-"""Registry-drift passes (RD001-RD004).
+"""Registry-drift passes (RD001-RD005).
 
-Four registries drift silently as the codebase grows: env knobs
+Five registries drift silently as the codebase grows: env knobs
 (``MXNET_TPU_*``) appear in code faster than in docs, counters get
 incremented that no ``_STATS`` literal declares (so ``reset`` misses
 them and ``profiler.dispatch_stats()`` only shows them after first
 fire), fault kinds get added to ``resilience/faults.py`` that
 ``tools/chaos_run.py`` never drills — an untested recovery path is an
-untrusted one — and observability names decay: a metric registered but
+untrusted one — observability names decay: a metric registered but
 documented nowhere is a dashboard nobody can interpret, and one span
 name opened at two sites makes timelines (and the per-name
-``mxnet_tpu_span_ms`` series) unattributable. These passes pin each
-registry to its consumers.
+``mxnet_tpu_span_ms`` series) unattributable — and the performance
+registries (the perf ledger's per-executable fields, the perf gate's
+baseline metrics) are numbers an operator must be able to interpret
+and a baseline reviewer must be able to audit, so every declared
+``LEDGER_FIELDS`` / ``GATED_METRICS`` token must appear under docs/.
+These passes pin each registry to its consumers.
 
 Policy: RD findings describe *repository state*, not a single line, so
 the acceptance bar is zero — they are fixed (document the knob, declare
@@ -320,10 +324,57 @@ def _check_rd004(project, findings):
                 "unattributable"))
 
 
+# ------------------------------------------------------------------- RD005
+
+# Module-level registry declarations the perf tier is built on: the
+# ledger's per-entry field tuple (observability/perf.py) and the gate's
+# baseline-metric tuple (tools/perf_gate.py). Runtime closure tests pin
+# the code to these declarations; this pass pins the declarations to
+# the docs.
+_PERF_REGISTRY_NAMES = {"LEDGER_FIELDS", "GATED_METRICS"}
+
+
+def _perf_registry_tokens(mod):
+    """``(decl_name, token, node)`` for every string element of a
+    module-level ``LEDGER_FIELDS = (...)`` / ``GATED_METRICS = (...)``
+    tuple/list literal."""
+    out = []
+    for stmt in mod.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id in _PERF_REGISTRY_NAMES
+                and isinstance(stmt.value, (ast.Tuple, ast.List))):
+            continue
+        for elt in stmt.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((stmt.targets[0].id, elt.value, elt))
+    return out
+
+
+def _check_rd005(project, findings):
+    doc_text = project.doc_text()
+    seen = set()
+    for mod in project.knob_source_modules():
+        for decl, token, node in _perf_registry_tokens(mod):
+            if (decl, token) in seen or _documented_token(token, doc_text):
+                continue
+            if mod.waived("RD005", getattr(node, "lineno", 0)):
+                continue
+            seen.add((decl, token))
+            findings.append(Finding(
+                "RD005", mod.relpath, getattr(node, "lineno", 0),
+                "<module>", token,
+                f"perf registry entry `{token}` (declared in {decl}) is "
+                "documented nowhere under docs/ — a ledger field or "
+                "gated baseline metric nobody can interpret (add it to "
+                "docs/observability.md)"))
+
+
 def run(project):
     findings = []
     _check_rd001(project, findings)
     _check_rd002(project, findings)
     _check_rd003(project, findings)
     _check_rd004(project, findings)
+    _check_rd005(project, findings)
     return findings

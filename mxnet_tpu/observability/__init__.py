@@ -27,6 +27,11 @@ package is that layer for the whole runtime (docs/observability.md):
   watchdog stalls, capture retrace reasons, checkpoint publishes and
   fleet state transitions. Watchdog crash reports embed its tail;
   ``observability.dump()`` / ``tools/obs_dump.py`` dump it on demand.
+- :mod:`perf` — performance attribution: a per-executable ledger (XLA
+  cost/memory analysis + compile time, keyed by the AOT fingerprint),
+  opt-in dependency-chained device timing
+  (``MXNET_TPU_OBS_DEVICE_TIME``), and derived MFU / roofline gauges;
+  ``tools/perf_gate.py`` gates it against a committed baseline.
 
 Everything here is stdlib-only at import so the hot paths (trainer,
 registry, serving) can instrument without dragging in jax.
@@ -43,6 +48,8 @@ _STATS = {
     "obs_metric_flushes": 0,   # JSON-lines exporter flushes
     "obs_metric_samples": 0,   # time-series ring samples taken
     "obs_dumps": 0,            # observability.dump() calls
+    "perf_ledger_entries": 0,  # executables attributed in the perf ledger
+    "perf_device_timings": 0,  # dependency-chained timed executions
 }
 
 
@@ -60,6 +67,7 @@ def reset_stats():
 from . import trace  # noqa: E402
 from . import metrics  # noqa: E402
 from . import flight  # noqa: E402
+from . import perf  # noqa: E402
 
 # operator story: exporting metrics needs ONLY the env knob — with
 # MXNET_TPU_METRICS_FILE set, the background JSON-lines flusher arms
@@ -86,8 +94,10 @@ def dump(limit=None):
         "spans": trace.spans(),
         "metrics": metrics.snapshot(),
         "series": metrics.series(),
+        "perf": perf.snapshot(),
         "counters": counters,
     }
 
 
-__all__ = ["trace", "metrics", "flight", "dump", "stats", "reset_stats"]
+__all__ = ["trace", "metrics", "flight", "perf", "dump", "stats",
+           "reset_stats"]

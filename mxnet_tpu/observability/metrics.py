@@ -48,8 +48,8 @@ from . import _STATS
 __all__ = ["counter", "gauge", "histogram", "get", "registry",
            "snapshot", "sample", "series", "render_prometheus",
            "flush_json", "start_flusher", "stop_flusher", "serve_http",
-           "update_slo", "note_span", "reset", "Counter", "Gauge",
-           "Histogram"]
+           "update_slo", "update_input_stall", "update_derived",
+           "note_span", "reset", "Counter", "Gauge", "Histogram"]
 
 _LOCK = threading.Lock()
 _REGISTRY: dict = {}
@@ -89,6 +89,14 @@ class _Metric:
     def value(self, **labels):
         with self._lock:
             return self._data.get(_labelset(self.labels, labels))
+
+    def remove(self, **labels):
+        """Drop one labelset's cell (derived gauges prune series whose
+        subject — a replica, an executable — no longer exists, so
+        exporters don't accrete unbounded label cardinality and stale
+        frozen values)."""
+        with self._lock:
+            self._data.pop(_labelset(self.labels, labels), None)
 
     def _snapshot(self):
         with self._lock:
@@ -135,6 +143,19 @@ class Histogram(_Metric):
     def __init__(self, name, help, labels, buckets=DEFAULT_BUCKETS):
         super().__init__(name, help, labels)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _snapshot(self):
+        # deep-copy each cell UNDER the lock: the generic shallow copy
+        # would hand exporters live cell dicts, and a renderer iterating
+        # `buckets` while observes land could emit a torn distribution
+        # (cumulative buckets exceeding `count`). One consistent point
+        # snapshot keeps the rendered cumulative series monotone with
+        # `le="+Inf"` == count by construction, even under racing
+        # observes (regression-tested).
+        with self._lock:
+            return {k: {"count": c["count"], "sum": c["sum"],
+                        "buckets": list(c["buckets"])}
+                    for k, c in self._data.items()}
 
     def _cell(self, key):
         cell = self._data.get(key)
@@ -245,19 +266,30 @@ _SLO_HEALTHY = gauge(
     "replicas currently in HEALTHY rotation", labels=("model",))
 
 
+def _ratio(num, den):
+    """num/den with the zero-denominator edge pinned to 0.0 — a derived
+    rate over an empty window must export 0 (or stay absent), never NaN
+    or a ZeroDivisionError that kills the exporter thread."""
+    return num / den if den else 0.0
+
+
 def update_slo():
     """Refresh the ``mxnet_tpu_fleet_*`` gauges from the live serving
-    layer. Called by every exporter; safe (and cheap) with no fleet."""
+    layer. Called by every exporter; safe (and cheap) with no fleet.
+    Division edges are explicit: a zero-request window leaves the rate
+    gauges absent (no data is not a 0% hit rate), an empty fleet or a
+    model with zero replicas reports 0 healthy replicas and 0-latency
+    percentiles rather than NaN."""
     try:
         from .. import serving
     except Exception:
         return
     s_requests = serving._STATS["fleet_requests"]
-    if s_requests:
-        _SLO_HIT_RATE.set(
-            1.0 - serving._STATS["fleet_deadline_exceeded"] / s_requests)
-        _SLO_SHED_RATE.set(
-            serving._STATS["fleet_shed_overloaded"] / s_requests)
+    if s_requests > 0:
+        _SLO_HIT_RATE.set(1.0 - _ratio(
+            serving._STATS["fleet_deadline_exceeded"], s_requests))
+        _SLO_SHED_RATE.set(_ratio(
+            serving._STATS["fleet_shed_overloaded"], s_requests))
     for fleet in serving._live_fleets():
         try:
             models = fleet.models()
@@ -266,15 +298,75 @@ def update_slo():
         for model in models:
             lat = []
             healthy = 0
-            for r in fleet._sup.replicas(model):
+            try:
+                replicas = fleet._sup.replicas(model)
+            except Exception:
+                replicas = ()  # a closing fleet's model set can race its
+            for r in replicas:  # supervisor teardown: report empty, not die
                 lat.extend(r.latency_snapshot())
                 healthy += 1 if r.state == "HEALTHY" else 0
                 _SLO_BREAKER.set(1 if r.breaker.is_open else 0,
                                  model=model, replica=r.rid)
             _SLO_HEALTHY.set(healthy, model=model)
             lat.sort()
+            # _percentile_us returns 0 for an empty window by contract
             _SLO_P50.set(serving._percentile_us(lat, 0.50), model=model)
             _SLO_P99.set(serving._percentile_us(lat, 0.99), model=model)
+
+
+# ------------------------------------------- derived training-input gauge
+
+# ROADMAP item 3's gate signal: the fraction of training-loop wall time
+# spent stalled on the input pipeline, derived from the span ring the
+# same way update_slo derives fleet gauges — no caller wiring.
+_INPUT_STALL = gauge(
+    "mxnet_tpu_input_stall_fraction",
+    "step.data_wait time / observed training-window wall time (first "
+    "span start to last span end over data_wait + training-step root "
+    "spans in the ring); 0 when the window has no training spans")
+
+_STEP_ROOT_SPANS = ("train.step", "train.sharded_step",
+                    "train.captured_step")
+
+
+def update_input_stall():
+    """Derive ``mxnet_tpu_input_stall_fraction`` from the ended-span
+    ring: time inside ``step.data_wait`` spans over the **wall-clock
+    window** those training spans cover (earliest start to latest end
+    across data_wait + step-root spans). The wall window — not the sum
+    of span durations — is the denominator because the eager path's
+    forward/backward runs in user code no span covers: ``train.step``
+    only spans the update phases there, and a sum-of-spans denominator
+    would report a compute-bound eager job as input-stalled. Requires
+    tracing on (``MXNET_TPU_OBS_TRACE``) to have data; an empty window
+    reports 0.0 — never NaN."""
+    from . import trace as _trace
+
+    wait = 0
+    t_min = None
+    t_max = None
+    for s in _trace.spans():
+        if s["name"] == "step.data_wait":
+            wait += s["dur_ns"]
+        elif s["name"] not in _STEP_ROOT_SPANS:
+            continue
+        t_min = s["t0_ns"] if t_min is None else min(t_min, s["t0_ns"])
+        end = s["t0_ns"] + s["dur_ns"]
+        t_max = end if t_max is None else max(t_max, end)
+    window = (t_max - t_min) if t_min is not None else 0
+    _INPUT_STALL.set(min(1.0, _ratio(wait, window)))
+
+
+def update_derived():
+    """Refresh every auto-derived gauge family — fleet SLO, input-stall
+    fraction, and the per-executable perf-ledger gauges — in one place.
+    Every exporter calls this, so derived series exist without any
+    caller wiring."""
+    update_slo()
+    update_input_stall()
+    from . import perf as _perf
+
+    _perf.update_gauges()
 
 
 # per-span-name cell cache for the note_span hot path: skips the
@@ -324,7 +416,7 @@ def snapshot():
     """Every instrument's current data as one JSON-friendly dict:
     ``{name: {"kind", "labels", "values": {flat-label-key: value}}}``
     (histogram values are ``{count, sum, buckets}``)."""
-    update_slo()
+    update_derived()
     out = {}
     for name, m in sorted(registry().items()):
         values = {}
@@ -362,7 +454,7 @@ def render_prometheus(include_runtime_counters=True):
     ``profiler.dispatch_stats()`` counter as an untyped
     ``mxnet_tpu_<name>`` sample, which is how the runtime's flat
     counters export without per-counter registration."""
-    update_slo()
+    update_derived()
     lines = []
     for name, m in sorted(registry().items()):
         if m.help:

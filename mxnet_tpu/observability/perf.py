@@ -1,0 +1,405 @@
+"""Performance attribution: the per-executable perf ledger, opt-in
+device timing, and MFU / roofline gauges.
+
+PR 10 made the runtime *legible* (span trees, metrics, flight
+recorder) but every span still measures host wall-clock around async
+dispatch, and nothing attributes cost to the *programs* the runtime
+actually runs. This module is the measurement substrate the remaining
+ROADMAP items (autotuning, input-stall gates, SLO control loops) stand
+on, in three layers:
+
+1. **Static attribution — the perf ledger.** Every compiled executable
+   that goes through the sanctioned capture/AOT compile path
+   (``capture.aot_compile``: captured trainer steps, ShardedTrainer
+   step/grads/apply programs, serving bucket executables in every
+   dtype variant) records one ledger entry keyed by its **existing AOT
+   fingerprint** (``<label>@<fingerprint16>``): XLA ``cost_analysis()``
+   (flops, bytes accessed), ``memory_analysis()`` (argument / output /
+   temp / generated-code bytes and the derived peak-HBM estimate) and
+   the wall compile time. The ledger is surfaced by
+   ``observability.dump()`` / ``tools/obs_dump.py`` and exported as
+   per-executable gauges (``mxnet_tpu_executable_peak_hbm_bytes``,
+   ``mxnet_tpu_compile_ms``, ...).
+
+2. **Dynamic attribution — device timing.** With
+   ``MXNET_TPU_OBS_DEVICE_TIME=1`` (or :func:`set_device_time`), every
+   ledgered executable call is wrapped in the dependency-chained
+   ``block_until_ready`` timing discipline PERF.md established: the
+   span splits into host-dispatch time (the async call returning) and
+   device-execute time (until the outputs are ready), recorded as a
+   retroactive ``perf.device_execute`` span under the caller's context
+   and folded into the ledger entry (``device_ms``, EWMA). OFF by
+   default — blocking per call serializes dispatch, so this is a
+   diagnosis mode, gated out of the ≤2% obs_bench overhead budget.
+
+3. **Derived gauges — MFU and roofline fraction.** From (1)+(2):
+   ``mfu = flops / (device_s · peak_flops)`` and
+   ``roofline_fraction = bytes_accessed / (device_s · peak_bw)`` per
+   executable, against nominal per-backend peaks (TPU / GPU / CPU
+   fallback; override with ``MXNET_TPU_PERF_PEAK_FLOPS`` /
+   ``MXNET_TPU_PERF_PEAK_GBPS``). Device time here is the full
+   dependency-chained wall (dispatch included) — an upper bound on
+   device busy time, so the gauges are conservative.
+
+``tools/perf_gate.py`` turns the ledger + measured step timings into a
+continuous regression gate against ``tools/perf_baseline.json``.
+Stdlib-only at import (jax loads lazily, and only in the paths that
+already hold compiled executables). See docs/observability.md
+("Performance attribution") and PERF.md round 6.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import _STATS
+from . import metrics as _metrics
+
+__all__ = ["LEDGER_FIELDS", "note_compile", "note_execution", "timed_call",
+           "ledger", "ledger_key", "combined_fingerprint", "snapshot",
+           "clear", "update_gauges", "device_time_enabled",
+           "set_device_time", "nominal_peaks"]
+
+_LOCK = threading.Lock()
+_LEDGER: dict = {}
+
+# THE field registry of one ledger entry. Every entry carries exactly
+# these keys (closure-tested), and every field is documented in
+# docs/observability.md — graftlint RD005 gates the drift, the same way
+# RD001/RD004 pin env knobs and metric names.
+LEDGER_FIELDS = (
+    "label",                 # compile-site label (trainer_step, serving_bucket8, ...)
+    "fingerprint",           # program+signature identity the key derives from
+    "backend",               # jax default backend at compile time (cpu/gpu/tpu)
+    "compiles",              # times this key compiled this process
+    "compile_ms",            # wall time of the latest trace+lower+XLA compile
+    "aot_hit",               # latest build deserialized from the AOT disk cache
+    "flops",                 # XLA cost_analysis flops (None when unavailable)
+    "bytes_accessed",        # XLA cost_analysis bytes accessed (None when unavailable)
+    "peak_hbm_bytes",        # argument+output+temp+generated_code-alias estimate
+    "argument_bytes",        # memory_analysis argument size
+    "output_bytes",          # memory_analysis output size
+    "temp_bytes",            # memory_analysis temp size
+    "generated_code_bytes",  # memory_analysis generated code size
+    "device_calls",          # dependency-chained timed executions (device mode)
+    "device_ms",             # EWMA of blocked wall per execution (device mode)
+    "dispatch_ms",           # EWMA of the async call returning (device mode)
+    "mfu",                   # flops / (device_s * nominal peak flops)
+    "roofline_fraction",     # bytes_accessed / (device_s * nominal HBM bandwidth)
+    "t",                     # wall-clock of the latest compile
+)
+
+_DEVICE_TIME = os.environ.get("MXNET_TPU_OBS_DEVICE_TIME", "").strip() in (
+    "1", "true", "on", "yes")
+
+# EWMA smoothing for per-execution device timings: heavy enough that a
+# one-off scheduling hiccup doesn't swing the MFU gauge, light enough
+# that a real regression shows within ~10 steps.
+_EWMA = 0.3
+
+# Nominal per-backend roofs for the MFU/roofline gauges: (flops/s,
+# HBM bytes/s). Order-of-magnitude nominals — TPU v4 bf16 MXU + HBM2e,
+# A100-class GPU, and a deliberately conservative CPU fallback so the
+# gauges are *defined* everywhere tests run. Real deployments override
+# per host with MXNET_TPU_PERF_PEAK_FLOPS / MXNET_TPU_PERF_PEAK_GBPS.
+_NOMINAL_PEAKS = {
+    "tpu": (275.0e12, 1228.0e9),
+    "gpu": (312.0e12, 2039.0e9),
+    "cpu": (2.0e11, 5.0e10),
+}
+
+
+def device_time_enabled():
+    return _DEVICE_TIME
+
+
+def set_device_time(flag):
+    """Toggle dependency-chained device timing at runtime (the
+    post-import counterpart of ``MXNET_TPU_OBS_DEVICE_TIME``); returns
+    the previous state."""
+    global _DEVICE_TIME
+    prev = _DEVICE_TIME
+    _DEVICE_TIME = bool(flag)
+    return prev
+
+
+def nominal_peaks(backend=None):
+    """(peak_flops_per_s, peak_hbm_bytes_per_s) for ``backend``
+    (default: jax's default backend, 'cpu' when jax is unavailable),
+    with the env overrides applied."""
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    flops, bw = _NOMINAL_PEAKS.get(backend, _NOMINAL_PEAKS["cpu"])
+    try:
+        flops = float(os.environ.get("MXNET_TPU_PERF_PEAK_FLOPS") or flops)
+    except ValueError:
+        pass
+    try:
+        bw = float(os.environ.get("MXNET_TPU_PERF_PEAK_GBPS") or 0) * 1e9 \
+            or bw
+    except ValueError:
+        pass
+    return flops, bw
+
+
+def ledger_key(label, fingerprint):
+    """The ledger key: the compile-site label + the first 16 hex chars
+    of the site's program+signature identity (see
+    :func:`combined_fingerprint` — the same structural identity the
+    persistent compile cache is keyed by, so a shape/dtype/code change
+    re-keys the entry instead of silently merging two programs)."""
+    fp = (fingerprint or "").strip()
+    return f"{label}@{fp[:16] if fp else 'none'}"
+
+
+def combined_fingerprint(fingerprint, sig):
+    """Fold a per-call aval/sharding signature into a compile site's
+    structural fingerprint — the ledger identity. The AOT disk cache
+    keys by (label, fingerprint, sig); a ledger keyed by fingerprint
+    alone would merge the distinct programs one CapturedExec compiles
+    for different batch shapes (elastic resize, partial tail batch)
+    into one entry with last-writer-wins numbers. Both the compile site
+    (``capture.aot_compile``) and the execution sites compute this from
+    the same inputs, so compile and execution attribution agree."""
+    import hashlib
+
+    base = (fingerprint or "").strip()
+    if not sig:
+        return base
+    return hashlib.sha256(f"{base}|{sig}".encode()).hexdigest()[:32]
+
+
+# ------------------------------------------------------------ cost analysis
+
+def _cost_numbers(compiled):
+    """(flops, bytes_accessed) from a compiled executable's XLA cost
+    analysis; (None, None) when the backend doesn't expose it. jax
+    returns either a per-computation list of dicts or one dict."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None, None
+    flops = ca.get("flops")
+    acc = ca.get("bytes accessed")
+    return (float(flops) if flops is not None else None,
+            float(acc) if acc is not None else None)
+
+
+def _memory_numbers(compiled):
+    """Memory footprint dict from ``memory_analysis()``; zeros when
+    unavailable. ``peak_hbm_bytes`` is the standard estimate
+    argument + output + temp + generated_code − alias (donated buffers
+    alias their inputs and must not be double-counted), clamped at 0."""
+    out = {"argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+           "generated_code_bytes": 0, "peak_hbm_bytes": 0}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return out
+    if ma is None:
+        return out
+    arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+    outp = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    tmp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    gen = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+    alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    out.update(argument_bytes=arg, output_bytes=outp, temp_bytes=tmp,
+               generated_code_bytes=gen,
+               peak_hbm_bytes=max(0, arg + outp + tmp + gen - alias))
+    return out
+
+
+def note_compile(label, fingerprint, compiled, compile_s, aot_hit=False):
+    """Record one compile into the ledger (called from
+    ``capture.aot_compile`` for every captured/serving executable).
+    ``compiled`` may be a lazily-jitted fallback without analysis
+    methods — the entry still lands with the wall compile time, so
+    `every executable has a ledger entry` holds even where XLA hides
+    its cost model. Returns the ledger key."""
+    key = ledger_key(label, fingerprint)
+    flops, acc = _cost_numbers(compiled)
+    mem = _memory_numbers(compiled)
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    with _LOCK:
+        entry = _LEDGER.get(key)
+        if entry is None:
+            entry = dict.fromkeys(LEDGER_FIELDS)
+            entry.update(label=label, fingerprint=fingerprint or "",
+                         compiles=0, device_calls=0)
+            _LEDGER[key] = entry
+            _STATS["perf_ledger_entries"] += 1
+        entry.update(mem)
+        entry.update(backend=backend, compile_ms=compile_s * 1e3,
+                     aot_hit=bool(aot_hit), flops=flops,
+                     bytes_accessed=acc, t=time.time())
+        entry["compiles"] += 1
+    return key
+
+
+def note_execution(label, fingerprint, blocked_s, dispatch_s=0.0):
+    """Fold one dependency-chained timed execution into the ledger
+    entry and refresh its derived MFU / roofline numbers. ``blocked_s``
+    is the full wall from launch until the outputs were ready (the
+    PERF.md discipline); ``dispatch_s`` the async call returning."""
+    key = ledger_key(label, fingerprint)
+    with _LOCK:
+        entry = _LEDGER.get(key)
+        if entry is None:
+            # executions can only follow a compile through aot_compile,
+            # but a cleared ledger (tests, gate runs) must not lose the
+            # timing — re-seed a minimal entry
+            entry = dict.fromkeys(LEDGER_FIELDS)
+            entry.update(label=label, fingerprint=fingerprint or "",
+                         compiles=0, device_calls=0)
+            _LEDGER[key] = entry
+            _STATS["perf_ledger_entries"] += 1
+        n = entry["device_calls"]
+        ms, disp = blocked_s * 1e3, dispatch_s * 1e3
+        if n == 0 or entry["device_ms"] is None:
+            entry["device_ms"], entry["dispatch_ms"] = ms, disp
+        else:
+            entry["device_ms"] += _EWMA * (ms - entry["device_ms"])
+            entry["dispatch_ms"] += _EWMA * (disp - entry["dispatch_ms"])
+        entry["device_calls"] = n + 1
+        dev_s = entry["device_ms"] / 1e3
+        if dev_s > 0:
+            peak_flops, peak_bw = nominal_peaks(entry["backend"])
+            if entry["flops"]:
+                entry["mfu"] = entry["flops"] / (dev_s * peak_flops)
+            if entry["bytes_accessed"]:
+                entry["roofline_fraction"] = \
+                    entry["bytes_accessed"] / (dev_s * peak_bw)
+    _STATS["perf_device_timings"] += 1
+    return key
+
+
+def timed_call(fn, args, label, fingerprint):
+    """Execute ``fn(*args)`` under the device-timing discipline when
+    enabled; a bare call otherwise (one global check — cheap enough for
+    every executable hot path). When timing: measure the async dispatch
+    returning, block until every output leaf is ready, record a
+    retroactive ``perf.device_execute`` span (host-dispatch vs
+    device-execute split in its attrs) under the caller's current trace
+    context, and fold the numbers into the ledger."""
+    if not _DEVICE_TIME:
+        return fn(*args)
+    t0 = time.perf_counter_ns()
+    out = fn(*args)
+    t_disp = time.perf_counter_ns()
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:
+        pass  # non-array outputs (already-host values) are already ready
+    t_ready = time.perf_counter_ns()
+    key = note_execution(label, fingerprint, (t_ready - t0) / 1e9,
+                         (t_disp - t0) / 1e9)
+    from . import trace as _trace
+
+    _trace.record("perf.device_execute", t0, t_ready - t0,
+                  executable=key, host_dispatch_ns=t_disp - t0,
+                  device_ns=t_ready - t_disp)
+    return out
+
+
+# -------------------------------------------------------------- introspection
+
+def ledger():
+    """Snapshot of every entry, keyed by ``<label>@<fingerprint16>``."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _LEDGER.items()}
+
+
+def snapshot():
+    """The ``observability.dump()`` section: entries + the roofline
+    constants they were judged against + the timing-mode flag."""
+    peak_flops, peak_bw = nominal_peaks()
+    return {"entries": ledger(),
+            "peaks": {"flops_per_s": peak_flops, "hbm_bytes_per_s": peak_bw},
+            "device_time": _DEVICE_TIME}
+
+
+def clear():
+    with _LOCK:
+        _LEDGER.clear()
+
+
+# ------------------------------------------------------------ derived gauges
+
+_PEAK_HBM = _metrics.gauge(
+    "mxnet_tpu_executable_peak_hbm_bytes",
+    "estimated peak HBM of one compiled executable "
+    "(argument+output+temp+generated code bytes)", labels=("executable",))
+_COMPILE_MS = _metrics.gauge(
+    "mxnet_tpu_compile_ms",
+    "wall compile time of the executable's latest build",
+    labels=("executable",))
+_EXEC_FLOPS = _metrics.gauge(
+    "mxnet_tpu_executable_flops",
+    "XLA cost-analysis flops per execution", labels=("executable",))
+_EXEC_BYTES = _metrics.gauge(
+    "mxnet_tpu_executable_bytes_accessed",
+    "XLA cost-analysis bytes accessed per execution",
+    labels=("executable",))
+_DEVICE_MS = _metrics.gauge(
+    "mxnet_tpu_device_ms",
+    "EWMA dependency-chained device time per execution "
+    "(MXNET_TPU_OBS_DEVICE_TIME)", labels=("executable",))
+_MFU = _metrics.gauge(
+    "mxnet_tpu_mfu",
+    "model flops utilization vs the backend's nominal peak",
+    labels=("executable",))
+_ROOFLINE = _metrics.gauge(
+    "mxnet_tpu_roofline_fraction",
+    "achieved HBM bandwidth fraction vs the backend's nominal peak",
+    labels=("executable",))
+
+
+_PERF_GAUGES = (_PEAK_HBM, _COMPILE_MS, _EXEC_FLOPS, _EXEC_BYTES,
+                _DEVICE_MS, _MFU, _ROOFLINE)
+
+
+def update_gauges():
+    """Refresh the per-executable gauges from the ledger — called by
+    every exporter via ``metrics.update_derived()``, so the ledger
+    exports without any caller wiring (the ``update_slo`` pattern).
+    Labelsets whose key left the ledger (re-fingerprinted program,
+    ``clear()``) are pruned, so a retrace-churny workload can't accrete
+    unbounded label cardinality or export dead executables' frozen
+    numbers forever."""
+    entries = ledger()
+    for g in _PERF_GAUGES:
+        for labelset in g.labelsets():
+            key = dict(labelset).get("executable")
+            if key not in entries:
+                g.remove(executable=key)
+    for key, e in entries.items():
+        _PEAK_HBM.set(e["peak_hbm_bytes"] or 0, executable=key)
+        if e["compile_ms"] is not None:
+            _COMPILE_MS.set(e["compile_ms"], executable=key)
+        if e["flops"] is not None:
+            _EXEC_FLOPS.set(e["flops"], executable=key)
+        if e["bytes_accessed"] is not None:
+            _EXEC_BYTES.set(e["bytes_accessed"], executable=key)
+        if e["device_calls"]:
+            _DEVICE_MS.set(e["device_ms"], executable=key)
+        if e["mfu"] is not None:
+            _MFU.set(e["mfu"], executable=key)
+        if e["roofline_fraction"] is not None:
+            _ROOFLINE.set(e["roofline_fraction"], executable=key)

@@ -67,6 +67,14 @@ Supported fault kinds (the hook that honours each is noted):
                                   with a structured
                                   CalibrationMismatchError — never a
                                   silently mis-scaled int8 model
+- ``perf_regression``           — inflate the measured perf numbers
+                                  entering ``tools/perf_gate.py``'s
+                                  baseline comparison, so the drill
+                                  proves the continuous perf-regression
+                                  gate actually fails (non-zero exit,
+                                  ``perf:regression`` flight events)
+                                  when an executable gets slower or
+                                  fatter
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -94,7 +102,8 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
            "maybe_hang", "maybe_oom_step", "maybe_peer_death",
            "maybe_replica_crash", "maybe_replica_hang",
-           "maybe_replica_nan_storm", "maybe_calib_table_drift"]
+           "maybe_replica_nan_storm", "maybe_calib_table_drift",
+           "maybe_perf_regression"]
 
 
 class SimulatedCrash(BaseException):
@@ -443,6 +452,24 @@ def maybe_calib_table_drift(table):
     if fault is None or not fault.should_fire():
         return table
     return table.stale_clone()
+
+
+def maybe_perf_regression(measured, factor=3.0):
+    """When ``perf_regression`` fires, return ``measured`` (the perf
+    gate's ``{key: {metric: value}}`` measurement dict) with every
+    numeric value inflated by ``factor`` — a synthetic across-the-board
+    slowdown/bloat the baseline comparison MUST catch. Hooked into
+    ``tools/perf_gate.py`` between measurement and comparison, so the
+    drill exercises the real gate logic, flight events included."""
+    if not _ACTIVE:
+        return measured
+    fault = _ACTIVE.get("perf_regression")
+    if fault is None or not fault.should_fire():
+        return measured
+    return {key: {m: (v * factor if isinstance(v, (int, float))
+                      and not isinstance(v, bool) else v)
+                  for m, v in metrics.items()}
+            for key, metrics in measured.items()}
 
 
 def maybe_peer_death():

@@ -69,3 +69,9 @@ def pytest_configure(config):
         "obs: unified observability layer (mxnet_tpu/observability/, "
         "docs/observability.md); fast cases run in tier-1, the "
         "obs_bench overhead gate carries the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "perf: performance attribution + regression gate "
+        "(mxnet_tpu/observability/perf.py, tools/perf_gate.py, "
+        "docs/observability.md); fast cases run in tier-1, the live "
+        "gate run carries the slow marker too")

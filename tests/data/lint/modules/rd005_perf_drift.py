@@ -1,0 +1,22 @@
+"""RD005 fixture: one undocumented perf-registry token must fire
+(the fixture tree has no docs/ at all); everything else is a clean
+near-miss (a non-registry ALL-CAPS tuple, a non-string element, a
+waived token, and a non-module-level declaration)."""
+
+# fires: a declared ledger field documented nowhere
+LEDGER_FIELDS = (
+    "fixture_undocumented_field",
+    "fixture_waived_field",  # graftlint: disable=RD005
+)
+
+# clean: not one of the perf registry declaration names
+OTHER_FIELDS = ("not_a_perf_registry_token",)
+
+# clean: non-string elements are ignored (only name tokens are audited)
+GATED_METRICS = (3.14,)
+
+
+def _not_module_level():
+    # clean: only module-level declarations are registries
+    LEDGER_FIELDS = ("inner_scope_not_a_registry",)  # noqa: F841
+    return LEDGER_FIELDS

@@ -129,6 +129,32 @@ def test_rd004_documented_metric_is_clean(tmp_path):
     assert got == [], got
 
 
+def test_rd005_exact(fixture_findings):
+    # one undocumented perf-registry token fires; the waived token, the
+    # non-registry tuple, the non-string element and the inner-scope
+    # declaration stay clean
+    got = _in_file(fixture_findings, "rd005_perf_drift.py")
+    assert got == [("RD005", "<module>", "fixture_undocumented_field")], got
+
+
+def test_rd005_documented_token_is_clean(tmp_path):
+    # a declared ledger field whose name appears in the docs does not
+    # fire — and the check is whole-token (a proper prefix of a
+    # documented name must not pass)
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "perf.py").write_text(
+        'LEDGER_FIELDS = ("documented_field", "documented_fiel")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `documented_field` | the one documented field |\n")
+    project = core.Project(str(tmp_path))
+    got = [(f.rule, f.token)
+           for f in core.run_all(project, rules={"RD005"})]
+    assert got == [("RD005", "documented_fiel")], got
+
+
 def test_rd001_rd003_miniproject():
     # the mini-project mirrors the repo's default layout, so this is
     # also a test of the CLI's zero-config Project defaults
@@ -165,7 +191,7 @@ def test_no_unexpected_fixture_findings(fixture_findings):
                "ts002_capture.py": 1, "ts003_donated_read.py": 1,
                "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
                "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1,
-               "rd004_obs_drift.py": 2}
+               "rd004_obs_drift.py": 2, "rd005_perf_drift.py": 1}
     per_file = {}
     for f in fixture_findings:
         per_file[os.path.basename(f.path)] = \

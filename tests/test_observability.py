@@ -537,6 +537,206 @@ def test_fleet_slo_gauges_derive():
         assert hit.value() == 1.0
 
 
+def test_update_slo_zero_request_window_never_nan():
+    """Regression (ISSUE 11 satellite): a zero-request window must
+    leave the rate gauges absent (no data), never NaN and never a
+    ZeroDivisionError killing the exporter thread."""
+    import math
+
+    metrics.reset()
+    serving.reset_stats()
+    metrics.update_slo()  # must not raise
+    for name in ("mxnet_tpu_fleet_deadline_hit_rate",
+                 "mxnet_tpu_fleet_shed_rate"):
+        v = metrics.get(name).value()
+        assert v is None or not math.isnan(v), name
+    assert metrics._ratio(5, 0) == 0.0
+    assert metrics._ratio(0, 0) == 0.0
+
+
+def test_update_slo_empty_fleet_reports_zero_not_nan():
+    """A live fleet whose model has zero replicas (mid-teardown, or a
+    supervisor that lost every replica) derives 0-latency percentiles
+    and 0 healthy replicas — not NaN, not an exception."""
+    import math
+
+    class _Sup:
+        def replicas(self, model):
+            return []
+
+    class _EmptyFleet:
+        _sup = _Sup()
+
+        def models(self):
+            return ["ghost_model"]
+
+        def _collect_latencies(self, lat, summaries):
+            pass
+
+        def _reset_latencies(self):
+            pass
+
+    ghost = _EmptyFleet()
+    serving._register_fleet(ghost)
+    try:
+        metrics.update_slo()  # must not raise
+        assert metrics.get("mxnet_tpu_fleet_healthy_replicas") \
+            .value(model="ghost_model") == 0
+        for name in ("mxnet_tpu_fleet_p50_us", "mxnet_tpu_fleet_p99_us"):
+            v = metrics.get(name).value(model="ghost_model")
+            assert v == 0 and not math.isnan(v), name
+    finally:
+        del ghost  # WeakSet entry dies with the reference
+
+
+# --------------------------------------------- input-stall fraction (derived)
+
+def test_input_stall_fraction_derives_from_span_window():
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    ms = 1_000_000
+    # wait [0,10ms) then a step [10,40ms): window 40ms, 10ms stalled
+    trace.record("step.data_wait", t0, 10 * ms)
+    trace.record("train.step", t0 + 10 * ms, 30 * ms)
+    metrics.update_input_stall()
+    g = metrics.get("mxnet_tpu_input_stall_fraction")
+    assert g.value() == pytest.approx(0.25)
+    # every training-step root extends the window denominator
+    trace.record("train.captured_step", t0 + 40 * ms, 40 * ms)
+    metrics.update_input_stall()
+    assert g.value() == pytest.approx(10 / 80)
+
+
+def test_input_stall_denominator_is_wall_window_not_span_sum():
+    """Review fix: the eager path's fwd/bwd runs in user code no span
+    covers (train.step only spans the update phases there) — the
+    denominator must be the wall window, or a compute-bound eager job
+    reads as input-stalled."""
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    ms = 1_000_000
+    # 10ms wait, 100ms UNSPANNED fwd/bwd gap, 5ms train.step update
+    trace.record("step.data_wait", t0, 10 * ms)
+    trace.record("train.step", t0 + 110 * ms, 5 * ms)
+    metrics.update_input_stall()
+    g = metrics.get("mxnet_tpu_input_stall_fraction")
+    # sum-of-spans would claim 10/15 = 0.67; the wall window gives
+    # 10/115 — the gap counts as compute, not stall
+    assert g.value() == pytest.approx(10 / 115)
+
+
+def test_input_stall_fraction_zero_window_is_zero():
+    trace.clear()
+    metrics.update_input_stall()
+    assert metrics.get("mxnet_tpu_input_stall_fraction").value() == 0.0
+
+
+def test_input_stall_fraction_exports_via_derived_refresh():
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    ms = 1_000_000
+    trace.record("step.data_wait", t0, 10 * ms)
+    trace.record("train.step", t0 + 10 * ms, 10 * ms)
+    text = metrics.render_prometheus()  # update_derived() runs inside
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("mxnet_tpu_input_stall_fraction ")][0]
+    assert float(line.rsplit(" ", 1)[1]) == pytest.approx(0.5)
+
+
+# ------------------------------------------ histogram concurrency (satellite)
+
+def test_histogram_observe_vs_registry_reset_race():
+    """Racing observes against metrics.reset() never raise, never leave
+    a torn cell: after the dust settles a fresh observation is exactly
+    what the registry reports."""
+    h = metrics.histogram("x_obs_race_ms", labels=("m",),
+                          buckets=(1, 10, 100))
+    stop = threading.Event()
+    errors = []
+
+    def observer():
+        try:
+            while not stop.is_set():
+                h.observe(5, m="a")
+                h.observe(50, m="b")
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=observer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            metrics.reset()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert not errors, errors
+    metrics.reset()
+    h.observe(5, m="a")
+    cell = h.value(m="a")
+    assert cell["count"] == 1 and sum(cell["buckets"]) == 1
+    assert cell["sum"] == 5.0
+
+
+def test_labeled_histogram_prometheus_monotone_under_racing_observes():
+    """The rendered cumulative form of a labeled histogram holds its
+    invariants while observes race the renderer: per labelset, bucket
+    counts are non-decreasing in `le`, `le="+Inf"` equals `_count`, and
+    `_count` never goes backwards between successive scrapes (the
+    snapshot is a consistent point copy, not live cell references)."""
+    import re as _re
+
+    h = metrics.histogram("x_obs_promrace_ms", labels=("m",),
+                          buckets=(1, 5, 25, 100))
+    stop = threading.Event()
+    errors = []
+    values = (0.5, 3.0, 20.0, 80.0, 300.0)
+
+    def observer(label):
+        try:
+            i = 0
+            while not stop.is_set():
+                h.observe(values[i % len(values)], m=label)
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=observer, args=(lab,))
+               for lab in ("a", "b") for _ in range(2)]
+    for t in threads:
+        t.start()
+    bucket_re = _re.compile(
+        r'^x_obs_promrace_ms_bucket\{m="([ab])",le="([^"]+)"\} (\d+)$')
+    count_re = _re.compile(r'^x_obs_promrace_ms_count\{m="([ab])"\} (\d+)$')
+    last_count = {}
+    try:
+        for _ in range(30):
+            series = {}
+            counts = {}
+            for ln in metrics.render_prometheus(
+                    include_runtime_counters=False).splitlines():
+                m = bucket_re.match(ln)
+                if m:
+                    series.setdefault(m.group(1), []).append(
+                        int(m.group(3)))
+                m = count_re.match(ln)
+                if m:
+                    counts[m.group(1)] = int(m.group(2))
+            for label, cum in series.items():
+                assert cum == sorted(cum), (label, cum)
+                assert cum[-1] == counts[label], (label, cum, counts)
+                assert counts[label] >= last_count.get(label, 0)
+                last_count[label] = counts[label]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert not errors, errors
+    assert last_count and all(v > 0 for v in last_count.values())
+
+
 def test_http_endpoint_serves_metrics_and_dump():
     import urllib.request
 
@@ -682,6 +882,7 @@ def test_monitor_rejects_unknown_emit():
 OBS_KEYS = frozenset({
     "obs_spans", "obs_spans_shipped", "obs_flight_events",
     "obs_metric_flushes", "obs_metric_samples", "obs_dumps",
+    "perf_ledger_entries", "perf_device_timings",
 })
 
 

@@ -49,3 +49,35 @@ def test_acceptance_mode_skips_without_datasets(tmp_path):
     report = json.loads(report_path.read_text())
     assert all(res["status"] == "skipped" for res in report["results"])
     assert len(report["results"]) == 5
+
+
+def test_perf_baseline_mode_validates_committed_store(tmp_path):
+    """--perf-baseline (ISSUE 11 satellite): the harness audits the
+    perf-regression baseline store's schema. The committed store must
+    pass; a store written under another key schema must fail loudly —
+    a fingerprint-schema change can never silently orphan it."""
+    report_path = tmp_path / "report.json"
+    r = subprocess.run(
+        [sys.executable, "tools/validate_baselines.py",
+         "--perf-baseline", "--report", str(report_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    report = json.loads(report_path.read_text())
+    res = {x["name"]: x for x in report["results"]}["perf_baseline"]
+    assert res["status"] == "passed" and res["problems"] == []
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({
+        "schema_version": 1, "key_schema": 999,
+        "backends": {"cpu": {"entries": {"a@ff00ff00": {"step_ms": 1}}}},
+    }))
+    r = subprocess.run(
+        [sys.executable, "tools/validate_baselines.py",
+         "--perf-baseline", str(stale),
+         "--report", str(report_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    report = json.loads(report_path.read_text())
+    res = {x["name"]: x for x in report["results"]}["perf_baseline"]
+    assert res["status"] == "failed"
+    assert any("key_schema" in p for p in res["problems"])

@@ -56,7 +56,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
               "peer_death_recover", "oom_step", "dist_connect_timeout",
               "capture_step", "replica_crash", "replica_hang",
-              "replica_nan_storm", "int8_calib_mismatch")
+              "replica_nan_storm", "int8_calib_mismatch",
+              "perf_regression")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -553,6 +554,48 @@ def _drill_int8_calib_mismatch(mx, workdir):
                 f"recovered_finite={bool(np.isfinite(out).all())}")
 
 
+def _drill_perf_regression(mx, workdir):
+    """The continuous perf gate must actually FAIL when an executable
+    regresses: armed, the fault inflates the measured numbers entering
+    ``tools/perf_gate.py``'s baseline comparison — every gated metric
+    blows its tolerance, each with a ``perf`` flight event — and
+    disarmed, the identical measurements pass clean (recovery = the
+    gate is discriminating, not just noisy)."""
+    import importlib.util
+
+    from mxnet_tpu.observability import flight
+    from mxnet_tpu.resilience import faults
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "perf_gate.py"))
+    perf_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_gate)
+
+    baseline = {
+        "trainer_step@feedfacefeedface": {
+            "step_ms": 1.0, "compile_ms": 50.0, "peak_hbm_bytes": 4096},
+        "serving_bucket8@deadbeefdeadbeef": {
+            "step_ms": 0.2, "compile_ms": 20.0, "peak_hbm_bytes": 1024},
+    }
+    current = {k: dict(v) for k, v in baseline.items()}
+    mark = flight.last_seq()
+    with faults.inject("perf_regression") as f:
+        regressions, rebaselined = perf_gate.compare(current, baseline)
+    perf_events = [e for e in flight.events(kind="perf",
+                                            since_seq=mark)
+                   if e.get("event") == "regression"]
+    detected = (f.fired == 1 and len(regressions) >= 1
+                and not rebaselined
+                and len(perf_events) == len(regressions))
+    # disarmed: the same measurements against the same baseline are clean
+    clean, _ = perf_gate.compare(current, baseline)
+    ok = detected and not clean
+    return ok, (f"fired={f.fired} regressions={len(regressions)} "
+                f"flight_perf_events={len(perf_events)} "
+                f"clean_after={not clean}")
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -598,6 +641,8 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_replica_fault(mx, tmp, kind)
     if kind == "int8_calib_mismatch":
         return _drill_int8_calib_mismatch(mx, tmp)
+    if kind == "perf_regression":
+        return _drill_perf_regression(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

@@ -114,6 +114,7 @@ def main(argv=None):
             "by_kind": _summarize_events(events),
             "spans": len(dump["spans"]),
             "metrics": len(dump["metrics"]),
+            "perf_ledger": sorted(dump["perf"]["entries"]),
             "counters": {k: v for k, v in dump["counters"].items()
                          if k.startswith("obs_")},
         }

@@ -26,6 +26,13 @@ Configs whose dataset flag is absent are SKIPPED (not failed). Each config
 runs as a subprocess (the same example entry points users run), the final
 metric is parsed from stdout, compared against the threshold, and the
 overall report is written as JSON with pass/fail per config.
+
+``--perf-baseline [PATH]`` additionally validates the perf-regression
+baseline store (``tools/perf_baseline.json``, docs/observability.md):
+schema-version and key-schema checks plus per-entry structure, via
+``tools/perf_gate.py``'s ``validate_baseline``. A fingerprint-schema
+change therefore fails HERE, loudly, instead of silently orphaning
+every key the perf gate would ever compare against.
 """
 from __future__ import annotations
 
@@ -126,6 +133,25 @@ def config_ssd(args, smoke=False):
     }
 
 
+def check_perf_baseline(path):
+    """Validate the perf-regression baseline store at ``path`` through
+    perf_gate's schema knowledge; returns a report-result dict."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    perf_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_gate)
+    _data, problems = perf_gate.load_baseline(path)
+    return {
+        "name": "perf_baseline",
+        "status": "passed" if not problems else "failed",
+        "path": path,
+        "problems": problems,
+        "reference": "docs/observability.md (performance attribution)",
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -144,6 +170,12 @@ def main():
                          "pass = metric parsed, not the accuracy bar")
     ap.add_argument("--timeout", type=int, default=24 * 3600,
                     help="per-config subprocess timeout (seconds)")
+    ap.add_argument("--perf-baseline", nargs="?", metavar="PATH",
+                    const=os.path.join(REPO, "tools", "perf_baseline.json"),
+                    default=None,
+                    help="validate the perf-regression baseline store "
+                         "(schema/key-schema/entry checks; default "
+                         "tools/perf_baseline.json)")
     args = ap.parse_args()
 
     candidates = [
@@ -157,6 +189,13 @@ def main():
 
     report = {"results": [], "all_passed": True,
               "mode": "smoke" if args.smoke else "acceptance"}
+    if args.perf_baseline is not None:
+        res = check_perf_baseline(args.perf_baseline)
+        report["results"].append(res)
+        report["all_passed"] &= res["status"] == "passed"
+        print(f"== perf_baseline: {res['status']}"
+              + "".join(f"\n   ! {p}" for p in res["problems"]),
+              flush=True)
     for path, build in candidates:
         cfg = build(args, smoke=args.smoke)
         if only and cfg["name"] not in only:
