@@ -52,6 +52,8 @@ RULES = {
              "trace.span literal name is unique per module",
     "RD005": "every declared perf-ledger field and perf-gate baseline "
              "metric is documented",
+    "RD006": "every registered alert-rule id is documented and drilled "
+             "or unit-tested",
 }
 
 _WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
@@ -141,6 +143,8 @@ class Project:
                  tool_dirs=("tools",),
                  chaos_files=("tools/chaos_run.py",),
                  extra_source_files=("tests/conftest.py",),
+                 alert_coverage_files=("tests/test_alerts.py",
+                                       "tools/chaos_run.py"),
                  exclude_dirs=("lint",)):
         self.root = os.path.abspath(root)
         self.package_dirs = tuple(package_dirs)
@@ -149,6 +153,7 @@ class Project:
         self.tool_dirs = tuple(tool_dirs)
         self.chaos_files = tuple(chaos_files)
         self.extra_source_files = tuple(extra_source_files)
+        self.alert_coverage_files = tuple(alert_coverage_files)
         self.exclude_dirs = set(exclude_dirs) | {"__pycache__"}
         self._modules = None
         self._aux = {}
@@ -218,6 +223,19 @@ class Project:
                               encoding="utf-8") as f:
                         chunks.append(f.read())
         for rel in self.doc_files:
+            path = os.path.join(self.root, rel)
+            if os.path.isfile(path):
+                with open(path, encoding="utf-8") as f:
+                    chunks.append(f.read())
+        return "\n".join(chunks)
+
+    def alert_coverage_text(self):
+        """Concatenated raw text of the files that count as alert-rule
+        coverage for RD006 (the alerts test suite and the chaos
+        harness) — whole-token occurrence of a rule id there is the
+        'drilled or unit-tested' evidence."""
+        chunks = []
+        for rel in self.alert_coverage_files:
             path = os.path.join(self.root, rel)
             if os.path.isfile(path):
                 with open(path, encoding="utf-8") as f:

@@ -1,4 +1,4 @@
-"""Registry-drift passes (RD001-RD005).
+"""Registry-drift passes (RD001-RD006).
 
 Five registries drift silently as the codebase grows: env knobs
 (``MXNET_TPU_*``) appear in code faster than in docs, counters get
@@ -14,7 +14,12 @@ registries (the perf ledger's per-executable fields, the perf gate's
 baseline metrics) are numbers an operator must be able to interpret
 and a baseline reviewer must be able to audit, so every declared
 ``LEDGER_FIELDS`` / ``GATED_METRICS`` token must appear under docs/.
-These passes pin each registry to its consumers.
+The alert-rule registry (``ALERT_RULE_IDS`` in
+``observability/alerts.py``) is held to the RD003 *and* RD005 bar at
+once: a rule that can page an operator must be documented under docs/
+(so the page is interpretable) and drilled or unit-tested (so the page
+is trustworthy) — RD006. These passes pin each registry to its
+consumers.
 
 Policy: RD findings describe *repository state*, not a single line, so
 the acceptance bar is zero — they are fixed (document the knob, declare
@@ -370,6 +375,59 @@ def _check_rd005(project, findings):
                 "docs/observability.md)"))
 
 
+# ------------------------------------------------------------------- RD006
+
+# The alert-rule registry: ``ALERT_RULE_IDS`` declared at module level
+# in observability/alerts.py (a runtime closure test pins the engine's
+# registered defaults to the declaration; this pass pins the
+# declaration to the docs AND to drill/test coverage).
+_ALERT_REGISTRY_NAMES = {"ALERT_RULE_IDS"}
+
+
+def _alert_rule_tokens(mod):
+    """``(token, node)`` for every string element of a module-level
+    ``ALERT_RULE_IDS = (...)`` tuple/list literal."""
+    out = []
+    for stmt in mod.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id in _ALERT_REGISTRY_NAMES
+                and isinstance(stmt.value, (ast.Tuple, ast.List))):
+            continue
+        for elt in stmt.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append((elt.value, elt))
+    return out
+
+
+def _check_rd006(project, findings):
+    doc_text = project.doc_text()
+    cov_text = project.alert_coverage_text()
+    seen = set()
+    for mod in project.knob_source_modules():
+        for token, node in _alert_rule_tokens(mod):
+            documented = _documented_token(token, doc_text)
+            covered = _documented_token(token, cov_text)
+            if token in seen or (documented and covered):
+                continue
+            if mod.waived("RD006", getattr(node, "lineno", 0)):
+                continue
+            seen.add(token)
+            missing = []
+            if not documented:
+                missing.append("documented under docs/ (add it to "
+                               "docs/observability.md's rule catalog)")
+            if not covered:
+                missing.append("exercised by tests/test_alerts.py or "
+                               "tools/chaos_run.py")
+            findings.append(Finding(
+                "RD006", mod.relpath, getattr(node, "lineno", 0),
+                "<module>", token,
+                f"alert rule `{token}` is not {' or '.join(missing)} — "
+                "an alert that pages an operator must be interpretable "
+                "and trustworthy"))
+
+
 def run(project):
     findings = []
     _check_rd001(project, findings)
@@ -377,4 +435,5 @@ def run(project):
     _check_rd003(project, findings)
     _check_rd004(project, findings)
     _check_rd005(project, findings)
+    _check_rd006(project, findings)
     return findings

@@ -32,6 +32,20 @@ package is that layer for the whole runtime (docs/observability.md):
   opt-in dependency-chained device timing
   (``MXNET_TPU_OBS_DEVICE_TIME``), and derived MFU / roofline gauges;
   ``tools/perf_gate.py`` gates it against a committed baseline.
+- :mod:`alerts` — the interpretation layer on top of all of the above:
+  declarative alert rules (multi-window SLO burn rate, live threshold
+  probes, statistical anomaly detectors) evaluated on the exporter
+  cadence, with per-rule FIRING/RESOLVED state, hold/cooldown flap
+  suppression, ``alert`` flight events, and correlated
+  :class:`~alerts.Incident` reports (evidence window + flight slice +
+  exemplar span trees + perf deltas + fleet states);
+  ``tools/obs_alerts.py`` is the CLI.
+- :mod:`traceview` — Chrome-trace timeline export:
+  ``traceview.to_chrome_trace()`` converts span records (fleet trees
+  included, pid/tid mapped from replica/thread identity) to Trace
+  Event Format JSON for Perfetto / ``chrome://tracing``;
+  ``tools/trace_export.py`` is the CLI and incidents embed their
+  exemplars' timeline.
 
 Everything here is stdlib-only at import so the hot paths (trainer,
 registry, serving) can instrument without dragging in jax.
@@ -50,6 +64,10 @@ _STATS = {
     "obs_dumps": 0,            # observability.dump() calls
     "perf_ledger_entries": 0,  # executables attributed in the perf ledger
     "perf_device_timings": 0,  # dependency-chained timed executions
+    "alert_evaluations": 0,          # alert-engine evaluation rounds
+    "alert_transitions": 0,          # FIRING/RESOLVED state transitions
+    "alert_incidents_opened": 0,     # incidents assembled on FIRING
+    "alert_incidents_resolved": 0,   # incidents closed on RESOLVED
 }
 
 
@@ -68,6 +86,8 @@ from . import trace  # noqa: E402
 from . import metrics  # noqa: E402
 from . import flight  # noqa: E402
 from . import perf  # noqa: E402
+from . import alerts  # noqa: E402
+from . import traceview  # noqa: E402
 
 # operator story: exporting metrics needs ONLY the env knob — with
 # MXNET_TPU_METRICS_FILE set, the background JSON-lines flusher arms
@@ -89,15 +109,17 @@ def dump(limit=None):
     except Exception:
         counters = {}
     return {
-        "schema_version": 1,
+        "schema_version": 2,
         "flight": flight.snapshot(limit=limit),
         "spans": trace.spans(),
         "metrics": metrics.snapshot(),
         "series": metrics.series(),
         "perf": perf.snapshot(),
+        "alerts": alerts.snapshot(),
+        "incidents": alerts.incidents(),
         "counters": counters,
     }
 
 
-__all__ = ["trace", "metrics", "flight", "perf", "dump", "stats",
-           "reset_stats"]
+__all__ = ["trace", "metrics", "flight", "perf", "alerts", "traceview",
+           "dump", "stats", "reset_stats"]

@@ -1,0 +1,858 @@
+"""Watchtower: SLO burn-rate alerting, anomaly detection, incidents.
+
+PRs 10–11 built the raw signal plane — span trees, typed metrics with
+derived SLO gauges, the flight recorder, the per-executable perf
+ledger — but nothing *interprets* it: an operator watching a fleet for
+millions of users still has to eyeball ``/metrics`` to notice an SLO
+burn. This module turns the telemetry from inspectable into
+diagnostic (docs/observability.md, "Alerting & incidents"):
+
+- **Alert rules** — a declarative registry of rules evaluated on the
+  existing exporter cadence (every ``metrics.update_derived()`` call,
+  i.e. every snapshot / Prometheus render / JSON-lines flush):
+  multi-window SLO **burn rate** over the fleet counters (deadline
+  hit-rate and shed rate, fast+slow windows — the SRE burn-rate
+  shape), live **threshold** probes (circuit breaker open,
+  healthy-replica floor, input-stall ceiling), and **statistical
+  anomaly detectors** (rolling median/MAD drift on step time, EWMA
+  device-time / MFU regression against the perf ledger, grad-norm /
+  health-skip spike).
+- **Per-rule state machine** — ``OK -> PENDING -> FIRING -> OK`` with
+  ``hold_s`` (a breach must persist before FIRING) and ``cooldown_s``
+  (conditions must stay clean before RESOLVED) to suppress flapping;
+  every FIRING/RESOLVED transition lands one ``alert`` event in the
+  flight recorder.
+- **Incidents** — a FIRING transition assembles one structured,
+  JSON-serializable :class:`Incident`: the rule's evidence window, the
+  flight-recorder slice covering it, the K slowest matching span trees
+  as exemplars (plus their Chrome-trace timeline via
+  :mod:`traceview`), perf-ledger entries for implicated executables,
+  and the fleet's replica/breaker states. Surfaced by
+  ``observability.dump()["incidents"]``, ``tools/obs_alerts.py``, the
+  ``/obs`` endpoint, and embedded in watchdog crash reports next to
+  the flight tail.
+
+Disabled (``MXNET_TPU_ALERTS=0`` or :func:`set_enabled`), the
+evaluation site is one global check — the tracing no-op discipline —
+and since evaluation rides the exporter cadence (never the step or
+request hot path), the ``tools/obs_bench.py`` <=2% overhead gate is
+untouched by construction. Stdlib-only at import.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+from collections import deque
+
+from . import _STATS
+from . import flight as _flight
+from . import metrics as _metrics
+from . import perf as _perf
+
+__all__ = ["AlertRule", "BurnRateRule", "ThresholdRule",
+           "StepTimeDriftRule", "PerfLedgerDropRule", "CounterSpikeRule",
+           "ALERT_RULE_IDS", "register_rule", "unregister_rule", "rules",
+           "get_rule", "evaluate", "maybe_evaluate", "enabled",
+           "set_enabled", "incidents", "open_incidents", "snapshot",
+           "reset", "Incident"]
+
+_LOCK = threading.Lock()
+_RULES: dict = {}
+_HISTORY: deque = deque(maxlen=512)   # evaluation observations (windows)
+_INCIDENTS: deque = None              # sized below from the env knob
+_INCIDENT_IDS = itertools.count(1)
+# REAL monotonic time of the last exporter-cadence evaluation. Kept
+# separate from any caller-supplied evaluation clock: rate-limiting
+# against a synthetic drill clock would let one large `now` suppress
+# real exporter ticks until the host clock caught up.
+_LAST_TICK = None
+
+_ENABLED = os.environ.get("MXNET_TPU_ALERTS", "").strip() not in (
+    "0", "false", "off", "no")
+
+
+def _env_float(name, default):
+    try:
+        raw = os.environ.get(name, "").strip()
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        raw = os.environ.get(name, "").strip()
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+_INCIDENTS = deque(maxlen=max(1, _env_int("MXNET_TPU_ALERT_INCIDENTS", 64)))
+
+# THE rule-id registry (graftlint RD006: every id must be documented
+# under docs/ AND exercised by tests/test_alerts.py or the chaos
+# harness; a closure test pins the registered defaults to this tuple).
+ALERT_RULE_IDS = (
+    "slo_deadline_burn",      # fleet deadline-miss burn rate, 2 windows
+    "slo_shed_burn",          # fleet overload-shed burn rate, 2 windows
+    "fleet_breaker_open",     # any live replica's circuit breaker open
+    "fleet_healthy_floor",    # a model's HEALTHY replicas under the floor
+    "input_stall_high",       # input-stall fraction over its ceiling
+    "step_time_drift",        # step time outside median + k*MAD
+    "perf_device_regression", # ledger device_ms/MFU off its own EWMA
+    "health_skip_spike",      # sentinel skips/grad-norm trips spiking
+)
+
+
+def enabled():
+    return _ENABLED
+
+
+def set_enabled(flag):
+    """Turn alert evaluation on/off at runtime (the post-import
+    counterpart of ``MXNET_TPU_ALERTS``); returns the previous state."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    return prev
+
+
+# ------------------------------------------------------------------ context
+
+class _EvalContext:
+    """Everything one evaluation round hands the rules: the clock, the
+    fresh observation (windowed counters), the history ring, and lazy
+    accessors for the live sources (fleets, span ring, perf ledger)."""
+
+    def __init__(self, now, obs, history, input_stall=None):
+        self.now = now
+        self.obs = obs
+        self.history = history
+        # input-stall fraction already derived this tick (update_derived
+        # passes its own update_input_stall() result so the gauge and
+        # the rule judge the same number, once); None = derive on demand
+        self.input_stall = input_stall
+
+    def windowed(self, group, key, window_s):
+        """Delta of ``history[...][group][key]`` over the trailing
+        ``window_s`` seconds (the newest sample at or before
+        ``now - window_s``; the oldest sample when the history is
+        younger than the window). Returns 0 with fewer than 2 samples."""
+        cur = self.obs.get(group, {}).get(key, 0)
+        base = None
+        for h in self.history:
+            if h is self.obs:
+                continue
+            if h["now"] <= self.now - window_s:
+                base = h
+            else:
+                break
+        if base is None:
+            for h in self.history:
+                if h is not self.obs:
+                    base = h
+                    break
+        if base is None:
+            return 0
+        return cur - base.get(group, {}).get(key, 0)
+
+    def seq_at(self, window_s):
+        """Flight-recorder bookmark at (or before) ``now - window_s`` —
+        the start of an incident's evidence slice."""
+        seq = None
+        for h in self.history:
+            seq = h["seq"] if seq is None else seq
+            if h["now"] <= self.now - window_s:
+                seq = h["seq"]
+            else:
+                break
+        return seq or 0
+
+    def fleets(self):
+        try:
+            import sys
+
+            serving = sys.modules.get("mxnet_tpu.serving")
+            if serving is None:
+                return []
+            return serving._live_fleets()
+        except Exception:
+            return []
+
+
+def _slo_counters():
+    """The fleet SLO counter triple the burn-rate rules consume — the
+    same ``slo_burn``-hook-applied view ``metrics.update_slo`` derives
+    its gauges from (``metrics.slo_counters``), so the drill's injected
+    burn reaches gauges and alert windows identically. Empty until the
+    serving layer has actually been imported (a light process must not
+    drag it in just to evaluate rules)."""
+    import sys
+
+    if sys.modules.get("mxnet_tpu.serving") is None:
+        return {}
+    try:
+        return _metrics.slo_counters()
+    except Exception:
+        return {}
+
+
+def _health_counters():
+    try:
+        import sys
+
+        sentinel = sys.modules.get("mxnet_tpu.resilience.sentinel")
+        if sentinel is None:
+            return {}
+        return {
+            "health_skipped_steps": sentinel._STATS["health_skipped_steps"],
+            "sentinel_grad_norm_trips":
+                sentinel._STATS["sentinel_grad_norm_trips"],
+        }
+    except Exception:
+        return {}
+
+
+# -------------------------------------------------------------------- rules
+
+class AlertRule:
+    """One declarative rule. Subclasses implement :meth:`check` ->
+    ``(breached, evidence)``; the engine owns the OK/PENDING/FIRING
+    state machine, hold/cooldown timing, flight events and incident
+    assembly. ``span_names`` hints which span trees make good incident
+    exemplars; ``window_s`` sizes the incident's evidence slice."""
+
+    def __init__(self, id, description="", severity="page", hold_s=None,
+                 cooldown_s=None, span_names=(), window_s=None):
+        self.id = str(id)
+        self.description = description
+        self.severity = severity
+        self.hold_s = _env_float("MXNET_TPU_ALERT_HOLD_S", 0.0) \
+            if hold_s is None else float(hold_s)
+        self.cooldown_s = _env_float("MXNET_TPU_ALERT_COOLDOWN_S", 60.0) \
+            if cooldown_s is None else float(cooldown_s)
+        self.span_names = tuple(span_names)
+        self.window_s = float(window_s) if window_s is not None else \
+            _env_float("MXNET_TPU_ALERT_BURN_SLOW_S", 300.0)
+        # state machine (engine-owned, under the module lock)
+        self.state = "OK"
+        self.pending_since = None
+        self.last_breach = None
+        self.incident_id = None
+        self.last_evidence = None
+
+    def check(self, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def describe(self):
+        return {"id": self.id, "severity": self.severity,
+                "state": self.state, "hold_s": self.hold_s,
+                "cooldown_s": self.cooldown_s,
+                "description": self.description}
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window SLO burn rate (the SRE alerting shape): with an
+    objective of e.g. 99% deadline hit-rate the error budget is 1%,
+    and ``burn = windowed_error_rate / budget``. The rule fires only
+    when BOTH the fast and the slow window burn at >= ``factor``×
+    budget — the fast window gives detection latency, the slow window
+    keeps a one-sample blip from paging."""
+
+    def __init__(self, id, num_key, den_key, objective=None, fast_s=None,
+                 slow_s=None, factor=None, **kw):
+        self.num_key = num_key
+        self.den_key = den_key
+        self.objective = _env_float("MXNET_TPU_ALERT_SLO_TARGET", 0.99) \
+            if objective is None else float(objective)
+        self.fast_s = _env_float("MXNET_TPU_ALERT_BURN_FAST_S", 60.0) \
+            if fast_s is None else float(fast_s)
+        self.slow_s = _env_float("MXNET_TPU_ALERT_BURN_SLOW_S", 300.0) \
+            if slow_s is None else float(slow_s)
+        self.factor = _env_float("MXNET_TPU_ALERT_BURN_FACTOR", 4.0) \
+            if factor is None else float(factor)
+        kw.setdefault("span_names", ("serve.request",))
+        kw.setdefault("window_s", self.slow_s)
+        super().__init__(id, **kw)
+
+    def _burn(self, ctx, window_s):
+        num = ctx.windowed("slo", self.num_key, window_s)
+        den = ctx.windowed("slo", self.den_key, window_s)
+        if den <= 0:
+            return 0.0, num, den
+        budget = max(1e-9, 1.0 - self.objective)
+        return (num / den) / budget, num, den
+
+    def check(self, ctx):
+        fast, fnum, fden = self._burn(ctx, self.fast_s)
+        slow, snum, sden = self._burn(ctx, self.slow_s)
+        breached = fast >= self.factor and slow >= self.factor
+        evidence = {
+            "objective": self.objective, "burn_factor": self.factor,
+            "windows": {
+                "fast": {"window_s": self.fast_s, "burn": round(fast, 3),
+                         self.num_key: fnum, self.den_key: fden},
+                "slow": {"window_s": self.slow_s, "burn": round(slow, 3),
+                         self.num_key: snum, self.den_key: sden},
+            },
+        }
+        return breached, evidence
+
+
+class ThresholdRule(AlertRule):
+    """Live threshold probe: ``value_fn(ctx) -> (value, detail)`` read
+    against ``threshold`` with comparator ``op`` (one of ``>`` ``>=``
+    ``<`` ``<=``). ``value=None`` means no data — never a breach."""
+
+    _OPS = {">": lambda v, t: v > t, ">=": lambda v, t: v >= t,
+            "<": lambda v, t: v < t, "<=": lambda v, t: v <= t}
+
+    def __init__(self, id, value_fn, op, threshold, **kw):
+        super().__init__(id, **kw)
+        self.value_fn = value_fn
+        self.op = op
+        self.threshold = threshold
+
+    def check(self, ctx):
+        value, detail = self.value_fn(ctx)
+        if value is None:
+            return False, None
+        breached = self._OPS[self.op](value, self.threshold)
+        evidence = {"value": value, "op": self.op,
+                    "threshold": self.threshold}
+        if detail:
+            evidence.update(detail)
+        return breached, evidence
+
+
+class StepTimeDriftRule(AlertRule):
+    """Rolling median/MAD drift detector on training-step wall time:
+    ingests every new step-root span duration from the trace ring
+    (``MXNET_TPU_OBS_TRACE`` must be on for it to have data), keeps a
+    window of recent durations, and breaches when a new step lands
+    beyond ``median + k * 1.4826*MAD`` with at least ``min_n`` clean
+    samples banked. Outliers are NOT folded into the baseline, so a
+    sustained anomaly keeps breaching instead of normalizing itself.
+    The ``step_time_anomaly`` fault hook inflates each ingested
+    duration — the chaos drill's injection point."""
+
+    STEP_ROOTS = ("train.step", "train.sharded_step",
+                  "train.captured_step")
+
+    def __init__(self, id, k=None, min_n=8, window_n=64, **kw):
+        kw.setdefault("span_names", self.STEP_ROOTS)
+        super().__init__(id, **kw)
+        self.k = _env_float("MXNET_TPU_ALERT_MAD_K", 6.0) \
+            if k is None else float(k)
+        self.min_n = int(min_n)
+        self.durs = deque(maxlen=int(window_n))
+        self.last_t0 = 0
+
+    def _new_durations(self):
+        from . import trace as _trace
+
+        try:
+            from ..resilience import faults
+            inflate = faults.maybe_step_time_anomaly
+        except Exception:
+            def inflate(d):
+                return d
+        out = []
+        high = self.last_t0
+        for s in _trace.spans():
+            if s["name"] not in self.STEP_ROOTS or \
+                    s["t0_ns"] <= self.last_t0:
+                continue
+            out.append(inflate(s["dur_ns"]))
+            high = max(high, s["t0_ns"])
+        self.last_t0 = high
+        return out
+
+    @staticmethod
+    def _median(values):
+        vals = sorted(values)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+    def check(self, ctx):
+        worst = None
+        med = mad = None
+        for dur in self._new_durations():
+            if len(self.durs) >= self.min_n:
+                med = self._median(self.durs)
+                mad = self._median([abs(d - med) for d in self.durs])
+                sigma = 1.4826 * mad
+                # two floors under the envelope: the spread floor (5%
+                # of the median) keeps a perfectly steady loop (MAD ~0)
+                # from paging on scheduler dust, and the hard 2x floor
+                # means only at least a DOUBLING of step time can ever
+                # page — one CI scheduling blip is not an anomaly
+                limit = max(med + self.k * max(sigma, 0.05 * med),
+                            2.0 * med)
+                if dur > limit:
+                    if worst is None or dur > worst["dur_ns"]:
+                        worst = {"dur_ns": dur, "limit_ns": limit,
+                                 "median_ns": med, "mad_ns": mad}
+                    continue  # outliers stay out of the baseline
+            self.durs.append(dur)
+        if worst is None:
+            return False, None
+        keys = sorted(k for k, e in _perf.ledger().items()
+                      if not str(e.get("label", "")).startswith("serving"))
+        worst.update(k=self.k, n=len(self.durs), ledger_keys=keys)
+        return True, worst
+
+
+class PerfLedgerDropRule(AlertRule):
+    """Device-time / MFU regression against the perf ledger's own EWMA
+    (``MXNET_TPU_OBS_DEVICE_TIME`` feeds it): the rule banks a slow
+    EWMA baseline per ledger key and breaches when the live
+    ``device_ms`` rises (or ``mfu`` falls) beyond ``tolerance`` of it."""
+
+    def __init__(self, id, tolerance=None, min_calls=5, alpha=0.05, **kw):
+        kw.setdefault("span_names", ("perf.device_execute",))
+        super().__init__(id, **kw)
+        self.tolerance = _env_float("MXNET_TPU_ALERT_PERF_TOL", 0.5) \
+            if tolerance is None else float(tolerance)
+        self.min_calls = int(min_calls)
+        self.alpha = float(alpha)
+        self.baselines: dict = {}   # key -> {device_ms, mfu}
+
+    def check(self, ctx):
+        regressed = {}
+        live = set()
+        for key, e in _perf.device_timed_entries(self.min_calls).items():
+            live.add(key)
+            base = self.baselines.get(key)
+            if base is None:
+                self.baselines[key] = {"device_ms": e["device_ms"],
+                                       "mfu": e.get("mfu")}
+                continue
+            slow = e["device_ms"] > base["device_ms"] * (1 + self.tolerance)
+            mfu_drop = (e.get("mfu") is not None
+                        and base.get("mfu")
+                        and e["mfu"] < base["mfu"] * (1 - self.tolerance))
+            if slow or mfu_drop:
+                regressed[key] = {
+                    "device_ms": e["device_ms"],
+                    "baseline_device_ms": round(base["device_ms"], 4),
+                    "mfu": e.get("mfu"), "baseline_mfu": base.get("mfu"),
+                    "tolerance": self.tolerance,
+                }
+                continue  # a regressed sample must not drag the baseline
+            base["device_ms"] += self.alpha * (e["device_ms"]
+                                               - base["device_ms"])
+            if e.get("mfu") is not None:
+                prev = base.get("mfu") or e["mfu"]
+                base["mfu"] = prev + self.alpha * (e["mfu"] - prev)
+        for key in list(self.baselines):
+            if key not in live:
+                del self.baselines[key]  # re-fingerprinted program
+        if not regressed:
+            return False, None
+        return True, {"regressed": regressed,
+                      "ledger_keys": sorted(regressed)}
+
+
+class CounterSpikeRule(AlertRule):
+    """Windowed counter spike: the summed delta of ``keys`` (history
+    group ``group``) over the fast window reaching ``threshold``."""
+
+    def __init__(self, id, group, keys, threshold=None, window_s=None,
+                 **kw):
+        fast = _env_float("MXNET_TPU_ALERT_BURN_FAST_S", 60.0)
+        # the detection window IS the evidence window (base window_s)
+        kw.setdefault("window_s", fast if window_s is None else window_s)
+        super().__init__(id, **kw)
+        self.group = group
+        self.keys = tuple(keys)
+        self.threshold = _env_float("MXNET_TPU_ALERT_SKIP_SPIKE", 3.0) \
+            if threshold is None else float(threshold)
+
+    def check(self, ctx):
+        per_key = {k: ctx.windowed(self.group, k, self.window_s)
+                   for k in self.keys}
+        total = sum(per_key.values())
+        if total < self.threshold:
+            return False, None
+        return True, {"window_s": self.window_s, "total": total,
+                      "threshold": self.threshold, "by_counter": per_key}
+
+
+# -------------------------------------------------------- threshold probes
+
+def _probe_breakers(ctx):
+    open_cells = []
+    saw_fleet = False
+    for fleet in ctx.fleets():
+        try:
+            for model in fleet.models():
+                for r in fleet._sup.replicas(model):
+                    saw_fleet = True
+                    if r.breaker.is_open:
+                        open_cells.append(f"{model}/{r.rid}")
+        except Exception:
+            continue
+    if not saw_fleet:
+        return None, None
+    return len(open_cells), {"open": sorted(open_cells)}
+
+
+def _probe_healthy_floor(ctx):
+    worst = None
+    detail = {}
+    for fleet in ctx.fleets():
+        try:
+            for model in fleet.models():
+                replicas = fleet._sup.replicas(model)
+                if not replicas:
+                    continue
+                healthy = sum(1 for r in replicas if r.state == "HEALTHY")
+                detail[model] = healthy
+                worst = healthy if worst is None else min(worst, healthy)
+        except Exception:
+            continue
+    if worst is None:
+        return None, None
+    return worst, {"healthy_by_model": detail}
+
+
+def _probe_input_stall(ctx):
+    if ctx.input_stall is not None:
+        return ctx.input_stall, None
+    return _metrics.update_input_stall(), None
+
+
+def _default_rules():
+    floor = _env_float("MXNET_TPU_ALERT_HEALTHY_FLOOR", 1.0)
+    stall_max = _env_float("MXNET_TPU_ALERT_STALL_MAX", 0.5)
+    return (
+        BurnRateRule(
+            "slo_deadline_burn", "fleet_deadline_exceeded",
+            "fleet_requests",
+            description="fleet deadline-miss rate burning the SLO error "
+                        "budget in both the fast and slow window"),
+        BurnRateRule(
+            "slo_shed_burn", "fleet_shed_overloaded", "fleet_requests",
+            description="fleet overload-shed rate burning the SLO error "
+                        "budget in both the fast and slow window"),
+        ThresholdRule(
+            "fleet_breaker_open", _probe_breakers, ">=", 1,
+            span_names=("serve.request",),
+            description="at least one live replica's circuit breaker is "
+                        "open (requests are being rerouted around it)"),
+        ThresholdRule(
+            "fleet_healthy_floor", _probe_healthy_floor, "<", floor,
+            span_names=("serve.request",),
+            description="a served model has fewer HEALTHY replicas than "
+                        "the configured floor"),
+        ThresholdRule(
+            "input_stall_high", _probe_input_stall, ">", stall_max,
+            span_names=("step.data_wait",),
+            description="the training loop is input-bound: "
+                        "mxnet_tpu_input_stall_fraction over its ceiling"),
+        StepTimeDriftRule(
+            "step_time_drift",
+            description="training-step wall time drifted outside "
+                        "median + k*MAD of its recent history"),
+        PerfLedgerDropRule(
+            "perf_device_regression",
+            description="a ledgered executable's EWMA device time rose "
+                        "(or its MFU fell) beyond tolerance of its own "
+                        "baseline"),
+        CounterSpikeRule(
+            "health_skip_spike", "health",
+            ("health_skipped_steps", "sentinel_grad_norm_trips"),
+            description="HealthSentinel skips / grad-norm trips spiking "
+                        "inside one fast window"),
+    )
+
+
+def register_rule(rule):
+    """Register (or replace) one rule; returns it. Default rules are
+    registered at import — :func:`reset` restores exactly that set."""
+    with _LOCK:
+        _RULES[rule.id] = rule
+    return rule
+
+
+def unregister_rule(rule_id):
+    with _LOCK:
+        return _RULES.pop(rule_id, None) is not None
+
+
+def rules():
+    with _LOCK:
+        return dict(_RULES)
+
+
+def get_rule(rule_id):
+    with _LOCK:
+        return _RULES.get(rule_id)
+
+
+# ---------------------------------------------------------------- incidents
+
+class Incident(dict):
+    """One correlated diagnosis bundle (a plain dict subclass so it
+    JSON-serializes as-is). Keys: ``id``, ``rule``, ``severity``,
+    ``description``, ``status`` (open|resolved), ``opened_t`` /
+    ``opened_now`` / ``resolved_t`` / ``resolved_now``, ``evidence``
+    (the rule's window math), ``flight`` (the recorder slice covering
+    the evidence window), ``exemplars`` (the K slowest matching span
+    trees), ``chrome_trace`` (their Trace Event Format timeline),
+    ``perf`` (ledger entries for implicated executables), and
+    ``fleet`` (replica/breaker states at open time)."""
+
+
+def _exemplar_trees(span_names, k):
+    """The ``k`` slowest root spans matching ``span_names`` (all roots
+    when empty), each expanded to its full tree (every ring record
+    sharing the trace id). Trees are slowest-first and each tree lists
+    its ROOT record first (descendants follow in ring order — children
+    end before their parents, so raw ring order buries the root)."""
+    from . import trace as _trace
+
+    recs = _trace.spans()
+    roots = _trace.roots(names=span_names)
+    roots.sort(key=lambda r: r["dur_ns"], reverse=True)
+    trees = []
+    for root in roots[:k]:
+        rest = [r for r in recs
+                if r["trace"] == root["trace"] and r is not root]
+        trees.append([root] + rest)
+    return trees
+
+
+def _fleet_states():
+    out = []
+    try:
+        import sys
+
+        serving = sys.modules.get("mxnet_tpu.serving")
+        if serving is None:
+            return out
+        for fleet in serving._live_fleets():
+            try:
+                for model in fleet.models():
+                    for r in fleet._sup.replicas(model):
+                        out.append({"model": model, "replica": r.rid,
+                                    "state": r.state,
+                                    "breaker_open": bool(r.breaker.is_open)})
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return out
+
+
+def _open_incident(rule, evidence, ctx):
+    since = ctx.seq_at(rule.window_s)
+    flight_slice = _flight.events(since_seq=since)
+    k = max(1, _env_int("MXNET_TPU_ALERT_EXEMPLARS", 3))
+    trees = _exemplar_trees(rule.span_names, k)
+    inc = Incident(
+        id=f"inc-{next(_INCIDENT_IDS)}",
+        rule=rule.id,
+        severity=rule.severity,
+        description=rule.description,
+        status="open",
+        opened_t=time.time(),
+        opened_now=ctx.now,
+        resolved_t=None,
+        resolved_now=None,
+        evidence=evidence or {},
+        flight=flight_slice,
+        exemplars=trees,
+        perf={key: e for key, e in _perf.ledger().items()
+              if key in set((evidence or {}).get("ledger_keys", ()))},
+        fleet=_fleet_states(),
+    )
+    if os.environ.get("MXNET_TPU_ALERT_CHROME_TRACE", "").strip() not in (
+            "0", "false", "off", "no"):
+        try:
+            from . import traceview
+
+            inc["chrome_trace"] = traceview.to_chrome_trace(
+                [r for tree in trees for r in tree])
+        except Exception:
+            inc["chrome_trace"] = None
+    with _LOCK:
+        _INCIDENTS.append(inc)
+    _STATS["alert_incidents_opened"] += 1
+    return inc
+
+
+def incidents(status=None, limit=None):
+    """Recorded incidents, oldest first; optionally filtered by
+    ``status`` (``open``/``resolved``) and truncated to the newest
+    ``limit``. Entries are live dicts of a bounded ring — treat as
+    read-only snapshots."""
+    with _LOCK:
+        out = list(_INCIDENTS)
+    if status is not None:
+        out = [i for i in out if i["status"] == status]
+    if limit is not None and limit >= 0:
+        out = out[-limit:] if limit else []  # -0: would slice ALL
+    return out
+
+
+def open_incidents():
+    return incidents(status="open")
+
+
+# ------------------------------------------------------------------- engine
+
+def _advance(rule, breached, evidence, ctx):
+    """One state-machine step for one rule; returns a transition string
+    (``FIRING``/``RESOLVED``) or None. Runs outside the module lock
+    (incident assembly reads other subsystems); per-rule state is only
+    touched from the engine, which is serialized by ``_EVAL_LOCK``."""
+    now = ctx.now
+    if breached:
+        rule.last_breach = now
+        rule.last_evidence = evidence
+        if rule.state == "OK":
+            rule.pending_since = now
+            rule.state = "PENDING"
+        if rule.state == "PENDING" and \
+                now - rule.pending_since >= rule.hold_s:
+            rule.state = "FIRING"
+            inc = _open_incident(rule, evidence, ctx)
+            rule.incident_id = inc["id"]
+            _flight.record("alert", rule=rule.id, state="FIRING",
+                           severity=rule.severity, incident=inc["id"])
+            _STATS["alert_transitions"] += 1
+            return "FIRING"
+        return None
+    if rule.state == "PENDING":
+        rule.state = "OK"
+        rule.pending_since = None
+        return None
+    if rule.state == "FIRING" and \
+            now - (rule.last_breach or now) >= rule.cooldown_s:
+        rule.state = "OK"
+        rule.pending_since = None
+        incident_id = rule.incident_id
+        rule.incident_id = None
+        with _LOCK:
+            for inc in _INCIDENTS:
+                if inc["id"] == incident_id:
+                    inc["status"] = "resolved"
+                    inc["resolved_t"] = time.time()
+                    inc["resolved_now"] = now
+        _flight.record("alert", rule=rule.id, state="RESOLVED",
+                       incident=incident_id)
+        _STATS["alert_transitions"] += 1
+        _STATS["alert_incidents_resolved"] += 1
+        return "RESOLVED"
+    return None
+
+
+_EVAL_LOCK = threading.Lock()
+
+
+def evaluate(now=None, force=False, slo=None, input_stall=None):
+    """Run every registered rule once against a fresh observation.
+    ``now`` (monotonic seconds) defaults to ``time.monotonic()`` —
+    tests and drills pass a synthetic clock to drive windows and
+    hold/cooldown deterministically. ``slo`` / ``input_stall`` reuse
+    values already derived this tick (``update_derived`` shares its
+    ``slo_counters()`` view and its input-stall fraction so gauges and
+    rules judge the same numbers, each derived once). Returns
+    ``{rule_id: transition}`` for the rules that transitioned this
+    round, or None when alerting is disabled (pass ``force=True`` to
+    evaluate anyway)."""
+    if not _ENABLED and not force:
+        return None
+    if now is None:
+        now = time.monotonic()
+    with _EVAL_LOCK:
+        obs = {"now": now, "seq": _flight.last_seq(),
+               "slo": _slo_counters() if slo is None else slo,
+               "health": _health_counters()}
+        with _LOCK:
+            # a clock that moved backwards (a synthetic test clock after
+            # a real-clock run, or vice versa) restarts the window
+            # history AND re-bases per-rule timestamps into the new
+            # clock domain — a rule left FIRING under the old clock
+            # would otherwise compare `now - last_breach` across
+            # domains and never satisfy its cooldown (stuck open)
+            if _HISTORY and _HISTORY[-1]["now"] > now:
+                _HISTORY.clear()
+                for r in _RULES.values():
+                    if r.last_breach is not None and r.last_breach > now:
+                        r.last_breach = now
+                    if r.pending_since is not None and \
+                            r.pending_since > now:
+                        r.pending_since = now
+            _HISTORY.append(obs)
+            history = list(_HISTORY)
+            current = list(_RULES.values())
+        ctx = _EvalContext(now, obs, history, input_stall=input_stall)
+        transitions = {}
+        for rule in current:
+            try:
+                breached, evidence = rule.check(ctx)
+            except Exception:
+                continue  # one broken rule must never kill the exporter
+            t = _advance(rule, breached, evidence, ctx)
+            if t:
+                transitions[rule.id] = t
+        _STATS["alert_evaluations"] += 1
+        return transitions
+
+
+def maybe_evaluate(slo=None, input_stall=None):
+    """The exporter-cadence hook (``metrics.update_derived`` calls it,
+    passing its already-derived ``slo_counters()`` view and input-stall
+    fraction): one global check when disabled, a full :func:`evaluate`
+    otherwise, rate-limited to at most one evaluation per
+    ``MXNET_TPU_ALERT_EVAL_S`` seconds (0 = every exporter tick). The
+    rate limiter keeps its OWN real-monotonic bookkeeping — a drill's
+    synthetic evaluation clock must never suppress real exporter
+    ticks."""
+    global _LAST_TICK
+    if not _ENABLED:
+        return None
+    min_s = _env_float("MXNET_TPU_ALERT_EVAL_S", 0.0)
+    real = time.monotonic()
+    if min_s > 0 and _LAST_TICK is not None and real - _LAST_TICK < min_s:
+        return None
+    out = evaluate(slo=slo, input_stall=input_stall)
+    _LAST_TICK = real
+    return out
+
+
+def snapshot():
+    """The ``observability.dump()`` section: per-rule states plus the
+    open-incident count (full incidents ride in ``dump()["incidents"]``)."""
+    with _LOCK:
+        rules_snap = [r.describe() for r in _RULES.values()]
+        n_open = sum(1 for i in _INCIDENTS if i["status"] == "open")
+    return {"enabled": _ENABLED, "rules": rules_snap,
+            "open_incidents": n_open}
+
+
+def reset():
+    """Restore the default rule set and clear all dynamic state
+    (history, incidents, per-rule machines) — tests and drills call
+    this between cases."""
+    global _LAST_TICK
+    with _EVAL_LOCK:
+        with _LOCK:
+            _RULES.clear()
+            _HISTORY.clear()
+            _INCIDENTS.clear()
+        for rule in _default_rules():
+            register_rule(rule)
+        _LAST_TICK = None
+
+
+for _rule in _default_rules():
+    register_rule(_rule)
+del _rule

@@ -21,6 +21,8 @@ schema):
 ``retrace``    a captured program recompiled, with the reason (capture)
 ``fleet``      a replica state transition (serving.fleet)
 ``monitor``    a Monitor tensor-stat emission (mxnet_tpu.monitor)
+``perf``       a perf-gate regression (tools/perf_gate.py)
+``alert``      an alert rule transitioned FIRING/RESOLVED (alerts)
 
 The ring is sized by ``MXNET_TPU_OBS_FLIGHT_RING`` (default 1024 events,
 ``0`` disables; resize at runtime with :func:`set_ring`). Watchdog crash

@@ -49,7 +49,8 @@ __all__ = ["counter", "gauge", "histogram", "get", "registry",
            "snapshot", "sample", "series", "render_prometheus",
            "flush_json", "start_flusher", "stop_flusher", "serve_http",
            "update_slo", "update_input_stall", "update_derived",
-           "note_span", "reset", "Counter", "Gauge", "Histogram"]
+           "slo_counters", "note_span", "reset", "Counter", "Gauge",
+           "Histogram"]
 
 _LOCK = threading.Lock()
 _REGISTRY: dict = {}
@@ -273,23 +274,58 @@ def _ratio(num, den):
     return num / den if den else 0.0
 
 
-def update_slo():
+def slo_counters():
+    """The cumulative fleet SLO counter triple (requests, deadline
+    misses, overload sheds) every SLO consumer — :func:`update_slo`'s
+    gauges and the alert engine's burn-rate windows — reads, with the
+    ``slo_burn`` fault hook applied upstream of both: the chaos drill
+    inflates deadline misses HERE, so the injected burn flows through
+    the real derivation and window math, never a shortcut."""
+    try:
+        from .. import serving
+    except Exception:
+        return {}
+    counters = {
+        "fleet_requests": serving._STATS["fleet_requests"],
+        "fleet_deadline_exceeded":
+            serving._STATS["fleet_deadline_exceeded"],
+        "fleet_shed_overloaded": serving._STATS["fleet_shed_overloaded"],
+    }
+    try:
+        from ..resilience import faults
+    except Exception:
+        return counters
+    return faults.maybe_slo_burn(counters)
+
+
+def update_slo(counters=None):
     """Refresh the ``mxnet_tpu_fleet_*`` gauges from the live serving
     layer. Called by every exporter; safe (and cheap) with no fleet.
     Division edges are explicit: a zero-request window leaves the rate
     gauges absent (no data is not a 0% hit rate), an empty fleet or a
     model with zero replicas reports 0 healthy replicas and 0-latency
-    percentiles rather than NaN."""
+    percentiles rather than NaN. Per-model/replica labelsets whose
+    subject left the live fleet set are pruned (the
+    ``perf.update_gauges`` discipline) so a closed fleet's breaker
+    cell cannot export ``open=1`` forever. ``counters`` reuses a
+    :func:`slo_counters` view already taken this tick
+    (``update_derived`` passes one shared view to the gauges AND the
+    alert engine, so a bounded-``times`` ``slo_burn`` arm inflates
+    both identically instead of burning one fire per consumer)."""
     try:
         from .. import serving
     except Exception:
         return
-    s_requests = serving._STATS["fleet_requests"]
+    if counters is None:
+        counters = slo_counters()
+    s_requests = counters.get("fleet_requests", 0)
     if s_requests > 0:
         _SLO_HIT_RATE.set(1.0 - _ratio(
-            serving._STATS["fleet_deadline_exceeded"], s_requests))
+            counters["fleet_deadline_exceeded"], s_requests))
         _SLO_SHED_RATE.set(_ratio(
-            serving._STATS["fleet_shed_overloaded"], s_requests))
+            counters["fleet_shed_overloaded"], s_requests))
+    live_models = set()
+    live_replicas = set()
     for fleet in serving._live_fleets():
         try:
             models = fleet.models()
@@ -298,6 +334,7 @@ def update_slo():
         for model in models:
             lat = []
             healthy = 0
+            live_models.add(str(model))
             try:
                 replicas = fleet._sup.replicas(model)
             except Exception:
@@ -305,6 +342,7 @@ def update_slo():
             for r in replicas:  # supervisor teardown: report empty, not die
                 lat.extend(r.latency_snapshot())
                 healthy += 1 if r.state == "HEALTHY" else 0
+                live_replicas.add((str(model), str(r.rid)))
                 _SLO_BREAKER.set(1 if r.breaker.is_open else 0,
                                  model=model, replica=r.rid)
             _SLO_HEALTHY.set(healthy, model=model)
@@ -312,6 +350,16 @@ def update_slo():
             # _percentile_us returns 0 for an empty window by contract
             _SLO_P50.set(serving._percentile_us(lat, 0.50), model=model)
             _SLO_P99.set(serving._percentile_us(lat, 0.99), model=model)
+    for labelset in _SLO_BREAKER.labelsets():
+        d = dict(labelset)
+        if (d.get("model"), d.get("replica")) not in live_replicas:
+            _SLO_BREAKER.remove(model=d.get("model"),
+                                replica=d.get("replica"))
+    for g in (_SLO_HEALTHY, _SLO_P50, _SLO_P99):
+        for labelset in g.labelsets():
+            model = dict(labelset).get("model")
+            if model not in live_models:
+                g.remove(model=model)
 
 
 # ------------------------------------------- derived training-input gauge
@@ -354,19 +402,29 @@ def update_input_stall():
         end = s["t0_ns"] + s["dur_ns"]
         t_max = end if t_max is None else max(t_max, end)
     window = (t_max - t_min) if t_min is not None else 0
-    _INPUT_STALL.set(min(1.0, _ratio(wait, window)))
+    value = min(1.0, _ratio(wait, window))
+    _INPUT_STALL.set(value)
+    return value
 
 
 def update_derived():
     """Refresh every auto-derived gauge family — fleet SLO, input-stall
-    fraction, and the per-executable perf-ledger gauges — in one place.
-    Every exporter calls this, so derived series exist without any
-    caller wiring."""
-    update_slo()
-    update_input_stall()
+    fraction, and the per-executable perf-ledger gauges — in one place,
+    then give the alert engine its evaluation tick. Every exporter
+    calls this, so derived series exist — and alert rules run — on the
+    exporter cadence without any caller wiring. One ``slo_counters()``
+    view is taken per tick and shared between the SLO gauges and the
+    alert windows (one ``slo_burn`` hook fire per tick, identical
+    inflated view on both sides)."""
+    counters = slo_counters()
+    update_slo(counters)
+    stall = update_input_stall()
     from . import perf as _perf
 
     _perf.update_gauges()
+    from . import alerts as _alerts
+
+    _alerts.maybe_evaluate(slo=counters, input_stall=stall)
 
 
 # per-span-name cell cache for the note_span hot path: skips the
@@ -431,8 +489,13 @@ def snapshot():
 
 def sample(now=None):
     """Append one time-series sample of every instrument (and the SLO
-    gauges) to the ring; returns the sample."""
+    gauges) to the ring; returns the sample. Each record carries BOTH
+    clocks (docs/observability.md, "time-series record schema"):
+    wall-clock ``t`` (epoch seconds, for humans and dashboards) and
+    monotonic ``ns`` (``perf_counter_ns``, what windowed consumers
+    like the alert engine difference — wall clock can step)."""
     rec = {"t": time.time() if now is None else now,
+           "ns": time.perf_counter_ns(),
            "metrics": snapshot()}
     with _LOCK:
         _SERIES.append(rec)

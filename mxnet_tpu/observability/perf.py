@@ -57,9 +57,9 @@ from . import _STATS
 from . import metrics as _metrics
 
 __all__ = ["LEDGER_FIELDS", "note_compile", "note_execution", "timed_call",
-           "ledger", "ledger_key", "combined_fingerprint", "snapshot",
-           "clear", "update_gauges", "device_time_enabled",
-           "set_device_time", "nominal_peaks"]
+           "ledger", "device_timed_entries", "ledger_key",
+           "combined_fingerprint", "snapshot", "clear", "update_gauges",
+           "device_time_enabled", "set_device_time", "nominal_peaks"]
 
 _LOCK = threading.Lock()
 _LEDGER: dict = {}
@@ -324,6 +324,17 @@ def ledger():
     """Snapshot of every entry, keyed by ``<label>@<fingerprint16>``."""
     with _LOCK:
         return {k: dict(v) for k, v in _LEDGER.items()}
+
+
+def device_timed_entries(min_calls=1):
+    """Entries with at least ``min_calls`` dependency-chained timed
+    executions and a live ``device_ms`` EWMA — the subscription surface
+    for consumers of the dynamic series (the alert engine's
+    ``perf_device_regression`` rule watches exactly this view)."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _LEDGER.items()
+                if (v["device_calls"] or 0) >= int(min_calls)
+                and v["device_ms"] is not None}
 
 
 def snapshot():

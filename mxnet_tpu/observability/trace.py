@@ -44,7 +44,7 @@ from . import _STATS, flight as _flight
 from . import metrics as _metrics
 
 __all__ = ["span", "start_span", "record", "current", "context",
-           "collect", "ingest", "spans", "clear", "enabled",
+           "collect", "ingest", "spans", "roots", "clear", "enabled",
            "set_enabled", "new_trace_id", "Span"]
 
 try:
@@ -304,6 +304,15 @@ def spans(trace_id=None, name=None):
     if name is not None:
         out = [s for s in out if s["name"] == name]
     return out
+
+
+def roots(names=()):
+    """Root-span records (``parent is None``) currently in the ring,
+    optionally restricted to a set of span names — the entry points
+    incident exemplars and timeline exports start from."""
+    names = set(names)
+    return [s for s in spans()
+            if s["parent"] is None and (not names or s["name"] in names)]
 
 
 def clear():

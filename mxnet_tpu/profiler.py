@@ -198,7 +198,10 @@ def dispatch_stats(reset=False, lock_timeout=None):
     - observability counters (docs/observability.md): obs_spans/
       obs_spans_shipped (trace spans recorded locally / ingested from
       process replicas), obs_flight_events, obs_metric_flushes/
-      obs_metric_samples (JSON-lines exporter), obs_dumps
+      obs_metric_samples (JSON-lines exporter), obs_dumps,
+      perf_ledger_entries/perf_device_timings (perf attribution), and
+      the alert engine's alert_evaluations/alert_transitions/
+      alert_incidents_opened/alert_incidents_resolved
 
     The snapshot (and an optional ``reset=True``) runs under the
     profiler lock, so two concurrent callers — or a caller racing

@@ -75,6 +75,22 @@ Supported fault kinds (the hook that honours each is noted):
                                   ``perf:regression`` flight events)
                                   when an executable gets slower or
                                   fatter
+- ``slo_burn``                  — inflate the fleet deadline-miss /
+                                  request counters feeding
+                                  ``metrics.slo_counters()`` (the view
+                                  ``update_slo`` and the alert engine's
+                                  burn-rate windows both consume), so
+                                  the drill proves a real SLO burn
+                                  opens exactly one correlated incident
+                                  (``alerts.py``) and resolves when the
+                                  burn stops
+- ``step_time_anomaly``         — inflate one measured step-time span
+                                  duration as the alert engine's
+                                  median/MAD drift detector ingests it
+                                  (``alerts.StepTimeDriftRule``), so
+                                  the drill proves a step-time anomaly
+                                  opens one incident naming the
+                                  implicated perf-ledger key
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -103,7 +119,8 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_hang", "maybe_oom_step", "maybe_peer_death",
            "maybe_replica_crash", "maybe_replica_hang",
            "maybe_replica_nan_storm", "maybe_calib_table_drift",
-           "maybe_perf_regression"]
+           "maybe_perf_regression", "maybe_slo_burn",
+           "maybe_step_time_anomaly"]
 
 
 class SimulatedCrash(BaseException):
@@ -470,6 +487,48 @@ def maybe_perf_regression(measured, factor=3.0):
                       and not isinstance(v, bool) else v)
                   for m, v in metrics.items()}
             for key, metrics in measured.items()}
+
+
+def maybe_slo_burn(counters):
+    """When ``slo_burn`` fires, return ``counters`` (the cumulative
+    fleet SLO triple from ``metrics.slo_counters()``) with
+    ``MXNET_TPU_FAULT_SLO_BURN_N`` (default 64) extra requests that ALL
+    missed their deadline folded in — an overwhelming burn against any
+    sane objective. Only deadline misses are inflated (not sheds), so
+    the drill's "exactly one incident" assertion is meaningful. Hooked
+    upstream of both the SLO gauges and the alert engine's burn-rate
+    windows."""
+    if not _ACTIVE:
+        return counters
+    fault = _ACTIVE.get("slo_burn")
+    if fault is None or not fault.should_fire():
+        return counters
+    n = int(os.environ.get("MXNET_TPU_FAULT_SLO_BURN_N", "64"))
+    out = dict(counters)
+    out["fleet_requests"] = out.get("fleet_requests", 0) + n
+    out["fleet_deadline_exceeded"] = \
+        out.get("fleet_deadline_exceeded", 0) + n
+    return out
+
+
+def maybe_step_time_anomaly(dur_ns):
+    """When ``step_time_anomaly`` fires, return one measured step-time
+    span duration inflated by ``MXNET_TPU_FAULT_STEP_TIME_FACTOR``
+    (default 10) — far outside any median + k*MAD envelope. Hooked into
+    the alert engine's drift detector exactly where it ingests new
+    step-root durations, so the drill exercises the real rolling
+    statistics, incident assembly included."""
+    if not _ACTIVE:
+        return dur_ns
+    fault = _ACTIVE.get("step_time_anomaly")
+    if fault is None or not fault.should_fire():
+        return dur_ns
+    try:
+        factor = float(os.environ.get(
+            "MXNET_TPU_FAULT_STEP_TIME_FACTOR", "10"))
+    except ValueError:
+        factor = 10.0
+    return int(dur_ns * factor)
 
 
 def maybe_peer_death():

@@ -583,6 +583,16 @@ def _write_crash_report(g):
             flight_tail = _obs_flight.snapshot(limit=256)
         except Exception:
             flight_tail = []
+        try:
+            # correlated incident reports next to the flight tail: if
+            # an alert was already FIRING when the stall hit, the
+            # report carries the full diagnosis bundle (evidence
+            # window, exemplar span trees, perf deltas, fleet states)
+            from ..observability import alerts as _obs_alerts
+
+            incident_tail = _obs_alerts.incidents(limit=8)
+        except Exception:
+            incident_tail = []
         report = {
             "schema_version": 1,
             "kind": "stall",
@@ -597,6 +607,7 @@ def _write_crash_report(g):
             "rng_state": _rng_snapshot(),
             "dispatch_ring": ring,
             "flight_recorder": flight_tail,
+            "incidents": incident_tail,
             "counters": counters,
             "env": _env_snapshot(),
         }

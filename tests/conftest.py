@@ -75,3 +75,9 @@ def pytest_configure(config):
         "(mxnet_tpu/observability/perf.py, tools/perf_gate.py, "
         "docs/observability.md); fast cases run in tier-1, the live "
         "gate run carries the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "alerts: SLO burn-rate alerting, anomaly detection, incident "
+        "correlation and Chrome-trace export "
+        "(mxnet_tpu/observability/alerts.py + traceview.py, "
+        "docs/observability.md); runs in tier-1")

@@ -1,0 +1,23 @@
+"""RD006 fixture: exactly ONE alert-rule registry finding.
+
+The fixture project has no docs/ and no coverage sources, so any id
+declared in a module-level ``ALERT_RULE_IDS`` literal fires — except
+the waived one. Near-misses that must stay clean: a registry tuple
+under a different name, a non-string element, an inner-scope
+declaration, and the inline-waived id.
+"""
+
+ALERT_RULE_IDS = (
+    "fixture_undrilled_rule",      # <- the one RD006 finding
+    "fixture_waived_rule",         # graftlint: disable=RD006
+    42,                            # non-string element: skipped
+)
+
+# a tuple that merely looks registry-ish: not a declared registry name
+OTHER_RULE_IDS = ("fixture_other_rule",)
+
+
+def _inner():
+    # inner-scope declaration is not the module-level registry
+    ALERT_RULE_IDS = ("fixture_inner_rule",)
+    return ALERT_RULE_IDS

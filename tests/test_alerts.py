@@ -1,0 +1,904 @@
+"""Watchtower: alerting, incidents, Chrome-trace export (ISSUE 12,
+docs/observability.md "Alerting & incidents"). Marker: alerts (tier-1).
+
+Covers: multi-window burn-rate math, hold/cooldown flap suppression,
+FIRING/RESOLVED transitions in the flight ring, the threshold probes
+(breaker open, healthy floor, input stall), the median/MAD step-time
+drift detector with its fault hook, the perf-ledger EWMA regression
+rule, the health-skip spike rule, incident assembly (flight slice +
+exemplar trees + perf deltas + fleet states), crash-report embedding,
+the registered-rule closure against ALERT_RULE_IDS (graftlint RD006's
+runtime counterpart), Chrome-trace structural validity (pid/tid maps,
+nesting, cross-process alignment, valid JSON), and the
+obs_alerts.py / trace_export.py / obs_dump.py CLI contracts on pure
+JSON inputs (no runtime import).
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, serving
+from mxnet_tpu.observability import (alerts, flight, metrics, perf,
+                                     trace, traceview)
+from mxnet_tpu.resilience import faults, watchdog
+
+pytestmark = pytest.mark.alerts
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IN_UNITS = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_layer():
+    """Alert state, tracing, faults and peers reset around every test;
+    auto-evaluation is disabled so only the test's explicit synthetic
+    clock drives the engine."""
+    alerts.reset()
+    prev = alerts.set_enabled(False)
+    trace.set_enabled(False)
+    trace.clear()
+    faults.reset()
+    watchdog.reset_peers()
+    yield
+    alerts.reset()
+    alerts.set_enabled(prev)
+    trace.set_enabled(False)
+    trace.clear()
+    faults.reset()
+    watchdog.reset_peers()
+    serving.reset_stats()  # the suite seeds synthetic SLO counters
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_tool", os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _seed_slo(requests=0, misses=0, sheds=0):
+    serving.reset_stats()
+    serving._STATS["fleet_requests"] = requests
+    serving._STATS["fleet_deadline_exceeded"] = misses
+    serving._STATS["fleet_shed_overloaded"] = sheds
+
+
+def _solo(rule):
+    """Deregister every default rule and run only ``rule`` — the
+    synthetic counter burns below would otherwise (correctly) trip the
+    default slo_deadline_burn too. The fixture's reset() restores the
+    default set after each test."""
+    for rid in list(alerts.rules()):
+        alerts.unregister_rule(rid)
+    return alerts.register_rule(rule)
+
+
+def _serving_factory(prefix="alerts_fleet_"):
+    mx.random.seed(5)
+    net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix=prefix)
+    net.initialize()
+    return serving.Predictor.from_block(
+        net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(2,))
+
+
+def _alerts_process_factory():
+    """Module-level (picklable) factory for spawn-mode replicas."""
+    return _serving_factory(prefix="alerts_proc_")
+
+
+# ------------------------------------------------------------ registry/basics
+
+def test_default_rules_close_over_alert_rule_ids():
+    """The runtime counterpart of graftlint RD006: the engine's
+    registered defaults are exactly the declared ALERT_RULE_IDS."""
+    assert set(alerts.rules()) == set(alerts.ALERT_RULE_IDS)
+    assert len(alerts.ALERT_RULE_IDS) == len(set(alerts.ALERT_RULE_IDS))
+    for rule_id in alerts.ALERT_RULE_IDS:
+        assert alerts.get_rule(rule_id) is not None
+
+
+def test_disabled_evaluation_is_a_noop_and_force_overrides():
+    assert alerts.evaluate(now=1.0) is None          # disabled by fixture
+    assert alerts.maybe_evaluate() is None
+    assert alerts.evaluate(now=1.0, force=True) == {}
+    prev = alerts.set_enabled(True)
+    try:
+        assert alerts.evaluate(now=2.0) == {}
+    finally:
+        alerts.set_enabled(prev)
+
+
+def test_evaluation_rides_the_exporter_cadence():
+    """update_derived() (every exporter's refresh hook) gives the
+    engine its tick — no caller wiring."""
+    prev = alerts.set_enabled(True)
+    before = profiler.dispatch_stats()["alert_evaluations"]
+    try:
+        metrics.update_derived()
+    finally:
+        alerts.set_enabled(prev)
+    assert profiler.dispatch_stats()["alert_evaluations"] == before + 1
+
+
+# ------------------------------------------------------------------ burn rate
+
+def test_burn_rate_window_math():
+    """burn = windowed_error_rate / budget, per window; the rule fires
+    only when BOTH windows burn at >= factor."""
+    rule = alerts.BurnRateRule(
+        "x_test_burn", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=300, factor=4.0,
+        cooldown_s=0.0)
+    _solo(rule)
+    _seed_slo(requests=1000)
+    t = 1000.0
+    assert alerts.evaluate(now=t, force=True) == {}
+
+    # 2% of requests missing deadline = burn 2.0 < factor 4: no fire
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 2
+    t += 30
+    assert "x_test_burn" not in alerts.evaluate(now=t, force=True)
+    assert rule.state == "OK"
+
+    # 8% missing = burn 8.0 >= 4 in both windows: FIRING
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 8
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("x_test_burn") == "FIRING"
+    ev = rule.last_evidence
+    fast, slow = ev["windows"]["fast"], ev["windows"]["slow"]
+    assert fast["window_s"] == 60 and slow["window_s"] == 300
+    # both windows cover the full 60s of samples (the slow window is
+    # PARTIAL — younger than 300s — so it falls back to the oldest
+    # sample rather than reporting an empty window)
+    assert fast["fleet_requests"] == 200
+    assert fast["fleet_deadline_exceeded"] == 10
+    assert fast["burn"] == pytest.approx((10 / 200) / 0.01, rel=1e-3)
+    assert slow["burn"] == pytest.approx((10 / 200) / 0.01, rel=1e-3)
+
+
+def test_burn_rate_needs_both_windows():
+    """Once a miss burst ages out of the FAST window, the rule stops
+    breaching even though the burst still sits inside the slow window
+    — the multi-window guard that keeps an old blip from paging."""
+    rule = alerts.BurnRateRule(
+        "x_test_burn2", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=600, factor=4.0,
+        cooldown_s=1e9)  # never resolves: isolates breach tracking
+    alerts.register_rule(rule)
+    _seed_slo(requests=100)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 50   # the burst
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("x_test_burn2") == "FIRING"
+    burst_t = t
+    # 5 minutes of clean traffic: the burst leaves the fast window
+    # (the slow window still contains it the whole time)
+    for _ in range(6):
+        t += 60
+        serving._STATS["fleet_requests"] += 100
+        alerts.evaluate(now=t, force=True)
+        slow_burn, _, _ = rule._burn(
+            alerts._EvalContext(t, alerts._HISTORY[-1],
+                                list(alerts._HISTORY)), rule.slow_s)
+    assert rule.last_breach == burst_t   # no breach after the burst tick
+    assert slow_burn >= rule.factor      # ...though the slow window burns
+
+
+def test_shed_burn_rule_fires_on_overload_sheds():
+    """The default slo_shed_burn rule: FleetOverloaded sheds burning
+    the budget fire it — and deadline misses alone do NOT."""
+    rule = alerts.get_rule("slo_shed_burn")
+    _seed_slo(requests=100)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_shed_overloaded"] += 50
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("slo_shed_burn") == "FIRING"
+    assert rule.state == "FIRING"
+    ev = rule.last_evidence
+    assert ev["windows"]["fast"]["fleet_shed_overloaded"] == 50
+    # a deadline-only burn leaves the shed rule quiet
+    alerts.reset()
+    _seed_slo(requests=100)
+    t = 2000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 50
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert "slo_shed_burn" not in got
+    assert got.get("slo_deadline_burn") == "FIRING"
+
+
+def test_slo_counters_applies_the_slo_burn_hook():
+    _seed_slo(requests=10)
+    clean = metrics.slo_counters()
+    assert clean["fleet_requests"] == 10
+    assert clean["fleet_deadline_exceeded"] == 0
+    with faults.inject("slo_burn", times=1) as f:
+        burned = metrics.slo_counters()
+    assert f.fired == 1
+    assert burned["fleet_requests"] > 10
+    assert burned["fleet_deadline_exceeded"] == \
+        burned["fleet_requests"] - 10
+    # serving's real counters were never touched
+    assert serving._STATS["fleet_deadline_exceeded"] == 0
+
+
+# ----------------------------------------------------------- hold / cooldown
+
+def test_hold_suppresses_one_tick_flap():
+    """A breach shorter than hold_s never fires: OK -> PENDING -> OK."""
+    rule = alerts.BurnRateRule(
+        "x_test_hold", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0, hold_s=50.0)
+    _solo(rule)
+    _seed_slo(requests=100)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    t += 10
+    assert alerts.evaluate(now=t, force=True) == {}
+    assert rule.state == "PENDING"
+    # breach gone before hold_s elapsed: back to OK, no incident
+    t += 100
+    serving._STATS["fleet_requests"] += 1000
+    assert alerts.evaluate(now=t, force=True) == {}
+    assert rule.state == "OK"
+    assert alerts.incidents() == []
+    # a PERSISTENT breach rides PENDING across ticks and then fires
+    serving._STATS["fleet_requests"] += 1000
+    serving._STATS["fleet_deadline_exceeded"] += 1000
+    t += 10
+    alerts.evaluate(now=t, force=True)
+    assert rule.state == "PENDING"
+    t += 60
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 100
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("x_test_hold") == "FIRING"
+
+
+def test_cooldown_suppresses_resolve_flap():
+    """FIRING persists through a clean tick shorter than cooldown_s;
+    only a clean cooldown window resolves (and re-breach re-arms)."""
+    rule = alerts.BurnRateRule(
+        "x_test_cool", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0,
+        cooldown_s=40.0)
+    _solo(rule)
+    _seed_slo(requests=100)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 100
+    t += 30
+    assert alerts.evaluate(now=t, force=True)["x_test_cool"] == "FIRING"
+    # clean tick inside the cooldown: still FIRING, incident open
+    t += 35  # the breach left the 60s fast window? no: keep burning
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 100
+    assert alerts.evaluate(now=t, force=True) == {}
+    assert rule.state == "FIRING"
+    t += 20  # clean, but only 20s < cooldown 40s
+    assert alerts.evaluate(now=t, force=True) == {}
+    assert rule.state == "FIRING"
+    assert len(alerts.open_incidents()) == 1
+    t += 40  # clean past the cooldown: RESOLVED
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("x_test_cool") == "RESOLVED"
+    assert rule.state == "OK"
+    assert alerts.open_incidents() == []
+
+
+def test_transitions_land_in_flight_ring():
+    rule = alerts.BurnRateRule(
+        "x_test_flight", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0, cooldown_s=0.0)
+    _solo(rule)
+    _seed_slo(requests=10)
+    mark = flight.last_seq()
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    t += 30
+    alerts.evaluate(now=t, force=True)
+    t += 30
+    serving._STATS["fleet_requests"] += 1000
+    t += 60
+    alerts.evaluate(now=t, force=True)
+    events = [e for e in flight.events(kind="alert", since_seq=mark)
+              if e["rule"] == "x_test_flight"]
+    assert [e["state"] for e in events] == ["FIRING", "RESOLVED"]
+    assert events[0]["severity"] == "page"
+    assert events[0]["incident"] == events[1]["incident"]
+    transitions = profiler.dispatch_stats()["alert_transitions"]
+    assert transitions >= 2
+
+
+# ------------------------------------------------------------ threshold rules
+
+class _FakeBreaker:
+    def __init__(self, open_):
+        self.is_open = open_
+
+
+class _FakeReplica:
+    def __init__(self, rid, state="HEALTHY", open_=False):
+        self.rid = rid
+        self.state = state
+        self.breaker = _FakeBreaker(open_)
+
+    def latency_snapshot(self):
+        return []
+
+
+class _FakeSup:
+    def __init__(self, replicas):
+        self._replicas = replicas
+
+    def replicas(self, model):
+        return self._replicas[model]
+
+
+class _FakeFleet:
+    def __init__(self, replicas):
+        self._sup = _FakeSup(replicas)
+        self._replicas = replicas
+
+    def models(self):
+        return list(self._replicas)
+
+
+def test_breaker_and_healthy_floor_probes():
+    fleet = _FakeFleet({"m": [_FakeReplica(0), _FakeReplica(1)]})
+    serving._register_fleet(fleet)
+    t = 1000.0
+    assert alerts.evaluate(now=t, force=True) == {}
+    # one breaker opens -> fleet_breaker_open fires with the cell named
+    fleet._replicas["m"][1].breaker.is_open = True
+    t += 1
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("fleet_breaker_open") == "FIRING"
+    rule = alerts.get_rule("fleet_breaker_open")
+    assert rule.last_evidence["open"] == ["m/1"]
+    # every replica leaves HEALTHY -> healthy floor fires too
+    fleet._replicas["m"][0].state = "DRAINING"
+    fleet._replicas["m"][1].state = "DEAD"
+    t += 1
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("fleet_healthy_floor") == "FIRING"
+    floor = alerts.get_rule("fleet_healthy_floor")
+    assert floor.last_evidence["healthy_by_model"] == {"m": 0}
+
+
+def test_input_stall_threshold_rule():
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    # 80% of a 1ms training window stalled on input
+    trace.record("step.data_wait", t0, 800_000)
+    trace.record("train.step", t0, 1_000_000)
+    trace.set_enabled(False)
+    got = alerts.evaluate(now=1000.0, force=True)
+    assert got.get("input_stall_high") == "FIRING"
+    assert alerts.get_rule("input_stall_high").last_evidence["value"] \
+        == pytest.approx(0.8, abs=0.01)
+
+
+def test_step_time_drift_rule_and_fault_hook():
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    for k in range(10):
+        trace.record("train.step", t0 + k * 10, 1_000_000 + k * 1000)
+    trace.set_enabled(False)
+    t = 1000.0
+    assert alerts.evaluate(now=t, force=True) == {}  # banks the baseline
+    # one anomalous step: 10x the median via the chaos hook
+    trace.set_enabled(True)
+    trace.record("train.step", t0 + 1000, 1_000_000)
+    trace.set_enabled(False)
+    with faults.inject("step_time_anomaly", times=1) as f:
+        t += 5
+        got = alerts.evaluate(now=t, force=True)
+    assert f.fired == 1
+    assert got.get("step_time_drift") == "FIRING"
+    ev = alerts.get_rule("step_time_drift").last_evidence
+    assert ev["dur_ns"] == 10_000_000
+    assert ev["dur_ns"] > ev["limit_ns"]
+    assert ev["median_ns"] == pytest.approx(1_004_500, rel=0.01)
+    # the outlier stayed out of the baseline: a following normal step
+    # does not breach
+    trace.set_enabled(True)
+    trace.record("train.step", t0 + 2000, 1_001_000)
+    trace.set_enabled(False)
+    t += 5
+    assert alerts.evaluate(now=t, force=True) == {}
+
+
+def test_perf_ledger_drop_rule():
+    perf.clear()
+    rule = alerts.get_rule("perf_device_regression")
+    rule.min_calls = 1
+    for _ in range(3):
+        perf.note_execution("x_alert_exec", "feedface", 0.010)
+    t = 1000.0
+    assert alerts.evaluate(now=t, force=True) == {}   # banks baseline
+    t += 1
+    assert alerts.evaluate(now=t, force=True) == {}   # tracks baseline
+    # EWMA device time triples: regression fires naming the key
+    for _ in range(10):
+        perf.note_execution("x_alert_exec", "feedface", 0.050)
+    t += 1
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("perf_device_regression") == "FIRING"
+    ev = rule.last_evidence
+    key = perf.ledger_key("x_alert_exec", "feedface")
+    assert ev["ledger_keys"] == [key]
+    assert ev["regressed"][key]["device_ms"] > \
+        ev["regressed"][key]["baseline_device_ms"]
+    perf.clear()
+
+
+def test_health_skip_spike_rule():
+    from mxnet_tpu.resilience import sentinel
+
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    before = sentinel._STATS["health_skipped_steps"]
+    try:
+        sentinel._STATS["health_skipped_steps"] += 5
+        t += 10
+        got = alerts.evaluate(now=t, force=True)
+    finally:
+        sentinel._STATS["health_skipped_steps"] = before
+    assert got.get("health_skip_spike") == "FIRING"
+    ev = alerts.get_rule("health_skip_spike").last_evidence
+    assert ev["total"] == 5
+    assert ev["by_counter"]["health_skipped_steps"] == 5
+
+
+# ------------------------------------------------------------------ incidents
+
+def test_incident_assembly_is_correlated():
+    """A FIRING incident carries the flight slice for its evidence
+    window, exemplar span trees (root first), perf entries for
+    implicated keys, and the fleet replica/breaker states."""
+    perf.clear()
+    fleet = _FakeFleet({"m": [_FakeReplica(0), _FakeReplica(1, open_=True)]})
+    serving._register_fleet(fleet)
+    trace.set_enabled(True)
+    t0 = time.perf_counter_ns()
+    for k in range(9):
+        trace.record("train.step", t0 + k * 10, 1_000_000)
+    trace.set_enabled(False)
+    perf.note_compile("trainer_step", "cafecafe", object(), 0.01)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    flight.record("ckpt", op="save", step=7)     # lands in the slice
+    trace.set_enabled(True)
+    trace.record("train.step", t0 + 1000, 1_000_000)
+    trace.set_enabled(False)
+    with faults.inject("step_time_anomaly", times=1):
+        t += 5
+        got = alerts.evaluate(now=t, force=True)
+    assert got.get("step_time_drift") == "FIRING"
+    # the breaker probe fires too (the fake fleet has an open breaker)
+    incs = {i["rule"]: i for i in alerts.open_incidents()}
+    inc = incs["step_time_drift"]
+    kinds = {e["kind"] for e in inc["flight"]}
+    assert "ckpt" in kinds and "fault" in kinds
+    assert inc["exemplars"] and \
+        inc["exemplars"][0][0]["name"] == "train.step"
+    key = perf.ledger_key("trainer_step", "cafecafe")
+    assert key in inc["evidence"]["ledger_keys"]
+    assert inc["perf"][key]["label"] == "trainer_step"
+    assert {"model": "m", "replica": 1, "state": "HEALTHY",
+            "breaker_open": True} in inc["fleet"]
+    assert inc["chrome_trace"] is not None
+    assert any(e["name"] == "train.step"
+               for e in inc["chrome_trace"]["traceEvents"])
+    assert inc["status"] == "open" and inc["resolved_t"] is None
+    json.dumps(alerts.incidents(), default=str)  # JSON-serializable
+    perf.clear()
+
+
+def test_incidents_surface_in_dump_and_are_bounded():
+    import mxnet_tpu.observability as obs
+
+    rule = alerts.BurnRateRule(
+        "x_test_dump", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0, cooldown_s=0.0)
+    _solo(rule)
+    _seed_slo(requests=10)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    t += 30
+    alerts.evaluate(now=t, force=True)
+    d = obs.dump()
+    assert [i["rule"] for i in d["incidents"]] == ["x_test_dump"]
+    assert d["alerts"]["open_incidents"] == 1
+    states = {r["id"]: r["state"] for r in d["alerts"]["rules"]}
+    assert states["x_test_dump"] == "FIRING"
+
+
+def test_crash_report_embeds_incidents(tmp_path, monkeypatch):
+    """Watchdog crash reports carry the open incidents next to the
+    flight tail — a stall during a burn ships the whole diagnosis."""
+    monkeypatch.setenv("MXNET_TPU_CRASH_DIR", str(tmp_path))
+    rule = alerts.BurnRateRule(
+        "x_test_crash", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0)
+    _solo(rule)
+    _seed_slo(requests=10)
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    t += 30
+    assert alerts.evaluate(now=t, force=True)["x_test_crash"] == "FIRING"
+    with pytest.raises(watchdog.StallError) as ei:
+        with faults.inject("hang_step"):
+            with watchdog.guard("step", timeout=0.3,
+                                detail="alerts-test stall"):
+                faults.maybe_hang("hang_step")
+    with open(ei.value.report_path) as f:
+        report = json.load(f)
+    assert [i["rule"] for i in report["incidents"]] == ["x_test_crash"]
+    assert report["incidents"][0]["status"] == "open"
+    assert report["incidents"][0]["evidence"]["windows"]
+
+
+# ----------------------------------------------------------- chrome trace
+
+def _tree_nesting_ok(doc, records):
+    by_id = {r["span"]: r for r in records}
+    ev_by_span = {e["args"]["span"]: e for e in doc["traceEvents"]
+                  if e["ph"] == "X"}
+    for rec in records:
+        parent = by_id.get(rec["parent"])
+        if parent is None:
+            continue
+        child, par = ev_by_span[rec["span"]], ev_by_span[parent["span"]]
+        assert child["ts"] >= par["ts"] - 1e-3, (child, par)
+        assert child["ts"] + child["dur"] <= \
+            par["ts"] + par["dur"] + 1e-3, (child, par)
+
+
+def test_chrome_trace_structure_single_process():
+    trace.set_enabled(True)
+    with trace.span("ct.root", step=3):
+        with trace.span("ct.child"):
+            with trace.span("ct.grandchild"):
+                pass
+        with trace.span("ct.sibling"):
+            pass
+    records = trace.spans()
+    doc = traceview.to_chrome_trace(records)
+    json.loads(json.dumps(doc))  # valid JSON round-trip
+    assert doc["displayTimeUnit"] == "ms"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 4
+    for e in xs:
+        assert set(e) == {"name", "cat", "ph", "ts", "dur", "pid",
+                          "tid", "args"}
+        assert e["pid"] == os.getpid()
+        assert e["dur"] >= 0
+    assert {m["name"] for m in metas} == {"process_name", "thread_name"}
+    main_names = [m["args"]["name"] for m in metas
+                  if m["name"] == "process_name"]
+    assert main_names == ["main"]
+    assert any(e["args"].get("step") == 3 for e in xs)
+    _tree_nesting_ok(doc, records)
+
+
+@pytest.mark.fleet
+def test_chrome_trace_fleet_process_mode():
+    """Acceptance: a fleet request served by a PROCESS-mode replica
+    exports as one valid Chrome trace with two pids (router + replica),
+    replica-named process metadata, and parent/child nesting intact —
+    the replica's clock re-based inside its cross-process parent."""
+    trace.set_enabled(True)
+    with serving.Fleet(_alerts_process_factory, replicas=1,
+                       mode="process", probe_interval_ms=5000,
+                       probe_timeout=30.0) as fleet:
+        fut = fleet.submit(np.ones((1, IN_UNITS), np.float32),
+                           deadline_ms=60000)
+        fut.result(timeout=60)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            reqs = trace.spans(name="serve.request")
+            tid = reqs[-1]["trace"] if reqs else None
+            names = {s["name"] for s in trace.spans(trace_id=tid)} \
+                if tid else set()
+            if {"serve.replica", "serve.predict"} <= names:
+                break
+            time.sleep(0.05)
+    records = trace.spans(trace_id=tid)
+    doc = traceview.to_chrome_trace(records)
+    json.loads(json.dumps(doc, default=str))
+    xs = {e["args"]["span"]: e for e in doc["traceEvents"]
+          if e["ph"] == "X"}
+    pids = {e["pid"] for e in xs.values()}
+    assert len(pids) == 2 and os.getpid() in pids
+    proc_names = {m["pid"]: m["args"]["name"]
+                  for m in doc["traceEvents"]
+                  if m["ph"] == "M" and m["name"] == "process_name"}
+    assert proc_names[os.getpid()] == "main"
+    assert any(n.startswith("replica") for n in proc_names.values())
+    # in-process nesting intact
+    same_pid = [r for r in records
+                if traceview.span_pid(r) == os.getpid()]
+    _tree_nesting_ok(doc, same_pid)
+    # the replica's spans were re-based INSIDE their attempt parent
+    by_id = {r["span"]: r for r in records}
+    rep = next(r for r in records if r["name"] == "serve.replica")
+    par = xs[by_id[rep["parent"]]["span"]]
+    child = xs[rep["span"]]
+    assert child["ts"] >= par["ts"]
+
+
+def test_chrome_trace_of_shipped_records_without_runtime():
+    """to_chrome_trace is pure data -> data: records from another
+    process (different pid prefix, incomparable clock) map to their own
+    pid/tid tracks."""
+    recs = [
+        {"trace": "t1", "span": f"{os.getpid():x}.1", "parent": None,
+         "name": "serve.attempt", "t0_ns": 5_000_000, "dur_ns": 4_000_000,
+         "thread": "router", "attrs": {}},
+        {"trace": "t1", "span": "abc123.1",
+         "parent": f"{os.getpid():x}.1", "name": "serve.replica",
+         "t0_ns": 77_000, "dur_ns": 1_000_000, "thread": "worker",
+         "attrs": {"replica": 0}},
+    ]
+    doc = traceview.to_chrome_trace(recs)
+    xs = {e["args"]["span"]: e for e in doc["traceEvents"]
+          if e["ph"] == "X"}
+    assert xs["abc123.1"]["pid"] == int("abc123", 16)
+    # clock re-based: the replica span starts inside its parent
+    assert xs["abc123.1"]["ts"] >= xs[f"{os.getpid():x}.1"]["ts"]
+    names = {m["pid"]: m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"}
+    assert names[int("abc123", 16)] == "replica 0"
+
+
+# ------------------------------------------------------------- CLI contracts
+
+def test_obs_alerts_cli_inspects_json_without_runtime(tmp_path, capsys):
+    dump = {"incidents": [
+        {"id": "inc-1", "rule": "slo_deadline_burn", "status": "open",
+         "flight": [{"kind": "fault"}], "exemplars": [[{"name": "x"}]]},
+        {"id": "inc-2", "rule": "step_time_drift", "status": "resolved",
+         "flight": [], "exemplars": []},
+    ]}
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps(dump))
+    tool = _load_tool("obs_alerts")
+    rc = tool.main(["--input", str(path)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1                       # one OPEN incident -> non-zero
+    assert out["metric"] == "obs_open_incidents" and out["value"] == 1
+    assert out["extra"]["total"] == 2
+    assert out["extra"]["by_rule"] == {"slo_deadline_burn": 1,
+                                       "step_time_drift": 1}
+    # all-resolved input exits clean
+    dump["incidents"][0]["status"] = "resolved"
+    path.write_text(json.dumps(dump))
+    rc = tool.main(["--input", str(path)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["value"] == 0
+    # unreadable input: structured error, non-zero
+    rc = tool.main(["--input", str(tmp_path / "missing.json")])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_trace_export_cli_converts_dump_without_runtime(tmp_path, capsys):
+    spans = [
+        {"trace": "t1", "span": "aa.1", "parent": None, "name": "t.root",
+         "t0_ns": 1000, "dur_ns": 9000, "thread": "main", "attrs": {}},
+        {"trace": "t1", "span": "aa.2", "parent": "aa.1",
+         "name": "t.child", "t0_ns": 2000, "dur_ns": 1000,
+         "thread": "main", "attrs": {}},
+    ]
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps({"spans": spans}))
+    out_path = tmp_path / "ct.json"
+    tool = _load_tool("trace_export")
+    rc = tool.main(["--input", str(path), "--out", str(out_path)])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert line["metric"] == "trace_export_events" and line["value"] == 2
+    doc = json.loads(out_path.read_text())
+    assert [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"] == \
+        ["t.root", "t.child"]
+    # incident-bearing input (a crash report) exports exemplars
+    path.write_text(json.dumps(
+        {"incidents": [{"exemplars": [spans]}]}))
+    rc = tool.main(["--input", str(path), "--out", str(out_path)])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and line["value"] == 2
+    # a spanless input exports nothing and fails
+    path.write_text(json.dumps({"spans": []}))
+    rc = tool.main(["--input", str(path), "--out", str(out_path)])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_obs_dump_cli_flight_filters(tmp_path, capsys):
+    data = {"schema_version": 2, "spans": [], "incidents": [],
+            "flight": [
+                {"seq": 1, "kind": "fault", "fault": "nan_grad"},
+                {"seq": 2, "kind": "ckpt", "op": "save"},
+                {"seq": 3, "kind": "fault", "fault": "hang_step"},
+            ]}
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps(data))
+    tool = _load_tool("obs_dump")
+    rc = tool.main(["--input", str(path), "--kind", "fault"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["value"] == 2
+    assert out["extra"]["by_kind"] == {"fault": 2}
+    rc = tool.main(["--input", str(path), "--kind", "fault",
+                    "--since-seq", "1"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["value"] == 1
+    # the exit-code contract survives filtering: empty result = failure
+    rc = tool.main(["--input", str(path), "--kind", "alert"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ------------------------------------------------------------- series schema
+
+def test_sample_carries_both_clocks():
+    rec = metrics.sample()
+    assert set(rec) == {"t", "ns", "metrics"}
+    assert rec["t"] == pytest.approx(time.time(), abs=60)
+    assert isinstance(rec["ns"], int) and rec["ns"] > 0
+    later = metrics.sample()
+    assert later["ns"] > rec["ns"]      # monotonic, never steps back
+    assert metrics.series()[-1]["ns"] == later["ns"]
+
+
+def test_update_slo_prunes_dead_fleet_labelsets():
+    fleet = _FakeFleet({"gone_model": [_FakeReplica(7, open_=True)]})
+    ref = fleet  # keep alive while registered
+    serving._register_fleet(fleet)
+    metrics.update_slo()
+    g = metrics.get("mxnet_tpu_fleet_breaker_open")
+    assert g.value(model="gone_model", replica="7") == 1
+    del ref, fleet
+    import gc
+
+    gc.collect()  # the WeakSet entry dies with the fleet
+    metrics.update_slo()
+    assert g.value(model="gone_model", replica="7") is None
+    assert metrics.get("mxnet_tpu_fleet_healthy_replicas").value(
+        model="gone_model") is None
+
+
+def test_backwards_clock_rebases_firing_rule():
+    """Review fix: a rule left FIRING under a larger (synthetic) clock
+    must still resolve once evaluation returns to a smaller clock
+    domain — per-rule timestamps re-base with the history."""
+    rule = alerts.BurnRateRule(
+        "x_test_clock", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0, cooldown_s=5.0)
+    _solo(rule)
+    _seed_slo(requests=10)
+    t = 100000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    assert alerts.evaluate(now=t + 30, force=True)["x_test_clock"] == \
+        "FIRING"
+    # the clock moves backwards (e.g. real monotonic after a synthetic
+    # drill): the rule must not be stuck FIRING forever
+    alerts.evaluate(now=50.0, force=True)
+    assert rule.last_breach <= 50.0
+    got = alerts.evaluate(now=60.0, force=True)
+    assert got.get("x_test_clock") == "RESOLVED"
+    assert alerts.open_incidents() == []
+
+
+def test_update_derived_fires_slo_burn_hook_once():
+    """Review fix: one update_derived() tick takes ONE slo_counters()
+    view shared by the gauges and the alert windows — a times=1 arm
+    must both dip the hit-rate gauge and trip the burn rule, not burn
+    its one fire on whichever consumer asked first."""
+    rule = alerts.BurnRateRule(
+        "x_test_onefire", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0)
+    _solo(rule)
+    _seed_slo(requests=100)
+    prev = alerts.set_enabled(True)
+    try:
+        metrics.update_derived()          # clean baseline tick
+        with faults.inject("slo_burn", times=1) as f:
+            metrics.update_derived()      # ONE tick, one fire
+        assert f.fired == 1               # nothing double-consumed
+        assert rule.state == "FIRING"
+        hit = metrics.get("mxnet_tpu_fleet_deadline_hit_rate").value()
+        assert hit is not None and hit < 0.99  # gauges saw it too
+    finally:
+        alerts.set_enabled(prev)
+
+
+def test_incidents_limit_zero_is_empty():
+    """Review fix: limit=0 must truncate to nothing (out[-0:] slices
+    the WHOLE list)."""
+    rule = alerts.BurnRateRule(
+        "x_test_lim", "fleet_deadline_exceeded", "fleet_requests",
+        objective=0.99, fast_s=60, slow_s=60, factor=4.0)
+    _solo(rule)
+    _seed_slo(requests=10)
+    alerts.evaluate(now=1000.0, force=True)
+    serving._STATS["fleet_requests"] += 10
+    serving._STATS["fleet_deadline_exceeded"] += 10
+    alerts.evaluate(now=1030.0, force=True)
+    assert len(alerts.incidents()) == 1
+    assert alerts.incidents(limit=0) == []
+    assert len(alerts.incidents(limit=1)) == 1
+
+
+def test_rate_limiter_immune_to_synthetic_clock(monkeypatch):
+    """Review fix: maybe_evaluate's MXNET_TPU_ALERT_EVAL_S limiter
+    keeps its own REAL-monotonic bookkeeping — a drill's huge
+    synthetic evaluation clock must not suppress exporter ticks."""
+    monkeypatch.setenv("MXNET_TPU_ALERT_EVAL_S", "30")
+    alerts.evaluate(now=1e9, force=True)  # synthetic drill clock
+    prev = alerts.set_enabled(True)
+    before = profiler.dispatch_stats()["alert_evaluations"]
+    try:
+        assert alerts.maybe_evaluate() is not None  # not rate-limited
+        assert alerts.maybe_evaluate() is None      # NOW rate-limited
+    finally:
+        alerts.set_enabled(prev)
+    assert profiler.dispatch_stats()["alert_evaluations"] == before + 1
+
+
+def test_input_stall_probe_reuses_the_ticks_derivation():
+    """Review fix: update_derived passes its own input-stall value to
+    the engine (one derivation per tick, gauge and rule judge the same
+    number); a direct evaluate() still derives on demand."""
+    got = alerts.evaluate(now=1000.0, force=True, input_stall=0.9)
+    assert got.get("input_stall_high") == "FIRING"
+    assert alerts.get_rule("input_stall_high").last_evidence["value"] \
+        == 0.9
+
+
+def test_chrome_trace_tolerates_null_fields():
+    """Review fix: a foreign dump record with "attrs": null or a
+    missing dur_ns converts instead of TypeError-ing the export."""
+    recs = [{"trace": "t", "span": "aa.1", "parent": None,
+             "name": "serve.replica", "t0_ns": 10, "attrs": None}]
+    doc = traceview.to_chrome_trace(recs)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["dur"] > 0
+
+
+def test_alert_counters_key_stability():
+    s = profiler.dispatch_stats()
+    for key in ("alert_evaluations", "alert_transitions",
+                "alert_incidents_opened", "alert_incidents_resolved"):
+        assert key in s

@@ -155,6 +155,38 @@ def test_rd005_documented_token_is_clean(tmp_path):
     assert got == [("RD005", "documented_fiel")], got
 
 
+def test_rd006_exact(fixture_findings):
+    # one undrilled/undocumented alert-rule id fires; the waived id,
+    # the non-registry tuple, the non-string element and the
+    # inner-scope declaration stay clean
+    got = _in_file(fixture_findings, "rd006_alert_drift.py")
+    assert got == [("RD006", "<module>", "fixture_undrilled_rule")], got
+
+
+def test_rd006_documented_and_covered_is_clean(tmp_path):
+    # an id that is BOTH documented under docs/ and exercised by the
+    # coverage sources passes; documented-only (or covered-only) fires
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "alerts.py").write_text(
+        'ALERT_RULE_IDS = ("clean_rule", "doc_only_rule", '
+        '"test_only_rule")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `clean_rule` | covered |\n| `doc_only_rule` | covered |\n")
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_alerts.py").write_text(
+        'def test_x():\n    assert get_rule("clean_rule")\n'
+        '    assert get_rule("test_only_rule")\n')
+    project = core.Project(str(tmp_path))
+    got = sorted((f.rule, f.token)
+                 for f in core.run_all(project, rules={"RD006"}))
+    assert got == [("RD006", "doc_only_rule"),
+                   ("RD006", "test_only_rule")], got
+
+
 def test_rd001_rd003_miniproject():
     # the mini-project mirrors the repo's default layout, so this is
     # also a test of the CLI's zero-config Project defaults
@@ -191,7 +223,8 @@ def test_no_unexpected_fixture_findings(fixture_findings):
                "ts002_capture.py": 1, "ts003_donated_read.py": 1,
                "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
                "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1,
-               "rd004_obs_drift.py": 2, "rd005_perf_drift.py": 1}
+               "rd004_obs_drift.py": 2, "rd005_perf_drift.py": 1,
+               "rd006_alert_drift.py": 1}
     per_file = {}
     for f in fixture_findings:
         per_file[os.path.basename(f.path)] = \

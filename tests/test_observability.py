@@ -748,7 +748,7 @@ def test_http_endpoint_serves_metrics_and_dump():
         assert b"mxnet_tpu_span_ms" in body
         dump = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/obs", timeout=10).read())
-        assert dump["schema_version"] == 1
+        assert dump["schema_version"] == 2
     finally:
         server.shutdown()
 
@@ -816,8 +816,9 @@ def test_dump_has_all_sections():
     with trace.span("d.root"):
         pass
     d = obs.dump()
-    assert d["schema_version"] == 1
-    assert {"flight", "spans", "metrics", "series", "counters"} <= set(d)
+    assert d["schema_version"] == 2
+    assert {"flight", "spans", "metrics", "series", "incidents",
+            "alerts", "counters"} <= set(d)
     assert any(s["name"] == "d.root" for s in d["spans"])
     assert d["counters"]["obs_dumps"] >= 1
     json.dumps(d, default=str)  # JSON-serializable end to end
@@ -883,6 +884,8 @@ OBS_KEYS = frozenset({
     "obs_spans", "obs_spans_shipped", "obs_flight_events",
     "obs_metric_flushes", "obs_metric_samples", "obs_dumps",
     "perf_ledger_entries", "perf_device_timings",
+    "alert_evaluations", "alert_transitions",
+    "alert_incidents_opened", "alert_incidents_resolved",
 })
 
 

@@ -57,7 +57,7 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "peer_death_recover", "oom_step", "dist_connect_timeout",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
-              "perf_regression")
+              "perf_regression", "slo_burn", "step_time_anomaly")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -596,6 +596,156 @@ def _drill_perf_regression(mx, workdir):
                 f"clean_after={not clean}")
 
 
+def _assert_one_incident(alerts, rule_id, want_ledger_key=False):
+    """Shared incident checks for the alerting drills: exactly one
+    incident is open, for the expected rule, and its report is
+    CORRELATED — a flight slice containing the injected fault event,
+    at least one exemplar span tree, and (when asked) an implicated
+    perf-ledger key. Returns (ok, detail, incident)."""
+    incs = alerts.incidents()
+    opened = [i for i in incs if i["status"] == "open"]
+    if len(incs) != 1 or len(opened) != 1:
+        return (False,
+                f"expected exactly one open incident, got {len(incs)} "
+                f"({len(opened)} open)", None)
+    inc = opened[0]
+    if inc["rule"] != rule_id:
+        return False, f"incident rule {inc['rule']} != {rule_id}", inc
+    has_fault = any(e.get("kind") == "fault" for e in inc["flight"])
+    has_exemplar = len(inc["exemplars"]) >= 1 and all(
+        tree for tree in inc["exemplars"])
+    has_key = (not want_ledger_key
+               or bool(inc["evidence"].get("ledger_keys")))
+    if not (has_fault and has_exemplar and has_key):
+        return (False,
+                f"incident not correlated: fault_event={has_fault} "
+                f"exemplars={has_exemplar} ledger_key={has_key}", inc)
+    return True, "", inc
+
+
+def _drill_slo_burn(mx, workdir):
+    """An SLO burn on a LIVE 2-replica fleet: the injected fault
+    inflates the deadline-miss counters feeding metrics.slo_counters(),
+    the multi-window burn-rate rule goes FIRING and opens exactly ONE
+    correlated incident (flight slice with the fault event, >=1
+    exemplar serve.request tree, fleet replica states), and once the
+    injection stops the rule cools down and the incident RESOLVES."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import alerts, flight, trace
+    from mxnet_tpu.resilience import faults
+
+    def factory():
+        mx.random.seed(5)
+        net = mx.gluon.nn.Dense(4, in_units=3, prefix="burn_net_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+
+    alerts.reset()
+    serving.reset_stats()
+    prev_trace = trace.set_enabled(True)
+    prev_alerts = alerts.set_enabled(False)  # drive a synthetic clock:
+    trace.clear()                            # no real-time auto-ticks
+    try:
+        x = np.ones((1, 3), np.float32)
+        with serving.Fleet(factory, replicas=2,
+                           server_kw={"batch_timeout_ms": 1.0}) as fleet:
+            for _ in range(4):
+                fleet.submit(x, deadline_ms=10000).result(timeout=10)
+            t = 1000.0
+            alerts.evaluate(now=t, force=True)  # clean window bookmark
+            if alerts.incidents():
+                return False, "incident open before the injection"
+            with faults.inject("slo_burn", times=None) as f:
+                for _ in range(2):
+                    t += 30.0
+                    alerts.evaluate(now=t, force=True)
+            ok, why, inc = _assert_one_incident(alerts,
+                                                "slo_deadline_burn")
+            if not ok:
+                return False, why
+            burn = inc["evidence"]["windows"]["fast"]["burn"]
+            has_fleet = len(inc["fleet"]) == 2
+            exemplar_root = inc["exemplars"][0][0]["name"]
+            # injection stopped: the rule must cool down and resolve
+            t += alerts.get_rule("slo_deadline_burn").cooldown_s + 1.0
+            alerts.evaluate(now=t, force=True)
+        resolved = (not alerts.open_incidents()
+                    and alerts.incidents()[0]["status"] == "resolved")
+        states = [e["state"] for e in flight.events(kind="alert")]
+        ok = (f.fired >= 1 and resolved and has_fleet
+              and exemplar_root == "serve.request"
+              and states[-2:] == ["FIRING", "RESOLVED"])
+        return ok, (f"fired={f.fired} burn={burn} fleet_states={has_fleet} "
+                    f"exemplar={exemplar_root} resolved={resolved}")
+    finally:
+        trace.set_enabled(prev_trace)
+        alerts.set_enabled(prev_alerts)
+        alerts.reset()
+
+
+def _drill_step_time_anomaly(mx, workdir):
+    """A step-time anomaly on a CAPTURED training step: the fault
+    inflates one measured step duration as the median/MAD drift
+    detector ingests it, exactly one correlated incident opens — its
+    report naming the implicated perf-ledger key (the captured step's
+    executable) next to the flight slice and an exemplar step
+    timeline — and clean steps after the injection resolve it."""
+    import numpy as np
+
+    from mxnet_tpu import capture
+    from mxnet_tpu.observability import alerts, trace
+    from mxnet_tpu.resilience import faults
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).sum()
+
+    alerts.reset()
+    prev_trace = trace.set_enabled(True)
+    prev_alerts = alerts.set_enabled(False)
+    trace.clear()
+    try:
+        net, trainer, _ = _trainer(mx)
+        step = capture.capture(trainer, net=net, loss_fn=loss_fn)
+        x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y = mx.nd.ones((2, 4))
+        for _ in range(10):
+            step(x, y, batch_size=2)
+        t = 1000.0
+        alerts.evaluate(now=t, force=True)  # banks the clean baseline
+        if alerts.incidents():
+            return False, "incident open before the injection"
+        with faults.inject("step_time_anomaly", times=1) as f:
+            step(x, y, batch_size=2)   # the next ingest inflates this one
+            t += 5.0
+            alerts.evaluate(now=t, force=True)
+        ok, why, inc = _assert_one_incident(alerts, "step_time_drift",
+                                            want_ledger_key=True)
+        if not ok:
+            return False, why
+        keys = inc["evidence"]["ledger_keys"]
+        ledgered = any(k.startswith("trainer_step@") for k in keys) \
+            and all(k in inc["perf"] for k in keys)
+        exemplar_root = inc["exemplars"][0][0]["name"]
+        # clean steps only: the detector must stop breaching + resolve
+        for _ in range(3):
+            step(x, y, batch_size=2)
+        t += alerts.get_rule("step_time_drift").cooldown_s + 1.0
+        alerts.evaluate(now=t, force=True)
+        resolved = (not alerts.open_incidents()
+                    and alerts.incidents()[0]["status"] == "resolved")
+        ok = (f.fired == 1 and ledgered and resolved
+              and exemplar_root == "train.captured_step")
+        return ok, (f"fired={f.fired} ledger_keys={keys} "
+                    f"exemplar={exemplar_root} resolved={resolved}")
+    finally:
+        trace.set_enabled(prev_trace)
+        alerts.set_enabled(prev_alerts)
+        alerts.reset()
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -643,6 +793,10 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_int8_calib_mismatch(mx, tmp)
     if kind == "perf_regression":
         return _drill_perf_regression(mx, tmp)
+    if kind == "slo_burn":
+        return _drill_slo_burn(mx, tmp)
+    if kind == "step_time_anomaly":
+        return _drill_step_time_anomaly(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

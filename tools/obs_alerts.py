@@ -1,0 +1,141 @@
+"""Inspect / demo the alert engine's incidents (docs/observability.md,
+"Alerting & incidents").
+
+Two modes:
+
+- **inspect** (``--input PATH``): read an existing JSON file — an
+  ``observability.dump()`` (``--out`` of ``tools/obs_dump.py``) or a
+  watchdog crash report — and summarize its ``incidents`` section:
+  per-rule counts, open vs resolved, evidence presence. Pure JSON, no
+  runtime (or jax) import.
+- **demo** (no ``--input``): run the full detection loop in-process —
+  a live 2-replica traced serving fleet, an injected ``slo_burn``
+  driving the multi-window burn-rate rule FIRING (one correlated
+  incident: flight slice + exemplar request tree + fleet states), then
+  disarm and drive it RESOLVED. This is the smoke-test form proving
+  alerting, correlation and resolution end-to-end.
+
+Prints ONE JSON line (the repo-wide tool contract)::
+
+    {"metric": "obs_open_incidents", "value": <n>, "unit": "incidents",
+     "extra": {"total": ..., "by_rule": {...}, "resolved": ...}}
+
+Exit code is non-zero when any incident is OPEN (an operator piping
+this into a health check gets a failing exit while something is
+burning) or, in demo mode, when the demo loop failed to open-and-
+resolve its incident.
+
+Run: JAX_PLATFORMS=cpu python tools/obs_alerts.py [--input f]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _summarize(incidents):
+    by_rule = {}
+    open_n = resolved_n = 0
+    correlated = 0
+    for inc in incidents:
+        rule = inc.get("rule", "?")
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+        if inc.get("status") == "open":
+            open_n += 1
+        else:
+            resolved_n += 1
+        if inc.get("flight") and inc.get("exemplars"):
+            correlated += 1
+    return {"total": len(incidents), "by_rule": by_rule,
+            "resolved": resolved_n, "correlated": correlated}, open_n
+
+
+def _demo_incidents():
+    """Open and resolve one slo_burn incident on a live 2-replica
+    fleet; returns the recorded incidents."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import alerts, trace
+    from mxnet_tpu.resilience import faults
+
+    def factory():
+        mx.random.seed(5)
+        net = mx.gluon.nn.Dense(4, in_units=3, prefix="alert_demo_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+
+    alerts.reset()
+    serving.reset_stats()
+    prev_trace = trace.set_enabled(True)
+    prev_alerts = alerts.set_enabled(False)  # synthetic clock below;
+    try:                                     # no auto-ticks in between
+        x = np.ones((1, 3), np.float32)
+        with serving.Fleet(factory, replicas=2,
+                           server_kw={"batch_timeout_ms": 1.0}) as fleet:
+            for _ in range(4):
+                fleet.submit(x, deadline_ms=10000).result(timeout=10)
+            t = 1000.0
+            alerts.evaluate(now=t, force=True)  # clean bookmark sample
+            with faults.inject("slo_burn", times=None):
+                for _ in range(2):
+                    t += 30.0
+                    alerts.evaluate(now=t, force=True)
+            t += alerts.get_rule("slo_deadline_burn").cooldown_s + 60.0
+            alerts.evaluate(now=t, force=True)  # burn stopped: resolve
+        return alerts.incidents()
+    finally:
+        trace.set_enabled(prev_trace)
+        alerts.set_enabled(prev_alerts)
+        faults.reset()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default=None,
+                    help="existing dump / crash-report JSON to inspect")
+    args = ap.parse_args(argv)
+
+    demo_ok = True
+    if args.input is not None:
+        try:
+            with open(args.input, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obs_alerts: cannot read {args.input}: {e}",
+                  file=sys.stderr)
+            print(json.dumps({"metric": "obs_open_incidents", "value": 0,
+                              "unit": "incidents",
+                              "extra": {"error": str(e)}}))
+            return 1
+        incidents = data.get("incidents", [])
+        extra, open_n = _summarize(incidents)
+        extra["source"] = args.input
+    else:
+        incidents = _demo_incidents()
+        extra, open_n = _summarize(incidents)
+        # the demo must have told the whole story: one slo_burn
+        # incident, correlated, opened AND resolved
+        demo_ok = (extra["total"] == 1 and extra["resolved"] == 1
+                   and extra["correlated"] == 1
+                   and extra["by_rule"].get("slo_deadline_burn") == 1)
+        extra["demo_ok"] = demo_ok
+
+    for inc in incidents:
+        print(f"{inc.get('id')}: {inc.get('rule')} [{inc.get('status')}] "
+              f"flight={len(inc.get('flight') or [])} "
+              f"exemplars={len(inc.get('exemplars') or [])}",
+              file=sys.stderr)
+    print(json.dumps({"metric": "obs_open_incidents", "value": open_n,
+                      "unit": "incidents", "extra": extra}, default=str))
+    return 0 if open_n == 0 and demo_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,12 @@ Two modes:
 
 ``--out PATH`` writes the full dump JSON (demo mode only).
 
+``--kind K`` and ``--since-seq N`` slice the flight ring exactly like
+``flight.events(kind=, since_seq=)`` — drills and operators can cut
+the event list to one kind, or to everything after a bookmarked
+sequence number, from the CLI. The exit-code contract is unchanged: an
+empty (post-filter) event list exits non-zero.
+
 Prints ONE JSON line (the repo-wide tool contract):
 
     {"metric": "obs_dump_events", "value": <n>, "unit": "events",
@@ -74,12 +80,28 @@ def _summarize_events(events):
     return by_kind
 
 
+def _filter_events(events, kind=None, since_seq=0):
+    """The ``flight.events(kind=, since_seq=)`` contract applied to an
+    already-materialized event list (works identically on the live
+    ring's dump and on an inspected crash report)."""
+    if kind is not None:
+        events = [e for e in events if e.get("kind") == kind]
+    if since_seq:
+        events = [e for e in events if e.get("seq", 0) > since_seq]
+    return events
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--input", default=None,
                     help="existing crash report or dump JSON to inspect")
     ap.add_argument("--out", default=None,
                     help="write the full demo dump JSON here")
+    ap.add_argument("--kind", default=None,
+                    help="only flight events of this kind (fault, span, "
+                         "ckpt, fleet, alert, ...)")
+    ap.add_argument("--since-seq", type=int, default=0,
+                    help="only flight events after this sequence number")
     args = ap.parse_args(argv)
 
     if args.input is not None:
@@ -95,17 +117,20 @@ def main(argv=None):
             return 1
         # a crash report embeds the tail as "flight_recorder"; a dump
         # carries the ring as "flight"
-        events = data.get("flight", data.get("flight_recorder", []))
+        events = _filter_events(
+            data.get("flight", data.get("flight_recorder", [])),
+            args.kind, args.since_seq)
         extra = {
             "source": args.input,
             "by_kind": _summarize_events(events),
             "spans": len(data.get("spans", [])),
+            "incidents": len(data.get("incidents", [])),
             "schema_version": data.get("schema_version"),
         }
         n = len(events)
     else:
         dump = _demo_dump()
-        events = dump["flight"]
+        events = _filter_events(dump["flight"], args.kind, args.since_seq)
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:
                 json.dump(dump, f, indent=1, default=str)
@@ -115,6 +140,7 @@ def main(argv=None):
             "spans": len(dump["spans"]),
             "metrics": len(dump["metrics"]),
             "perf_ledger": sorted(dump["perf"]["entries"]),
+            "incidents": len(dump["incidents"]),
             "counters": {k: v for k, v in dump["counters"].items()
                          if k.startswith("obs_")},
         }

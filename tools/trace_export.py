@@ -1,0 +1,142 @@
+"""Export span timelines as Chrome Trace Event Format JSON
+(docs/observability.md, "Timeline export").
+
+Two modes:
+
+- **inspect/convert** (``--input PATH``): read an existing JSON file —
+  an ``observability.dump()`` (its ``spans`` ring) or any file carrying
+  ``incidents`` with exemplar span trees — and convert the span
+  records via ``observability.traceview.to_chrome_trace()``. The
+  converter module is loaded BY FILE PATH (it is deliberately
+  self-contained), so this path imports neither the runtime nor jax.
+- **demo** (no ``--input``): run a tiny traced train + serve workload
+  in-process (the ``obs_dump.py`` smoke shape) and export the live
+  span ring.
+
+``--out PATH`` (default ``chrome_trace.json``) receives the Trace
+Event Format JSON — load it in Perfetto / ``chrome://tracing``.
+
+Prints ONE JSON line (the repo-wide tool contract)::
+
+    {"metric": "trace_export_events", "value": <n>, "unit": "events",
+     "extra": {"out": ..., "pids": ..., "threads": ..., "names": ...}}
+
+Exit code is non-zero when no span events were exported (a traced
+workload that leaves no timeline means tracing is broken).
+
+Run: JAX_PLATFORMS=cpu python tools/trace_export.py [--input f] [--out f]
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_traceview():
+    """Load observability/traceview.py by file path — no package (and
+    so no jax) import on the --input path."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu", "observability",
+        "traceview.py")
+    spec = importlib.util.spec_from_file_location("_graft_traceview", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _records_from_input(data):
+    """Span records from a dump (``spans``) or, failing that, the
+    exemplar trees of any ``incidents`` the file carries."""
+    recs = data.get("spans")
+    if recs:
+        return list(recs)
+    out = []
+    for inc in data.get("incidents", ()):
+        for tree in inc.get("exemplars", ()):
+            out.extend(tree)
+    return out
+
+
+def _demo_records():
+    """Two traced training steps + one traced BatchServer request."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.observability import trace
+
+    prev = trace.set_enabled(True)
+    try:
+        mx.random.seed(11)
+        net = mx.gluon.nn.Dense(4, in_units=3)
+        net.initialize()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1})
+        for k in range(2):
+            x = mx.nd.array(np.ones((2, 3), np.float32) + k)
+            y = mx.nd.ones((2, 4))
+            with mx.autograd.record():
+                loss = ((net(x) - y) ** 2).sum()
+            loss.backward()
+            trainer.step(2)
+        pred = serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+        with serving.BatchServer(pred, max_batch_size=2,
+                                 batch_timeout_ms=1.0) as srv:
+            srv.submit(np.ones((1, 3), np.float32)).result(timeout=10)
+        return trace.spans()
+    finally:
+        trace.set_enabled(prev)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default=None,
+                    help="existing dump / incident JSON to convert")
+    ap.add_argument("--out", default="chrome_trace.json",
+                    help="Trace Event Format output path")
+    args = ap.parse_args(argv)
+
+    if args.input is not None:
+        try:
+            with open(args.input, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trace_export: cannot read {args.input}: {e}",
+                  file=sys.stderr)
+            print(json.dumps({"metric": "trace_export_events", "value": 0,
+                              "unit": "events",
+                              "extra": {"error": str(e)}}))
+            return 1
+        records = _records_from_input(data)
+    else:
+        records = _demo_records()
+
+    traceview = _load_traceview()
+    doc = traceview.to_chrome_trace(records)
+    events = doc["traceEvents"]
+    span_events = [e for e in events if e["ph"] == "X"]
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    print(f"chrome trace -> {args.out} ({len(span_events)} span event(s), "
+          f"{len(events) - len(span_events)} metadata)", file=sys.stderr)
+
+    extra = {
+        "out": args.out,
+        "pids": len({e["pid"] for e in span_events}),
+        "threads": len({(e['pid'], e['tid']) for e in span_events}),
+        "names": sorted({e["name"] for e in span_events})[:20],
+    }
+    print(json.dumps({"metric": "trace_export_events",
+                      "value": len(span_events), "unit": "events",
+                      "extra": extra}, default=str))
+    return 0 if span_events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
