@@ -1374,6 +1374,13 @@ class CapturedShardedStep:
     def mesh(self):
         return self.trainer.mesh
 
+    @property
+    def batch_sharding(self):
+        """The trainer's batch placement, passed through so the
+        streaming layer's ``DevicePrefetcher.for_trainer`` accepts a
+        captured step wherever it accepts the trainer itself."""
+        return self.trainer.batch_sharding
+
 
 def capture(trainer, net=None, loss_fn=None, **kwargs):
     """Capture a whole training step as one donated XLA executable.

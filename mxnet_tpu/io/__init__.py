@@ -1,7 +1,10 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, MXDataIter, ImageRecordIter, MNISTIter,
                  CSVIter)
+from .stream import (RecordStream, StreamBatchIter, StreamBatch,
+                     DevicePrefetcher)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MXDataIter", "ImageRecordIter", "MNISTIter",
-           "CSVIter"]
+           "CSVIter", "RecordStream", "StreamBatchIter", "StreamBatch",
+           "DevicePrefetcher"]

@@ -208,7 +208,8 @@ class ImageRecordIter(DataIter):
         if lib is not None:
             self._impl = _NativePipeline(lib, path_imgrec, path_imgidx, cfg)
         else:
-            self._impl = _PyPipeline(path_imgrec, cfg)
+            self._impl = _PyPipeline(path_imgrec, cfg,
+                                     idx_path=path_imgidx)
 
     @property
     def provide_data(self):
@@ -311,34 +312,15 @@ class _NativePipeline:
 class _PyPipeline:
     """Pure-Python fallback with identical batch semantics (PIL decode)."""
 
-    def __init__(self, rec_path, cfg):
-        from ..recordio import _decode_flag_len, _kMagic
-
+    def __init__(self, rec_path, cfg, idx_path=None):
         self._cfg = cfg
-        self._records = []  # offset of each logical record's first frame
-        with open(rec_path, "rb") as f:
-            off = 0
-            in_split = False
-            while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
-                    break
-                magic, fl = struct.unpack("<II", hdr)
-                if magic != _kMagic:
-                    raise MXNetError("bad record magic")
-                cflag, length = _decode_flag_len(fl)
-                if not in_split:
-                    self._records.append(off)
-                    in_split = cflag == 1  # kBegin
-                elif cflag == 3:  # kEnd
-                    in_split = False
-                elif cflag != 2:  # not kMiddle
-                    raise MXNetError("bad record framing")
-                skip = (length + 3) & ~3
-                f.seek(off + 8 + skip)
-                off += 8 + skip
-            if in_split:
-                raise MXNetError("truncated split record")
+        # offset of each logical record's first frame: from the .idx
+        # offset index when one exists (range reads, no full-file scan —
+        # the same index the streaming layer and the native pipeline
+        # consume), else a sequential framing scan
+        self._records = self._load_index_offsets(rec_path, idx_path)
+        if self._records is None:
+            self._records = self._scan_offsets(rec_path)
         self._rec_path = rec_path
         self._tls = threading.local()
         from concurrent.futures import ThreadPoolExecutor
@@ -359,6 +341,66 @@ class _PyPipeline:
         self._order = _np.arange(self.num_samples)
         self._epoch = 0
         self._start_epoch(first=True)
+
+    @staticmethod
+    def _load_index_offsets(rec_path, idx_path):
+        """Record offsets from the .idx index, or None when the index is
+        absent or fails a cheap sanity check (a stale index must fall
+        back to the scan, like the native reader does)."""
+        if not idx_path or not os.path.isfile(idx_path):
+            return None
+        from ..recordio import load_index, read_logical_record
+
+        try:
+            offsets = [e.offset for e in load_index(idx_path)]
+        except (OSError, ValueError):
+            return None
+        size = os.path.getsize(rec_path)
+        if not offsets or offsets != sorted(offsets) \
+                or offsets[0] != 0 or offsets[-1] >= size:
+            return None
+        # the index must reach EOF: an index from an earlier, SHORTER
+        # pack of the same data passes every offset check but would
+        # silently drop the trailing records — verify the record framed
+        # at the last offset ends exactly at the file size
+        try:
+            with open(rec_path, "rb") as f:
+                f.seek(offsets[-1])
+                if read_logical_record(f) is None or f.tell() != size:
+                    return None
+        except (OSError, ValueError):
+            return None
+        return offsets
+
+    @staticmethod
+    def _scan_offsets(rec_path):
+        from ..recordio import _decode_flag_len, _kMagic
+
+        records = []
+        with open(rec_path, "rb") as f:
+            off = 0
+            in_split = False
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                magic, fl = struct.unpack("<II", hdr)
+                if magic != _kMagic:
+                    raise MXNetError("bad record magic")
+                cflag, length = _decode_flag_len(fl)
+                if not in_split:
+                    records.append(off)
+                    in_split = cflag == 1  # kBegin
+                elif cflag == 3:  # kEnd
+                    in_split = False
+                elif cflag != 2:  # not kMiddle
+                    raise MXNetError("bad record framing")
+                skip = (length + 3) & ~3
+                f.seek(off + 8 + skip)
+                off += 8 + skip
+            if in_split:
+                raise MXNetError("truncated split record")
+        return records
 
     def _start_epoch(self, first=False):
         if not first:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import sys
 import threading
 import time
 
@@ -522,9 +523,22 @@ def _probe_healthy_floor(ctx):
 
 
 def _probe_input_stall(ctx):
-    if ctx.input_stall is not None:
-        return ctx.input_stall, None
-    return _metrics.update_input_stall(), None
+    value = (ctx.input_stall if ctx.input_stall is not None
+             else _metrics.update_input_stall())
+    # evidence names WHERE the starving loop's streaming iterators sat
+    # (epoch + global cursor, io/stream.py). sys.modules lookup, not an
+    # import: when the stream module was never loaded there are no live
+    # iterators, and the alert path must not drag the io package in.
+    detail = None
+    stream_mod = sys.modules.get("mxnet_tpu.io.stream")
+    if stream_mod is not None:
+        try:
+            positions = stream_mod.live_positions()
+        except Exception:
+            positions = []
+        if positions:
+            detail = {"stream_positions": positions}
+    return value, detail
 
 
 def _default_rules():

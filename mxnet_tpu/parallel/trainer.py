@@ -360,6 +360,17 @@ class ShardedTrainer:
     def learning_rate(self):
         return self._optimizer_params.get("learning_rate")
 
+    @property
+    def batch_sharding(self):
+        """NamedSharding of the step's batch operands on the CURRENT
+        mesh (re-derived on a mesh shrink) — the overlap handshake with
+        the streaming input layer: ``io.stream.DevicePrefetcher.
+        for_trainer`` places each prefetched batch with exactly this
+        sharding, so ``step``'s own placement check
+        (``is_equivalent_to``) skips the redundant device_put and the
+        captured step consumes an already-resident batch."""
+        return self._batch_sharding
+
     def _is_multiprocess(self):
         import jax
 

@@ -185,6 +185,12 @@ def dispatch_stats(reset=False, lock_timeout=None):
       fleet_replica_latency_us
     - dataloader_respawns: multiprocessing DataLoader workers respawned
       after dying mid-epoch (docs/resilience.md)
+    - streaming-ingestion counters (docs/data.md): io_batches_streamed
+      (host batches assembled by StreamBatchIter), io_records_corrupt
+      (CRC-failed records skipped under policy=skip),
+      io_prefetch_depth (DevicePrefetcher ring occupancy, last
+      observed), io_stream_resumes (iterators rewound from a resume
+      token)
     - capture counters (docs/capture.md): capture_steps/hits/misses,
       capture_retraces (recompiles of a captured program, each with a
       structured reason in the dispatch ring and capture.retrace_log()),
@@ -216,6 +222,7 @@ def dispatch_stats(reset=False, lock_timeout=None):
     from . import capture, engine, observability, resilience, serving
     from .contrib import quantization
     from .gluon.data import dataloader
+    from .io import stream
     from .ops import registry
 
     if lock_timeout is None:
@@ -228,6 +235,7 @@ def dispatch_stats(reset=False, lock_timeout=None):
         stats.update(resilience.stats())
         stats.update(serving.stats())
         stats.update(dataloader.stats())
+        stats.update(stream.stats())
         stats.update(capture.stats())
         stats.update(quantization.stats())
         stats.update(observability.stats())
@@ -241,7 +249,8 @@ def dispatch_stats(reset=False, lock_timeout=None):
 
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
-    serving + dataloader + capture + quantization + observability).
+    serving + dataloader + stream + capture + quantization +
+    observability).
     Takes the profiler lock so a concurrent ``dispatch_stats()`` sees
     either the pre-reset or the post-reset world, never a mix."""
     with _LOCK:
@@ -252,6 +261,7 @@ def _reset_dispatch_stats_locked():
     from . import capture, engine, observability, resilience, serving
     from .contrib import quantization
     from .gluon.data import dataloader
+    from .io import stream
     from .ops import registry
 
     registry.reset_dispatch_stats()
@@ -260,6 +270,7 @@ def _reset_dispatch_stats_locked():
     resilience.reset_stats()
     serving.reset_stats()
     dataloader.reset_stats()
+    stream.reset_stats()
     capture.reset_stats()
     quantization.reset_stats()
     observability.reset_stats()

@@ -13,12 +13,14 @@ import ctypes
 import numbers
 import os
 import struct
+import zlib
 from collections import namedtuple
 
 import numpy as np
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "IndexEntry",
+           "RecordCorruptError", "load_index", "read_record_at", "pack",
+           "unpack", "pack_img", "unpack_img"]
 
 _kMagic = 0xced7230a
 
@@ -197,9 +199,103 @@ class MXIndexedRecordIO(MXRecordIO):
         key = self.key_type(idx)
         pos = self.tell()
         self.write(buf)
-        self.fidx.write(f"{key}\t{pos}\n")
+        # extended index line: offset + logical payload length + CRC32,
+        # so streaming consumers (io/stream.py) can range-read and
+        # integrity-check each record without scanning the record
+        # stream. Legacy readers (this class, the native pipeline)
+        # parse the first two columns only, so the format stays
+        # backward compatible (docs/data.md).
+        self.fidx.write(f"{key}\t{pos}\t{len(buf)}\t"
+                        f"{zlib.crc32(buf) & 0xFFFFFFFF}\n")
         self.idx[key] = pos
         self.keys.append(key)
+
+
+# ------------------------------------------------------------ offset index
+
+IndexEntry = namedtuple("IndexEntry", ["key", "offset", "length", "crc32"])
+IndexEntry.__doc__ = """One parsed line of a ``.idx`` offset index.
+
+``length`` (logical payload bytes) and ``crc32`` (payload checksum) come
+from the extended 4-column form ``tools/im2rec.py`` / ``write_idx``
+emit; both are None for legacy 2-column indexes."""
+
+
+class RecordCorruptError(ValueError):
+    """A record failed its integrity check on read (CRC32/length recorded
+    in the offset index, or an unreadable frame at the indexed offset).
+    Structured: ``path`` / ``key`` / ``offset`` name the damaged record so
+    recovery tooling and the ``io_records_corrupt`` skip policy
+    (io/stream.py, docs/data.md) can report precisely what was lost."""
+
+    def __init__(self, message, path=None, key=None, offset=None):
+        super().__init__(message)
+        self.path = path
+        self.key = key
+        self.offset = offset
+
+
+def load_index(path, key_type=int):
+    """Parse a RecordIO offset index into ``[IndexEntry]`` (file order).
+
+    Accepts both the legacy 2-column ``key\\toffset`` form and the
+    extended 4-column ``key\\toffset\\tlength\\tcrc32`` form the repo's
+    writers emit; extra columns beyond the first are ignored by the
+    legacy readers, so one file serves both."""
+    entries = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 2:
+                continue
+            entries.append(IndexEntry(
+                key_type(parts[0]), int(parts[1]),
+                int(parts[2]) if len(parts) > 2 else None,
+                int(parts[3]) if len(parts) > 3 else None))
+    return entries
+
+
+def read_record_at(fileobj, entry, path=None, verify=True):
+    """Range-read the logical record indexed by ``entry`` and verify its
+    payload against the index's recorded length and CRC32 (when
+    present). This is THE verified-read primitive the streaming
+    ingestion layer (io/stream.py) is built on: no full-file scan, and
+    a damaged record surfaces as a structured :class:`RecordCorruptError`
+    — never as garbage bytes silently decoded into a batch.
+
+    The ``record_corrupt`` fault kind (resilience.faults) injects a bit
+    flip here, between the read and the verification, so the chaos
+    drill exercises the real detection path."""
+    fileobj.seek(entry.offset)
+    try:
+        buf = read_logical_record(fileobj)
+    except ValueError as e:
+        raise RecordCorruptError(
+            f"unreadable record frame at offset {entry.offset} of {path} "
+            f"({e})", path=path, key=entry.key, offset=entry.offset) from e
+    if buf is None:
+        raise RecordCorruptError(
+            f"no record at offset {entry.offset} of {path} (stale index?)",
+            path=path, key=entry.key, offset=entry.offset)
+    from .resilience import faults as _faults
+
+    buf = _faults.maybe_corrupt_record(buf)
+    if not verify:
+        return buf
+    if entry.length is not None and len(buf) != entry.length:
+        raise RecordCorruptError(
+            f"record {entry.key} at offset {entry.offset} of {path} is "
+            f"{len(buf)} bytes but the index records {entry.length}",
+            path=path, key=entry.key, offset=entry.offset)
+    if entry.crc32 is not None:
+        crc = zlib.crc32(buf) & 0xFFFFFFFF
+        if crc != entry.crc32:
+            raise RecordCorruptError(
+                f"record {entry.key} at offset {entry.offset} of {path} "
+                f"failed its CRC32 integrity check (index records "
+                f"{entry.crc32:#010x}, payload hashes {crc:#010x})",
+                path=path, key=entry.key, offset=entry.offset)
+    return buf
 
 
 IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])

@@ -23,7 +23,10 @@ restore) decouples the saved state from the topology that saved it::
     ckpt-00000042/
         manifest.json      # step/epoch/rng/scaler + per-ARRAY records:
                            #   logical shape, dtype, sharding spec, and
-                           #   per-shard-file {index, crc32, size}
+                           #   per-shard-file {index, crc32, size};
+                           #   plus the optional data_state resume token
+                           #   of a streaming input iterator
+                           #   (save(data_iter=...), docs/data.md)
         arrays/00000-000.bin   # one raw-bytes payload per unique shard
         trainer.state      # gluon Updater pickle (eager trainer only —
                            #   sharded opt_state lives in arrays/)
@@ -492,7 +495,7 @@ class CheckpointManager:
     # ---------------------------------------------------------------- save
 
     def save(self, step, net=None, trainer=None, epoch=None, extra=None,
-             async_=False):
+             async_=False, data_iter=None):
         """Write one checkpoint atomically; returns its published path.
 
         Snapshots, as available: ``net`` parameters (or the sharded
@@ -500,6 +503,15 @@ class CheckpointManager:
         (gluon Trainer or parallel ShardedTrainer), the global RNG key,
         and the attached AMP loss-scaler state. On any failure the
         previous checkpoints are untouched.
+
+        ``data_iter`` is a streaming input iterator exposing
+        ``state()``/``restore()`` (``io.stream.StreamBatchIter`` or its
+        ``DevicePrefetcher`` wrapper): its resume token — epoch, shard
+        identity, chunk-permutation seed, global sample cursor; a
+        prefetcher's token deliberately excludes its in-flight ring —
+        is snapshotted synchronously into the manifest's ``data_state``
+        field, so kill-resume and mesh-shrink replay re-produce the
+        exact remaining sample sequence (docs/data.md).
 
         ``async_=True`` returns as soon as device state is snapshotted
         (fork mode: zero-copy views + a COW ``fork()``; thread mode: an
@@ -518,19 +530,23 @@ class CheckpointManager:
         self._gc_debris()
         tag = self._tag(step)
         final = os.path.join(self.directory, tag)
+        # the data-iterator token is taken HERE, synchronously — it must
+        # describe the stream position at the moment of the save, not
+        # wherever an async writer later gets around to looking
+        data_state = None if data_iter is None else dict(data_iter.state())
         if not async_:
             # a synchronous save completes before the caller can run
             # another (donating) step, so zero-copy views are safe —
             # the writer's tobytes() is the one unavoidable copy
             snap = self._snapshot(step, net, trainer, epoch, extra, tag,
-                                  copy=False)
+                                  copy=False, data_state=data_state)
             with _obs_trace.span("ckpt.save", step=int(step), mode="sync"):
                 path = self._write_snapshot(snap, tag, final)
             _obs_flight.record("ckpt", op="save", step=int(step), tag=tag)
             return path
         mode = _async_mode()
         snap = self._snapshot(step, net, trainer, epoch, extra, tag,
-                              copy=(mode != "fork"))
+                              copy=(mode != "fork"), data_state=data_state)
         _STATS["ckpt_async_saves"] += 1
         _track_manager(self)  # exit barrier: never lose the final save
         if mode == "fork":
@@ -674,7 +690,8 @@ class CheckpointManager:
         except BaseException as e:  # incl. SimulatedCrash: debris stays
             info["error"] = e
 
-    def _snapshot(self, step, net, trainer, epoch, extra, tag, copy=True):
+    def _snapshot(self, step, net, trainer, epoch, extra, tag, copy=True,
+                  data_state=None):
         """Host-side snapshot of everything the checkpoint will persist
         — after this returns, the writer never touches device state, so
         an async publish is isolated from subsequent (donating) steps.
@@ -725,6 +742,7 @@ class CheckpointManager:
                              "rng_key": _rng_state(),
                              "loss_scaler": _scaler_state(trainer),
                              "mesh_axes": mesh_axes,
+                             "data_state": data_state,
                              "extra": extra or {}}}
 
     def _write_snapshot(self, snap, tag, final, is_async=False,
@@ -841,13 +859,16 @@ class CheckpointManager:
 
     # ------------------------------------------------------------- restore
 
-    def restore_latest(self, net=None, trainer=None):
+    def restore_latest(self, net=None, trainer=None, data_iter=None):
         """Restore the newest *valid* checkpoint into ``net``/``trainer``;
         returns its manifest, or None if no valid checkpoint exists.
         Corrupt or partially-written checkpoints — a bad manifest OR any
         shard file failing its CRC — are skipped in favor of the previous
         valid one. Barriers on an in-flight async save first, so the
-        freshest published state is always considered."""
+        freshest published state is always considered. ``data_iter``
+        (``io.stream``; see ``save``) is rewound to the manifest's
+        ``data_state`` token, re-producing the exact remaining sample
+        sequence."""
         import warnings
 
         self.wait_for_async()
@@ -861,22 +882,35 @@ class CheckpointManager:
                     _STATS["ckpt_restore_skipped"] += 1
                     warnings.warn(f"skipping corrupt checkpoint: {e}")
                     continue
-                return self._apply(manifest, payloads, net, trainer)
+                return self._apply(manifest, payloads, net, trainer,
+                                   data_iter)
         return None
 
-    def restore(self, path, net=None, trainer=None):
+    def restore(self, path, net=None, trainer=None, data_iter=None):
         """Restore one specific checkpoint (verified, bitwise — onto the
         CURRENT mesh topology for sharded trainers) and return its
         manifest."""
         self.wait_for_async()
         with self._pin(path):
             manifest, payloads = self._verify(path)
-            return self._apply(manifest, payloads, net, trainer)
+            return self._apply(manifest, payloads, net, trainer, data_iter)
 
-    def _apply(self, manifest, payloads, net, trainer):
+    def _apply(self, manifest, payloads, net, trainer, data_iter=None):
         """Apply already-verified payload bytes (one disk read total),
-        spanned and flight-recorded as one restore."""
+        spanned and flight-recorded as one restore. The data iterator is
+        validated and rewound FIRST: its restore() rejects a missing or
+        incompatible token without touching net/trainer, so a stream
+        mismatch can never leave the model half-restored."""
         with _obs_trace.span("ckpt.restore", step=manifest.get("step")):
+            if data_iter is not None:
+                data_state = manifest.get("data_state")
+                if data_state is None:
+                    raise ValueError(
+                        "restore(data_iter=...) but the checkpoint "
+                        "manifest carries no data_state (saved without "
+                        "data_iter=?) — resuming the stream from an "
+                        "unknown position would replay samples")
+                data_iter.restore(data_state)
             out = self._apply_impl(manifest, payloads, net, trainer)
         _obs_flight.record("ckpt", op="restore", step=manifest.get("step"),
                            tag=manifest.get("tag"))

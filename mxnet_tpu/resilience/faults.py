@@ -84,6 +84,16 @@ Supported fault kinds (the hook that honours each is noted):
                                   opens exactly one correlated incident
                                   (``alerts.py``) and resolves when the
                                   burn stops
+- ``record_corrupt``            — flip one byte of a streamed RecordIO
+                                  payload between the range read and the
+                                  CRC verification
+                                  (``recordio.read_record_at``), so the
+                                  drill proves a corrupt record becomes
+                                  a structured ``RecordCorruptError`` —
+                                  or a counted skip under the
+                                  ``MXNET_TPU_DATA_CORRUPT_POLICY=skip``
+                                  knob — never garbage bytes decoded
+                                  into a training batch
 - ``step_time_anomaly``         — inflate one measured step-time span
                                   duration as the alert engine's
                                   median/MAD drift detector ingests it
@@ -120,7 +130,7 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_replica_crash", "maybe_replica_hang",
            "maybe_replica_nan_storm", "maybe_calib_table_drift",
            "maybe_perf_regression", "maybe_slo_burn",
-           "maybe_step_time_anomaly"]
+           "maybe_step_time_anomaly", "maybe_corrupt_record"]
 
 
 class SimulatedCrash(BaseException):
@@ -529,6 +539,26 @@ def maybe_step_time_anomaly(dur_ns):
     except ValueError:
         factor = 10.0
     return int(dur_ns * factor)
+
+
+def maybe_corrupt_record(buf):
+    """When ``record_corrupt`` fires, return ``buf`` (one streamed
+    RecordIO payload) with its middle byte flipped — same length, so
+    only the per-record CRC32 the offset index carries can catch it.
+    Hooked into ``recordio.read_record_at`` between the range read and
+    the verification, so the drill proves the real detection path turns
+    silent bitrot into a structured ``RecordCorruptError`` (policy
+    ``raise``) or a counted, substituted row (policy ``skip`` +
+    ``io_records_corrupt``) — never garbage bytes in a batch."""
+    if not _ACTIVE:
+        return buf
+    fault = _ACTIVE.get("record_corrupt")
+    if fault is None or not fault.should_fire():
+        return buf
+    out = bytearray(buf)
+    if out:
+        out[len(out) // 2] ^= 0xFF
+    return bytes(out)
 
 
 def maybe_peer_death():

@@ -81,3 +81,9 @@ def pytest_configure(config):
         "correlation and Chrome-trace export "
         "(mxnet_tpu/observability/alerts.py + traceview.py, "
         "docs/observability.md); runs in tier-1")
+    config.addinivalue_line(
+        "markers",
+        "stream: sharded streaming ingestion, device prefetch and "
+        "deterministic mid-epoch resume (mxnet_tpu/io/stream.py, "
+        "docs/data.md); fast cases run in tier-1, the dp=8 input-stall "
+        "bench gate carries the slow marker too")

@@ -190,6 +190,42 @@ def test_native_matches_python(rec_dataset):
         assert np.abs(bn.data[0].asnumpy() - bp.data[0].asnumpy()).mean() < 8.0
 
 
+def test_python_pipeline_uses_offset_index(rec_dataset):
+    """With a .idx next to the .rec, the Python fallback initializes
+    from the offset index (no full-file framing scan) and produces the
+    identical batch stream."""
+    from mxnet_tpu.io.record_pipeline import _PyPipeline, _build_config
+
+    cfg = _build_config(8, (3, 8, 8), 1, False, 0, 2, 2, False, False,
+                        False, 0.08, 1.0, 0.75, 4 / 3, 0, (0.0,) * 4,
+                        (1.0,) * 4, 0, 1, True, 0)
+    indexed = _PyPipeline(rec_dataset + ".rec", cfg,
+                          idx_path=rec_dataset + ".idx")
+    scanned = _PyPipeline(rec_dataset + ".rec", cfg)
+    assert indexed._records == scanned._records
+    assert indexed.num_samples == scanned.num_samples == N_IMAGES
+    bi, bs = indexed.next(), scanned.next()
+    np.testing.assert_array_equal(bi[0], bs[0])
+    np.testing.assert_array_equal(bi[1], bs[1])
+    # a stale index (offset past EOF) falls back to the scan
+    stale = rec_dataset + "_stale.idx"
+    with open(stale, "w") as f:
+        f.write("0\t0\n1\t99999999999\n")
+    fallback = _PyPipeline(rec_dataset + ".rec", cfg, idx_path=stale)
+    assert fallback._records == scanned._records
+    # review fix: a stale PREFIX index (valid offsets from a shorter
+    # pack of the same data, not reaching EOF) must also fall back —
+    # trusting it would silently drop the trailing records
+    prefix_idx = rec_dataset + "_prefix.idx"
+    with open(rec_dataset + ".idx") as f:
+        head = [next(f) for _ in range(10)]
+    with open(prefix_idx, "w") as f:
+        f.writelines(head)
+    fallback2 = _PyPipeline(rec_dataset + ".rec", cfg, idx_path=prefix_idx)
+    assert fallback2._records == scanned._records
+    assert fallback2.num_samples == N_IMAGES
+
+
 def _write_split_record(f, payload):
     """Write `payload` the way the dmlc-core writer does when it contains the
     magic word: split at each magic occurrence into kBegin/kMiddle/kEnd

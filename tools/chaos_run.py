@@ -57,7 +57,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "peer_death_recover", "oom_step", "dist_connect_timeout",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
-              "perf_regression", "slo_burn", "step_time_anomaly")
+              "perf_regression", "slo_burn", "step_time_anomaly",
+              "record_corrupt")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -746,6 +747,57 @@ def _drill_step_time_anomaly(mx, workdir):
         alerts.reset()
 
 
+def _drill_record_corrupt(mx, workdir):
+    """A streamed RecordIO payload is corrupted in flight (bitrot the
+    range read can't see — same length, only the index CRC catches it):
+    policy=raise surfaces a STRUCTURED RecordCorruptError naming the
+    shard/key/offset, and policy=skip counts ``io_records_corrupt``,
+    substitutes the row, and keeps delivering every other record —
+    never garbage bytes decoded into a batch."""
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import stream as dstream
+    from mxnet_tpu.resilience import faults
+
+    prefix = os.path.join(workdir, "stream")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(8):
+        payload = np.full(4, i, np.float32).tobytes()
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payload))
+    rec.close()
+    decode = dstream.raw_decoder((4,))
+
+    # policy=raise: the corrupt record is a structured error, not data
+    it = dstream.StreamBatchIter(prefix + ".rec", batch_size=2,
+                                 decode=decode, epochs=1,
+                                 corrupt_policy="raise")
+    with faults.inject("record_corrupt") as f:
+        try:
+            next(it)
+            return False, "corrupt record decoded into a batch"
+        except recordio.RecordCorruptError as e:
+            structured = (e.path is not None and e.key is not None
+                          and e.offset is not None)
+
+    # policy=skip: counted substitute row, stream completes the epoch
+    before = dstream.stats()["io_records_corrupt"]
+    it = dstream.StreamBatchIter(prefix + ".rec", batch_size=2,
+                                 decode=decode, epochs=1,
+                                 corrupt_policy="skip")
+    with faults.inject("record_corrupt") as f2:
+        batches = list(it)
+    skipped = dstream.stats()["io_records_corrupt"] - before
+    labels = sorted(float(v) for b in batches for v in np.atleast_1d(b.label))
+    # 8 records, one corrupt: its row is substituted by a valid batch
+    # row, so geometry holds (4 batches x 2 rows) with one duplicate
+    ok = (structured and f.fired == 1 and f2.fired == 1 and skipped == 1
+          and len(batches) == 4 and len(set(labels)) == 7)
+    return ok, (f"structured={structured} skipped={skipped} "
+                f"batches={len(batches)} distinct_labels={len(set(labels))}")
+
+
 def _drill_dist_connect_timeout(mx, workdir):
     from mxnet_tpu.kvstore import dist as kd
     from mxnet_tpu.resilience import faults
@@ -797,6 +849,8 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_slo_burn(mx, tmp)
     if kind == "step_time_anomaly":
         return _drill_step_time_anomaly(mx, tmp)
+    if kind == "record_corrupt":
+        return _drill_record_corrupt(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

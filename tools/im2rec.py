@@ -12,6 +12,15 @@ Two modes:
          Reads ``prefix.lst``, encodes each image (optionally resized /
          re-encoded JPEG), writes ``prefix.rec`` + ``prefix.idx`` readable by
          ImageRecordIter and MXIndexedRecordIO.
+
+The emitted ``.idx`` is the extended 4-column offset index
+(``key\\toffset\\tlength\\tcrc32``): legacy readers parse the first two
+columns, while the streaming ingestion layer (mxnet_tpu/io/stream.py,
+docs/data.md) uses it for index-based range reads and per-record CRC
+verification without ever scanning the record stream. ``--num-shards N``
+splits the pack into ``prefix-00000.rec/.idx .. prefix-{N-1:05d}.rec/.idx``
+(contiguous balanced split of the list), the layout each host/dp rank
+streams its slice of.
 """
 from __future__ import annotations
 
@@ -67,25 +76,56 @@ def read_list(path):
             yield idx, labels, parts[-1]
 
 
-def pack(prefix, root, resize=0, quality=95, color=1):
+def shard_prefixes(prefix, num_shards):
+    """Output prefixes of a sharded pack: ``prefix`` itself when
+    ``num_shards <= 1``, else ``prefix-00000 .. prefix-{N-1:05d}`` (the
+    inputs a per-rank RecordStream slices)."""
+    if num_shards <= 1:
+        return [prefix]
+    return [f"{prefix}-{s:05d}" for s in range(num_shards)]
+
+
+def pack(prefix, root, resize=0, quality=95, color=1, num_shards=1):
     from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack as _pack
 
-    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
-    count = 0
-    for idx, labels, rel in read_list(prefix + ".lst"):
-        path = os.path.join(root, rel)
-        try:
-            payload = _encode(path, resize, quality, color)
-        except Exception as e:  # noqa: BLE001 - skip unreadable images
-            print(f"skipping {rel}: {e}", file=sys.stderr)
-            continue
-        label = labels[0] if len(labels) == 1 else labels
-        rec.write_idx(idx, _pack(IRHeader(0, label, idx, 0), payload))
-        count += 1
-        if count % 1000 == 0:
-            print(f"packed {count}")
-    rec.close()
-    print(f"packed {count} records -> {prefix}.rec")
+    entries = list(read_list(prefix + ".lst"))
+    prefixes = shard_prefixes(prefix, num_shards)
+    n_shards = len(prefixes)
+    if len(entries) < n_shards:
+        raise ValueError(
+            f"--num-shards {n_shards} exceeds the {len(entries)}-entry "
+            "list: an empty shard's .idx would fail every streaming "
+            "consumer at load time, far from this pack")
+    # contiguous balanced split: shard s takes entries[bounds[s]:bounds[s+1]]
+    bounds = [round(s * len(entries) / n_shards)
+              for s in range(n_shards + 1)]
+    total = 0
+    for s, out_prefix in enumerate(prefixes):
+        rec = MXIndexedRecordIO(out_prefix + ".idx", out_prefix + ".rec",
+                                "w")
+        count = 0
+        for idx, labels, rel in entries[bounds[s]:bounds[s + 1]]:
+            path = os.path.join(root, rel)
+            try:
+                payload = _encode(path, resize, quality, color)
+            except Exception as e:  # noqa: BLE001 - skip unreadable images
+                print(f"skipping {rel}: {e}", file=sys.stderr)
+                continue
+            label = labels[0] if len(labels) == 1 else labels
+            rec.write_idx(idx, _pack(IRHeader(0, label, idx, 0), payload))
+            count += 1
+            if count % 1000 == 0:
+                print(f"packed {count}")
+        rec.close()
+        if count == 0:
+            raise ValueError(
+                f"shard {out_prefix} packed 0 records (every image in "
+                "its slice was skipped as unreadable); fix the inputs "
+                "or re-pack with fewer shards")
+        total += count
+        print(f"packed {count} records -> {out_prefix}.rec")
+    if n_shards > 1:
+        print(f"packed {total} records across {n_shards} shards")
 
 
 def _encode(path, resize, quality, color):
@@ -121,13 +161,17 @@ def main(argv=None):
     ap.add_argument("--quality", type=int, default=95)
     ap.add_argument("--color", type=int, default=1, choices=[0, 1])
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-shards", type=int, default=1,
+                    help="split the pack into N prefix-XXXXX.rec/.idx "
+                         "shards (contiguous balanced split of the list)")
     args = ap.parse_args(argv)
     if args.list:
         make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
                   seed=args.seed, train_ratio=args.train_ratio)
     else:
         pack(args.prefix, args.root, resize=args.resize,
-             quality=args.quality, color=args.color)
+             quality=args.quality, color=args.color,
+             num_shards=args.num_shards)
 
 
 if __name__ == "__main__":

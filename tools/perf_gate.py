@@ -9,7 +9,11 @@ loop (docs/observability.md "Performance attribution", PERF.md round 6):
    training step + one warmed serving Predictor bucket) and gather, per
    perf-ledger key (``<label>@<fingerprint16>``, the AOT-fingerprint
    identity), the ledger's ``compile_ms`` / ``peak_hbm_bytes`` plus a
-   best-of-N measured ``step_ms`` wall time.
+   best-of-N measured ``step_ms`` wall time. Streaming ingestion rides
+   along under the fixed ``stream_ingest@host_pipeline`` key (per-batch
+   host pipeline wall time over a synthetic dataset — no compiled
+   executable, so ``step_ms`` only), so an ingestion regression fails
+   the gate like a compute regression (docs/data.md).
 2. **compare** — against the committed per-backend baseline store
    ``tools/perf_baseline.json`` (schema-versioned). A key missing from
    the baseline means the program's *identity* changed (shape / dtype /
@@ -157,10 +161,53 @@ def _collect_once(steps, trials):
             elif e["label"].startswith("serving_bucket"):
                 rec["step_ms"] = serve_ms
             measured[key] = rec
+        measured["stream_ingest@host_pipeline"] = {
+            "step_ms": _measure_stream_ingest(steps, trials)}
         return measured
     finally:
         if saved_cache is not None:
             os.environ["MXNET_TPU_COMPILE_CACHE"] = saved_cache
+
+
+def _measure_stream_ingest(steps, trials):
+    """Best-of-N per-batch host-pipeline wall time (index range read +
+    raw decode + batch assembly, io/stream.py) over a fixed synthetic
+    dataset. The key is the fixed string ``stream_ingest@host_pipeline``
+    — there is no compiled executable behind it, so the entry gates
+    ``step_ms`` only."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import stream as dstream
+
+    sdir = tempfile.mkdtemp(prefix="perfgate_stream_")
+    try:
+        prefix = os.path.join(sdir, "synth")
+        rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                         "w")
+        rs = np.random.RandomState(11)
+        for i in range(64):
+            rec.write_idx(i, recordio.pack(
+                recordio.IRHeader(0, float(i % 8), i, 0),
+                rs.rand(16).astype(np.float32).tobytes()))
+        rec.close()
+        stream_ms = 1e9
+        for _ in range(trials):
+            it = dstream.StreamBatchIter(
+                prefix + ".rec", batch_size=16,
+                decode=dstream.raw_decoder((16,)), shuffle=True, seed=3,
+                decode_threads=1)
+            t0 = time.perf_counter()
+            for _k in range(steps):
+                next(it)
+            stream_ms = min(stream_ms,
+                            (time.perf_counter() - t0) / steps * 1e3)
+        return stream_ms
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
 
 
 def compare(current, baseline_entries, tolerance_pct=None,
