@@ -120,6 +120,9 @@ def scale_loss(loss, trainer):
     if scaler is None:
         yield loss
         return
+    # a fresh eager step begins: a finite flag noted by a captured step
+    # is about ITS gradients — never let it answer this step's unscale
+    scaler.clear_note()
     trainer._scale = trainer._amp_original_scale / scaler.loss_scale
     if isinstance(loss, (list, tuple)):
         yield [l * scaler.loss_scale for l in loss]

@@ -19,10 +19,36 @@ class LossScaler:
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        self._noted_finite = None
+
+    def note_finite(self, finite):
+        """Captured-step hook: the whole-program capture computes the
+        fused all-finite check INSIDE its donated executable (one side
+        output next to the numerics telemetry) and notes the result
+        here, so the next :meth:`has_overflow` consumes the flag
+        instead of re-running the kernel and paying a per-step
+        ``.asnumpy()`` host sync. Never called on the eager path, whose
+        behavior stays bitwise-identical."""
+        self._noted_finite = bool(finite)
+
+    def clear_note(self):
+        """Invalidate any unconsumed noted flag. Called at the start of
+        an EAGER step (``amp.scale_loss``, the captured step's eager
+        fallback): a flag noted by a previous captured step describes
+        that step's gradients, and must never answer ``has_overflow``
+        for a fresh eager backward."""
+        self._noted_finite = None
 
     def has_overflow(self, params):
         """True if any gradient in ``params`` (list of Parameter or NDArray)
-        contains inf/nan. Uses the fused multi_all_finite kernel."""
+        contains inf/nan. Uses the fused multi_all_finite kernel — or,
+        under whole-program capture, the flag the captured step already
+        computed in-graph (``note_finite``), consumed once: no second
+        kernel launch, no host sync."""
+        noted = self._noted_finite
+        if noted is not None:
+            self._noted_finite = None
+            return not noted
         from ..ndarray import ndarray as _nd
 
         grads = []

@@ -65,6 +65,7 @@ import time
 
 from . import profiler as _profiler
 from .observability import flight as _obs_flight
+from .observability import numerics as _obs_numerics
 from .observability import perf as _obs_perf
 from .observability import trace as _obs_trace
 
@@ -840,11 +841,19 @@ class CapturedTrainerStep:
     loss_scaler : amp.LossScaler — its scale becomes a runtime operand:
         the loss is scaled before backward, gradients unscale before the
         finite check and update, and the scaler's host schedule advances
-        from the program's overflow flag.
+        from the program's overflow flag (``note_finite``, so
+        ``has_overflow`` never host-syncs under capture).
+    numerics : observability.numerics.NumericsTap — in-graph numerics
+        telemetry: per-layer/per-param stats computed on-device as one
+        extra side output, with sampling cadence and stat selection as
+        runtime operands (docs/observability.md "Numerics telemetry").
+        Default: armed from ``MXNET_TPU_NUMERICS``; None keeps the
+        program identical to the pre-telemetry build.
     """
 
     def __init__(self, net, loss_fn, trainer, batch_size=None,
-                 sentinel=None, loss_scaler=None, label="trainer_step"):
+                 sentinel=None, loss_scaler=None, numerics=None,
+                 label="trainer_step"):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
@@ -859,6 +868,10 @@ class CapturedTrainerStep:
         self.sentinel = sentinel if sentinel is not None \
             else getattr(trainer, "_sentinel", None)
         self.loss_scaler = loss_scaler
+        self.numerics = numerics if numerics is not None \
+            else _obs_numerics.default_tap()
+        if self.numerics is not None:
+            self.numerics.bind(net, trainer)
         self._entries = {}
         self._last_sig = None
         self._step_count = 0
@@ -908,7 +921,7 @@ class CapturedTrainerStep:
         return flag, norm_ok
 
     def _run_step_python(self, x_nd, y_nd, batch_size, scale_val=None,
-                         check_gate=None):
+                         check_gate=None, tap_ops=None):
         """The step body re-run by discovery and by the jit trace. The
         update sweep runs in a nested TraceSession so the sentinel
         select knows each cell's pre-update value. ``check_gate`` is the
@@ -916,7 +929,12 @@ class CapturedTrainerStep:
         on off-cadence steps the eager ``before_update`` never looks at
         the gradients, so the select must let even an unhealthy batch
         through — except the loss-scaler's finiteness gate, which eager
-        AMP applies every step."""
+        AMP applies every step. ``tap_ops`` is the numerics tap's
+        column-selection-mask operand and marks the SAMPLED program
+        variant: when present, the per-layer stats matrix computes and
+        rides out as one extra side output; when None with a tap armed,
+        this body builds the base (off-cadence) variant — no hooks, no
+        stats, only the finite gate for halt/skip policies."""
         import jax.numpy as jnp
 
         from . import autograd
@@ -924,46 +942,91 @@ class CapturedTrainerStep:
         from .ndarray.ndarray import NDArray
 
         trainer = self.trainer
-        with autograd.record():
-            out = self.net(x_nd)
-            loss = self.loss_fn(out, y_nd)
-            if scale_val is not None:
-                scale_nd = NDArray(jnp.asarray(scale_val, jnp.float32))
-                sess = _active()
-                if sess is not None:
-                    sess.note_created(scale_nd)
-                loss_b = loss * scale_nd
-            else:
-                loss_b = loss
+        tap = self.numerics
+        # "full" = the sampled-step program variant (stats side output);
+        # with tap_ops=None and a tap armed this body builds the BASE
+        # variant: for a record-policy tap literally the untapped
+        # program, for halt/skip the untapped program + the fused
+        # finite flag and its weight-write select (the protection that
+        # must run every step regardless of sampling)
+        full = tap is not None and tap_ops is not None
+        hooks = acts = None
+        if full:
+            hooks, acts = tap.install_hooks(self.net)
+        try:
+            with autograd.record():
+                out = self.net(x_nd)
+                loss = self.loss_fn(out, y_nd)
+                if scale_val is not None:
+                    scale_nd = NDArray(jnp.asarray(scale_val, jnp.float32))
+                    sess = _active()
+                    if sess is not None:
+                        sess.note_created(scale_nd)
+                    loss_b = loss * scale_nd
+                else:
+                    loss_b = loss
+        finally:
+            if full:
+                tap.remove_hooks(hooks)
         loss_b.backward()
         grads = self._grad_list()
         if scale_val is not None:
             inv = 1.0 / scale_nd
             for g in grads:
                 g._set_data((g * inv)._data)
+        # a record-policy tap adds NO per-step device work: its finite
+        # signal rides the sampled stats matrix's nonfinite column, so
+        # the fused every-step finite reduction is only built when
+        # something gates on it (sentinel, AMP scaler, halt/skip tap)
         flags = self._health_flags(grads) if (
-            self.sentinel is not None or scale_val is not None) else None
+            self.sentinel is not None or scale_val is not None
+            or (tap is not None and tap.gates_updates)) else None
+        tap_params = tap_pre = None
+        if full:
+            tap_params = tap.tapped_params(trainer)
+            tap_pre = [p.data()._data for p in tap_params]
         outer = _active()
         trainer._optimizer.rescale_grad = trainer._scale / batch_size
         with TraceSession() as upd:
             trainer._allreduce_grads()
             trainer._update()
         _absorb_session(outer, upd)
+        tap_out = None
+        if full:
+            # stats see the RAW computed update (post - pre), before the
+            # health select below decides whether it lands
+            named_grads = []
+            for p in tap_params:
+                for g in p.list_grad():
+                    named_grads.append((p.name, g.data_))
+            named_pre = [(p.name, d) for p, d in zip(tap_params, tap_pre)]
+            named_post = [(p.name, p.data()._data) for p in tap_params]
+            tap_out = tap.graph_stats(named_grads, named_pre, named_post,
+                                      acts, tap_ops)
         if flags is not None:
             finite, norm_ok = flags
             ok = finite if norm_ok is None \
                 else jnp.logical_and(finite, norm_ok)
-            if check_gate is not None:
-                passed = jnp.logical_or(ok, check_gate == 0)
-                if scale_val is not None:
-                    # AMP overflow skips are never sampled
-                    passed = jnp.logical_and(passed, finite)
-            else:
-                passed = ok
-            for cell in upd.mutated:
-                cell._data = jnp.where(passed, cell._data,
-                                       upd.orig[id(cell)])
-        return loss, flags
+            passed = None
+            if self.sentinel is not None or scale_val is not None:
+                if check_gate is not None:
+                    passed = jnp.logical_or(ok, check_gate == 0)
+                    if scale_val is not None:
+                        # AMP overflow skips are never sampled
+                        passed = jnp.logical_and(passed, finite)
+                else:
+                    passed = ok
+            if tap is not None and tap.gates_updates:
+                # halt/skip numerics policies: a non-finite batch never
+                # touches the weights, sampled or not (the AMP rule); a
+                # record-only tap leaves the program bitwise-transparent
+                passed = finite if passed is None \
+                    else jnp.logical_and(passed, finite)
+            if passed is not None:
+                for cell in upd.mutated:
+                    cell._data = jnp.where(passed, cell._data,
+                                           upd.orig[id(cell)])
+        return loss, flags, tap_out
 
     # ------------------------------------------------------------------ build
     def _build(self, x_nd, y_nd, batch_size, sig):
@@ -986,16 +1049,20 @@ class CapturedTrainerStep:
         from .jit import TraceSession
         from .ndarray.ndarray import NDArray
 
+        import numpy as np
+
         host_snap = self._opt_host_snapshot()
         scale0 = (self.loss_scaler.loss_scale
                   if self.loss_scaler is not None else None)
         has_gate = self.sentinel is not None
+        has_tap = self.numerics is not None
+        tap0 = self.numerics.sel_values() if has_tap else None
         with _ScalarSession("discover") as scal, TraceSession() as sess:
             sess.note_created(x_nd)
             sess.note_created(y_nd)
             try:
                 self._run_step_python(x_nd, y_nd, batch_size, scale0,
-                                      1.0 if has_gate else None)
+                                      1.0 if has_gate else None, tap0)
             finally:
                 for m in sess.mutated:
                     m._data = sess.orig[id(m)]
@@ -1003,72 +1070,120 @@ class CapturedTrainerStep:
         slots = list(scal.slots)
         n_dyn = len(scal.values)
         state_cells = list(sess.captured)
-        has_flag = self.sentinel is not None or self.loss_scaler is not None
+        has_flag = self.sentinel is not None \
+            or self.loss_scaler is not None \
+            or (has_tap and self.numerics.gates_updates)
         has_scale = self.loss_scaler is not None
         has_norm = self.sentinel is not None \
             and self.sentinel.grad_norm_threshold is not None
+        tap_rows = self.numerics.rows if has_tap else ()
         step = self
 
-        def pure(arg_datas, state_datas, dyn_vals):
-            saved = [c._data for c in state_cells]
-            snap = step._opt_host_snapshot()
-            try:
-                for c, d in zip(state_cells, state_datas):
-                    c._data = d
-                x2, y2 = NDArray(arg_datas[0]), NDArray(arg_datas[1])
-                scale_t = dyn_vals[n_dyn] if has_scale else None
-                gate_t = dyn_vals[n_dyn + int(has_scale)] if has_gate \
-                    else None
-                with _ScalarSession("record", slots, dyn_vals), \
-                        TraceSession() as inner:
-                    inner.note_created(x2)
-                    inner.note_created(y2)
-                    loss, flags = step._run_step_python(
-                        x2, y2, batch_size, scale_t, gate_t)
-                outs = [loss.data_]
-                if flags is not None:
-                    outs.append(flags[0])
-                    if flags[1] is not None:
-                        outs.append(flags[1])
-                new_state = [c._data for c in state_cells]
-            finally:
-                for c, d in zip(state_cells, saved):
-                    c._data = d
-                step._opt_host_restore(snap)
-            return outs, new_state
+        def make_pure(with_tap):
+            """One program variant: ``with_tap`` is the SAMPLED-step
+            form (stats side output + one trailing mask operand); the
+            base form is the off-cadence hot path — identical to the
+            pre-telemetry program for a record-policy tap, plus only
+            the fused finite gate for halt/skip policies."""
 
-        import numpy as np
+            def pure(arg_datas, state_datas, dyn_vals):
+                saved = [c._data for c in state_cells]
+                snap = step._opt_host_snapshot()
+                try:
+                    for c, d in zip(state_cells, state_datas):
+                        c._data = d
+                    x2, y2 = NDArray(arg_datas[0]), NDArray(arg_datas[1])
+                    idx = n_dyn
+                    scale_t = dyn_vals[idx] if has_scale else None
+                    idx += int(has_scale)
+                    gate_t = dyn_vals[idx] if has_gate else None
+                    idx += int(has_gate)
+                    tap_t = dyn_vals[idx] if with_tap else None
+                    with _ScalarSession("record", slots, dyn_vals), \
+                            TraceSession() as inner:
+                        inner.note_created(x2)
+                        inner.note_created(y2)
+                        loss, flags, tap_out = step._run_step_python(
+                            x2, y2, batch_size, scale_t, gate_t, tap_t)
+                    if with_tap and \
+                            tuple(step.numerics.rows) != tuple(tap_rows):
+                        raise CaptureError(
+                            "numerics tap row plan drifted between "
+                            f"discovery and trace ({len(tap_rows)} -> "
+                            f"{len(step.numerics.rows)} rows); recapture "
+                            "with a fresh CapturedTrainerStep")
+                    outs = [loss.data_]
+                    if flags is not None:
+                        outs.append(flags[0])
+                        if flags[1] is not None:
+                            outs.append(flags[1])
+                    if tap_out is not None:
+                        outs.append(tap_out)
+                    new_state = [c._data for c in state_cells]
+                finally:
+                    for c, d in zip(state_cells, saved):
+                        c._data = d
+                    step._opt_host_restore(snap)
+                return outs, new_state
+
+            return pure
 
         fingerprint = self._fingerprint(sig, slots, state_cells)
         # numpy f32 scalars: the per-step refresh passes np.float32 too,
         # so the example avals match the steady-state call exactly (a
         # Python float would trace a weak-typed operand and the compiled
         # program would reject the refreshed values)
+        base_dyn = ([np.float32(v) for v in scal.values]
+                    + ([np.float32(scale0)] if has_scale else [])
+                    + ([np.float32(1.0)] if has_gate else []))
         example = ([x_nd.data_, y_nd.data_],
-                   [c._data for c in state_cells],
-                   [np.float32(v) for v in scal.values]
-                   + ([np.float32(scale0)] if has_scale else [])
-                   + ([np.float32(1.0)] if has_gate else []))
-        fn = aot_compile(pure, label=self.label, fingerprint=fingerprint,
-                         example_args=example, donate_argnums=(1,))
+                   [c._data for c in state_cells], list(base_dyn))
+        fn = aot_compile(make_pure(False), label=self.label,
+                         fingerprint=fingerprint, example_args=example,
+                         donate_argnums=(1,))
+        fn_tap = None
+        fp_tap = None
+        if has_tap:
+            # the sampled-step variant is its own program (extra output
+            # + trailing mask operand) under a variant-tagged identity;
+            # cadence picks between the two PREBUILT executables, so an
+            # interval change can never retrace
+            fingerprint_tap = self._fingerprint(sig, slots, state_cells,
+                                                variant="tap_sample")
+            example_tap = ([x_nd.data_, y_nd.data_],
+                           [c._data for c in state_cells],
+                           list(base_dyn) + [self.numerics.sel_values()])
+            fn_tap = aot_compile(make_pure(True),
+                                 label=f"{self.label}:tap_sample",
+                                 fingerprint=fingerprint_tap,
+                                 example_args=example_tap,
+                                 donate_argnums=(1,))
+            fp_tap = _perf_identity(fingerprint_tap, example_tap)
         entry = {
-            "fn": fn, "cells": state_cells, "slots": slots,
+            "fn": fn, "fn_tap": fn_tap, "cells": state_cells,
+            "slots": slots,
             "has_flag": has_flag, "has_scale": has_scale,
             "has_gate": has_gate, "has_norm": has_norm,
+            "has_tap": has_tap, "tap_rows": tap_rows,
+            "tap_gates": has_tap and self.numerics.gates_updates,
+            "tap_idx": 1 + int(has_flag) + int(has_norm),
             "states_ref": self.trainer._updaters[0].states,
             "ctx": x_nd.context,
             # the same fp ⊕ avals identity aot_compile just ledgered,
             # so the per-step device timings land on this program's entry
             "fingerprint": _perf_identity(fingerprint, example),
+            "fingerprint_tap": fp_tap,
         }
         self._entries[sig] = entry
         self._last_sig = sig
         return entry
 
-    def _fingerprint(self, sig, slots, state_cells):
+    def _fingerprint(self, sig, slots, state_cells, variant=None):
         trainer = self.trainer
         opt = trainer._optimizer
         parts = {
+            # base vs tap_sample program variant of one captured step
+            "variant": variant,
             "net": [(n, tuple(c.shape), str(c.dtype))
                     for n, c in sorted(
                         self.net._collect_params_with_prefix().items())],
@@ -1085,6 +1200,10 @@ class CapturedTrainerStep:
             "sentinel": None if self.sentinel is None else
                 (self.sentinel.policy, self.sentinel.grad_norm_threshold),
             "scaler": self.loss_scaler is not None,
+            # row plan + column schema + gating semantics; cadence and
+            # stat selection are runtime operands and must NOT key here
+            "numerics": None if self.numerics is None
+                else self.numerics.plan_signature(),
         }
         return fingerprint(parts)
 
@@ -1122,6 +1241,12 @@ class CapturedTrainerStep:
             f = _faults.get("nan_grad")
             if f is not None and f.should_fire():
                 x_nd = NDArray(x_nd.data_ * np.float32("nan"), x_nd.context)
+        # the nonfinite_grad drill's captured form: poison the TARGET
+        # layer's weight so the NaN flows through the real compiled
+        # fwd/bwd into that layer's activations and gradients — the
+        # detection surface (fused finite flag + per-layer tap rows)
+        # and the bisect tool then localize it, never the injection
+        _faults.maybe_nonfinite_grad(self.trainer._params, where="param")
         bs = batch_size if batch_size is not None else (
             self._batch_size if self._batch_size is not None
             else int(x_nd.shape[0]))
@@ -1176,21 +1301,38 @@ class CapturedTrainerStep:
                 % self.sentinel.check_every == 0
         if entry["has_gate"]:
             dyn.append(np.float32(1.0 if checking else 0.0))
+        tap_sampled = False
+        if entry["has_tap"]:
+            # the cadence picks between the two PREBUILT program
+            # variants and the column selection is a trailing operand
+            # of the sampled one: changing either at runtime never
+            # retraces (tested by the compile-count probe)
+            tap_sampled = self.numerics.tick()
+            if tap_sampled:
+                dyn.append(self.numerics.sel_values())
         self._step_count += 1
         _watchdog.note_step(self._step_count)
         try:
-            with _obs_trace.span("train.captured_step",
-                                 step=self._step_count), \
+            # numerics_sampled marks the tap's cadence steps: they pay
+            # the stats variant + host pull by design, so the step-time
+            # drift detector excludes them (a configured sampling
+            # cadence is not an anomaly)
+            span_attrs = {"step": self._step_count}
+            if tap_sampled:
+                span_attrs["numerics_sampled"] = True
+            with _obs_trace.span("train.captured_step", **span_attrs), \
                     _watchdog.guard("step",
                                     detail="capture.CapturedTrainerStep",
                                     step=self._step_count):
                 _faults.maybe_hang("hang_step")
                 with _obs_trace.span("captured.execute"):
                     outs, new_state = _obs_perf.timed_call(
-                        entry["fn"],
+                        entry["fn_tap"] if tap_sampled else entry["fn"],
                         ([x_nd.data_, y_nd.data_],
                          [c._data for c in entry["cells"]], dyn),
-                        self.label, entry["fingerprint"])
+                        self.label,
+                        entry["fingerprint_tap"] if tap_sampled
+                        else entry["fingerprint"])
         except _watchdog.StallError as e:
             if not self._stall_rollback(e):
                 # the stalled step never applied: un-advance the replay's
@@ -1203,15 +1345,50 @@ class CapturedTrainerStep:
         for c, v in zip(entry["cells"], new_state):
             c._data = v
         loss = NDArray(outs[0], entry["ctx"])
-        if entry["has_flag"]:
+        # reading the flag is a host sync that breaks async dispatch
+        # pipelining. Anything that GATES on it — sentinel, AMP scaler,
+        # a halt/skip tap — reads it every step: the in-program select
+        # and the host bookkeeping (the un-advance below, Adam's t /
+        # num_update) must stay in lockstep, or the replayed scalar
+        # operands would drift from the reverted device state. Only a
+        # record-policy tap (pure telemetry, nothing gated) defers to
+        # the sampling cadence, deriving its finite signal from the
+        # sampled matrix's nonfinite column.
+        need_flag = entry["has_flag"] and (
+            self.sentinel is not None or entry["has_scale"]
+            or entry["tap_gates"] or tap_sampled)
+        if entry["has_tap"] and not need_flag:
+            # record-policy tap (or gating tap off-cadence): the finite
+            # signal derives from the sampled matrix's nonfinite column
+            stats_np = np.asarray(outs[entry["tap_idx"]]) \
+                if tap_sampled else None
+            self.numerics.on_step(self._step_count, None, stats_np,
+                                  (x_nd, y_nd))
+        if need_flag:
             finite_ok = bool(np.asarray(outs[1]).reshape(-1)[0])
             norm_ok = (bool(np.asarray(outs[2]).reshape(-1)[0])
                        if entry["has_norm"] else None)
+            if entry["has_scale"]:
+                # the in-graph flag IS the AMP all-finite check: note it
+                # so LossScaler.has_overflow never host-syncs under
+                # capture (amp.unscale consumes the noted flag)
+                self.loss_scaler.note_finite(finite_ok)
+            tap_gated = entry["tap_gates"] and not finite_ok
             gated = (not finite_ok) if not checking \
                 else not (finite_ok and norm_ok is not False)
-            if gated and (checking or entry["has_scale"]):
+            if (gated and (checking or entry["has_scale"])) or tap_gated:
+                # the gated update never applied: un-advance the
+                # replay's host bookkeeping (Adam's t, num_update)
                 self._opt_host_restore(host_snap)
             self._apply_flag(finite_ok, norm_ok, checking)
+            if entry["has_tap"]:
+                stats_np = np.asarray(outs[entry["tap_idx"]]) \
+                    if tap_sampled else None
+                # emission + divergence detectors + non-finite policy;
+                # off-cadence steps never pull the stats matrix (the
+                # finite flag above is the only per-step host read)
+                self.numerics.on_step(self._step_count, finite_ok,
+                                      stats_np, (x_nd, y_nd))
         return loss
 
     def _apply_flag(self, finite_ok, norm_ok, checking):
@@ -1311,7 +1488,8 @@ class CapturedTrainerStep:
         from .resilience import watchdog as _watchdog
 
         scale = float(scaler.loss_scale)
-        with autograd.record():
+        scaler.clear_note()  # stale captured-step flag never answers
+        with autograd.record():  # this eager step's has_overflow
             loss = self.loss_fn(self.net(x_nd), y_nd)
             loss_b = loss * scale
         loss_b.backward()
@@ -1333,10 +1511,12 @@ class CapturedTrainerStep:
                 for g in grads:
                     g._set_data((g * inv)._data)
                 _faults.maybe_nan_grads(self.trainer._params)
+                _faults.maybe_nonfinite_grad(self.trainer._params)
                 finite_t, norm_t = self._health_flags(grads)
                 finite_ok = bool(np.asarray(finite_t).reshape(-1)[0])
                 norm_ok = (bool(np.asarray(norm_t).reshape(-1)[0])
                            if norm_t is not None else None)
+                scaler.note_finite(finite_ok)
                 ok = finite_ok and norm_ok is not False
                 if finite_ok and (ok or not checking):
                     trainer._allreduce_grads()
@@ -1347,6 +1527,27 @@ class CapturedTrainerStep:
             return None
         self._apply_flag(finite_ok, norm_ok, checking)
         return loss
+
+
+    def attach_monitor(self, monitor):
+        """``Monitor.install`` entry point for the compiled-tap path:
+        ensures this step has a :class:`~.observability.numerics
+        .NumericsTap` (creating a ``record``-policy, request-driven one
+        when none is armed — ``Monitor.tic`` forces the sample, so the
+        tap's own cadence stays off) and returns it. Attaching a tap to
+        an already-built step is a program change: the built entries
+        are dropped with a structured retrace reason, never silently."""
+        tap = self.numerics
+        if tap is None:
+            tap = _obs_numerics.NumericsTap(interval=0, policy="record")
+            tap.bind(self.net, self.trainer)
+            self.numerics = tap
+            if self._entries:
+                _note_retrace(self.label, self._last_sig, self._last_sig,
+                              reason="numerics tap attached "
+                                     "(Monitor install)")
+                self._entries.clear()
+        return tap
 
 
 class CapturedShardedStep:

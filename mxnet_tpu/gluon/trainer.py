@@ -190,6 +190,7 @@ class Trainer:
                 with _obs_trace.span("step.allreduce"):
                     self._allreduce_grads()
             _faults.maybe_nan_grads(self._params)
+            _faults.maybe_nonfinite_grad(self._params)
             if self._sentinel is not None:
                 with _obs_trace.span("step.sentinel"):
                     healthy = self._sentinel.before_update(self)

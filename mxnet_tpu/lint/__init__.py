@@ -8,8 +8,10 @@ Three AST pass families over the whole package (docs/static_analysis.md):
 - **concurrency** (CC001-CC003): module state in threaded subsystems is
   mutated under its lock, lock acquisition order is acyclic, non-daemon
   threads are joined.
-- **registry drift** (RD001-RD003): env knobs are documented, counters
-  are declared, fault kinds are chaos-drilled.
+- **registry drift** (RD001-RD007): env knobs are documented, counters
+  are declared, fault kinds are chaos-drilled, and the observability
+  registries (metrics/spans, perf-ledger fields, alert-rule ids,
+  numerics stat columns) stay documented and exercised.
 
 Stdlib-only; never imports the code it analyzes. CLI:
 ``python tools/graftlint.py [--json]``; tier-1 gate:

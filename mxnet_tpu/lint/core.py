@@ -54,6 +54,8 @@ RULES = {
              "metric is documented",
     "RD006": "every registered alert-rule id is documented and drilled "
              "or unit-tested",
+    "RD007": "every declared numerics stat column is documented and "
+             "exercised by the numerics test suite or chaos harness",
 }
 
 _WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
@@ -145,6 +147,8 @@ class Project:
                  extra_source_files=("tests/conftest.py",),
                  alert_coverage_files=("tests/test_alerts.py",
                                        "tools/chaos_run.py"),
+                 numerics_coverage_files=("tests/test_numerics.py",
+                                          "tools/chaos_run.py"),
                  exclude_dirs=("lint",)):
         self.root = os.path.abspath(root)
         self.package_dirs = tuple(package_dirs)
@@ -154,6 +158,7 @@ class Project:
         self.chaos_files = tuple(chaos_files)
         self.extra_source_files = tuple(extra_source_files)
         self.alert_coverage_files = tuple(alert_coverage_files)
+        self.numerics_coverage_files = tuple(numerics_coverage_files)
         self.exclude_dirs = set(exclude_dirs) | {"__pycache__"}
         self._modules = None
         self._aux = {}
@@ -236,6 +241,19 @@ class Project:
         'drilled or unit-tested' evidence."""
         chunks = []
         for rel in self.alert_coverage_files:
+            path = os.path.join(self.root, rel)
+            if os.path.isfile(path):
+                with open(path, encoding="utf-8") as f:
+                    chunks.append(f.read())
+        return "\n".join(chunks)
+
+    def numerics_coverage_text(self):
+        """Concatenated raw text of the files that count as numerics
+        stat-column coverage for RD007 (the numerics test suite and the
+        chaos harness) — whole-token occurrence of a stat name there is
+        the 'exercised' evidence."""
+        chunks = []
+        for rel in self.numerics_coverage_files:
             path = os.path.join(self.root, rel)
             if os.path.isfile(path):
                 with open(path, encoding="utf-8") as f:

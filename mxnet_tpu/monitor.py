@@ -3,9 +3,21 @@
 Parity: python/mxnet/monitor.py — taps every operator output (and optionally
 weights) via the executor monitor callback
 (GraphExecutor::SetMonitorCallback, graph_executor.cc:187), batching stats
-between tic()/toc(). TPU-native note: while installed, the executor runs
-op-by-op (eager) so intermediates exist as host-visible buffers; uninstall
-to get the fused single-executable path back.
+between tic()/toc().
+
+TPU-native note: the DEFAULT tap under whole-program capture is the
+**compiled numerics tap** — ``install()`` on a
+``capture.CapturedTrainerStep`` rides the in-graph telemetry side
+output (``observability.numerics``), so the step keeps its single fused
+donated executable and the stats cost one cadence-gated on-device
+reduction pass instead of forfeiting the roofline. Row names arrive
+prefixed by kind (``act:<layer>``, ``param:<name>``, ``grad:<name>``,
+``update:<name>``) and the statistic is the reference ``asum``
+(|x| / sqrt(size), derived from the tap's L2 column). Installing on a
+plain ``Executor`` keeps the reference behavior — op-by-op eager
+execution while installed, every intermediate host-visible — and is
+now the *explicitly requested* fallback, not the default: use it only
+when you need arbitrary ``stat_func`` bodies over full tensors.
 """
 from __future__ import annotations
 
@@ -41,6 +53,7 @@ class Monitor:
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
                  emit="print"):
+        self._default_stat = stat_func is None
         if stat_func is None:
             def asum_stat(x):
                 return x.norm() / x.size ** 0.5
@@ -55,6 +68,7 @@ class Monitor:
         self.queue = []
         self.step = 0
         self.exes = []
+        self.taps = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
         self.emit = emit
@@ -72,16 +86,61 @@ class Monitor:
         self.stat_helper = stat_helper
 
     def install(self, exe, monitor_all=False):
-        """Install the tap on an executor (monitor.py install)."""
+        """Install the tap (monitor.py install).
+
+        Passing a ``capture.CapturedTrainerStep`` (anything exposing
+        ``attach_monitor``) rides the COMPILED numerics tap: the step
+        stays one fused donated executable, ``tic()`` forces the next
+        step to sample, and the tap's activation rows (plus parameter /
+        gradient / update rows with ``monitor_all=True``) land in the
+        queue as ``asum`` scalars. Requires the default ``stat_func`` —
+        the compiled tap computes fixed on-device columns, not
+        arbitrary Python over full tensors; for a custom ``stat_func``
+        install on a plain ``Executor`` (the explicit eager fallback).
+        """
+        if hasattr(exe, "attach_monitor"):
+            if not self._default_stat:
+                raise MXNetError(
+                    "Monitor(stat_func=...) cannot ride the compiled "
+                    "numerics tap (it computes fixed on-device stats); "
+                    "install on an Executor for the eager op-by-op tap, "
+                    "or drop the custom stat_func")
+            tap = exe.attach_monitor(self)
+            self.taps.append(tap)
+            tap.add_listener(self._tap_listener(monitor_all))
+            return
         exe.set_monitor_callback(
             lambda name, arr: self.stat_helper(name, arr), monitor_all)
         self.exes.append(exe)
+
+    def _tap_listener(self, monitor_all):
+        """One sampled captured step -> queue entries, mirroring the
+        executor callback: activation rows always, the rest with
+        ``monitor_all``. Values are the reference ``asum`` statistic
+        derived from the tap's L2 column — already host scalars, so no
+        extra device sync."""
+
+        def listener(step, by_tensor):
+            if not self.activated:
+                return
+            for name, rec in by_tensor.items():
+                if not monitor_all and not name.startswith("act:"):
+                    continue
+                l2 = rec.get("l2")
+                if l2 is None or not self.re_prog.match(name):
+                    continue
+                size = max(1, rec.get("size", 1))
+                self.queue.append((self.step, name, l2 / size ** 0.5))
+
+        return listener
 
     def tic(self):
         """Start collecting stats for the current batch (monitor.py tic)."""
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
+            for tap in self.taps:
+                tap.request_sample()  # the compiled tap samples this batch
             if self.emit == "metrics":
                 self._span = _obs_trace.start_span("monitor.collect",
                                                    step=self.step)
@@ -105,6 +164,15 @@ class Monitor:
         queue = sorted(self.queue, key=lambda x: x[1]) if self.sort \
             else self.queue
         for n, k, v_list in queue:
+            if isinstance(v_list, (int, float)):
+                # compiled-tap entries are already host scalars
+                value = float(v_list)
+                res.append((n, k, str(value) + "\t"))
+                if self._gauge is not None:
+                    self._gauge.set(value, name=k)
+                    _obs_flight.record("monitor", step=n, name=k,
+                                       value=value)
+                continue
             if isinstance(v_list, NDArray):
                 v_list = [v_list]
             if not isinstance(v_list, list):

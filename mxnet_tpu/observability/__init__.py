@@ -68,6 +68,10 @@ _STATS = {
     "alert_transitions": 0,          # FIRING/RESOLVED state transitions
     "alert_incidents_opened": 0,     # incidents assembled on FIRING
     "alert_incidents_resolved": 0,   # incidents closed on RESOLVED
+    "numerics_samples": 0,           # in-graph numerics samples pulled
+    "numerics_nonfinite_steps": 0,   # steps the fused finite flag failed
+    "numerics_snapshots": 0,         # numerics snapshots published
+    "numerics_halts": 0,             # halt-policy divergence raises
 }
 
 
@@ -85,6 +89,7 @@ def reset_stats():
 from . import trace  # noqa: E402
 from . import metrics  # noqa: E402
 from . import flight  # noqa: E402
+from . import numerics  # noqa: E402
 from . import perf  # noqa: E402
 from . import alerts  # noqa: E402
 from . import traceview  # noqa: E402
@@ -115,11 +120,12 @@ def dump(limit=None):
         "metrics": metrics.snapshot(),
         "series": metrics.series(),
         "perf": perf.snapshot(),
+        "numerics": numerics.snapshot_state(),
         "alerts": alerts.snapshot(),
         "incidents": alerts.incidents(),
         "counters": counters,
     }
 
 
-__all__ = ["trace", "metrics", "flight", "perf", "alerts", "traceview",
-           "dump", "stats", "reset_stats"]
+__all__ = ["trace", "metrics", "flight", "numerics", "perf", "alerts",
+           "traceview", "dump", "stats", "reset_stats"]

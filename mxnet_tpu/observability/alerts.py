@@ -105,6 +105,9 @@ ALERT_RULE_IDS = (
     "step_time_drift",        # step time outside median + k*MAD
     "perf_device_regression", # ledger device_ms/MFU off its own EWMA
     "health_skip_spike",      # sentinel skips/grad-norm trips spiking
+    "numerics_nonfinite",     # in-graph tap: non-finite gradient onset
+    "numerics_grad_explosion",# in-graph tap: grad norm off median+k*MAD
+    "numerics_dead_layer",    # in-graph tap: a layer stopped training
 )
 
 
@@ -367,8 +370,14 @@ class StepTimeDriftRule(AlertRule):
             if s["name"] not in self.STEP_ROOTS or \
                     s["t0_ns"] <= self.last_t0:
                 continue
-            out.append(inflate(s["dur_ns"]))
             high = max(high, s["t0_ns"])
+            # numerics-sampled steps pay the telemetry variant + host
+            # pull by DESIGN (observability.numerics): a configured
+            # sampling cadence is periodic and expected, not drift —
+            # they neither breach nor bank into the baseline
+            if (s.get("attrs") or {}).get("numerics_sampled"):
+                continue
+            out.append(inflate(s["dur_ns"]))
         self.last_t0 = high
         return out
 
@@ -541,6 +550,29 @@ def _probe_input_stall(ctx):
     return value, detail
 
 
+def _probe_numerics(cond_name):
+    """Threshold probe over one in-graph numerics divergence condition
+    (``observability.numerics``): the tap evaluates the detector on its
+    own sampling cadence and writes the automatic numerics snapshot at
+    activation; the rule lifts that state — evidence window, offending
+    rows, snapshot path — into a correlated Incident. ``None`` until a
+    tap has ever judged the condition (rule stays inert in untapped
+    processes)."""
+
+    def probe(ctx):
+        from . import numerics
+
+        cond = numerics.condition(cond_name)
+        if cond is None:
+            return None, None
+        detail = {"since_step": cond.get("since_step"),
+                  "snapshot": cond.get("snapshot")}
+        detail.update(cond.get("evidence") or {})
+        return (1 if cond.get("active") else 0), detail
+
+    return probe
+
+
 def _default_rules():
     floor = _env_float("MXNET_TPU_ALERT_HEALTHY_FLOOR", 1.0)
     stall_max = _env_float("MXNET_TPU_ALERT_STALL_MAX", 0.5)
@@ -583,6 +615,25 @@ def _default_rules():
             ("health_skipped_steps", "sentinel_grad_norm_trips"),
             description="HealthSentinel skips / grad-norm trips spiking "
                         "inside one fast window"),
+        ThresholdRule(
+            "numerics_nonfinite", _probe_numerics("nonfinite"), ">=", 1,
+            span_names=("train.captured_step",),
+            description="the captured step's in-graph numerics tap saw "
+                        "a non-finite gradient (NaN/Inf onset); a "
+                        "numerics snapshot was published for "
+                        "tools/numerics_bisect.py"),
+        ThresholdRule(
+            "numerics_grad_explosion", _probe_numerics("grad_explosion"),
+            ">=", 1, span_names=("train.captured_step",),
+            description="the global gradient norm exploded outside "
+                        "median + k*MAD of its own clean history "
+                        "(in-graph numerics tap)"),
+        ThresholdRule(
+            "numerics_dead_layer", _probe_numerics("dead_layer"), ">=", 1,
+            span_names=("train.captured_step",),
+            description="a layer's gradients stayed ~0 or fully "
+                        "fp16-underflowed for N consecutive samples "
+                        "while the rest of the net kept training"),
     )
 
 

@@ -23,6 +23,8 @@ schema):
 ``monitor``    a Monitor tensor-stat emission (mxnet_tpu.monitor)
 ``perf``       a perf-gate regression (tools/perf_gate.py)
 ``alert``      an alert rule transitioned FIRING/RESOLVED (alerts)
+``numerics``   an in-graph numerics sample / divergence-condition flip
+               / snapshot publish (observability.numerics)
 
 The ring is sized by ``MXNET_TPU_OBS_FLIGHT_RING`` (default 1024 events,
 ``0`` disables; resize at runtime with :func:`set_ring`). Watchdog crash

@@ -1,0 +1,810 @@
+"""In-graph numerics telemetry for the captured training step.
+
+The PR-7 whole-program capture made the step a single donated XLA
+executable that the observability plane cannot see inside, and the
+parity ``Monitor`` forfeits the fused path by forcing op-by-op eager
+execution while installed. This module compiles the telemetry *into*
+the program instead (the MXNet monitor/executor-callback design and
+the TensorFlow production-debuggability argument, PAPERS.md):
+
+- **NumericsTap** — attached to a ``capture.CapturedTrainerStep``, it
+  plans one statistics row per tapped tensor (per-parameter gradient /
+  weight / optimizer update, per-layer activation) and the captured
+  program computes the whole ``(rows, stats)`` float32 matrix
+  **on-device** as one extra side output. The sampling cadence and the
+  stat selection are **runtime operands** (a gate scalar driving a
+  ``lax.cond`` and a column mask), so changing the interval or the
+  selected stats at runtime never retraces, and off-cadence steps skip
+  the stat reductions entirely.
+- **Stat columns** (``NUMERICS_STATS``; graftlint RD007 keeps them
+  documented and exercised): ``l2`` (L2 norm), ``maxabs`` (max |x|),
+  ``nonfinite`` (NaN/Inf element count), ``underflow`` (fraction of
+  nonzero elements that flush to zero in fp16 — the AMP loss-scaling
+  regime; bf16 shares fp32's exponent range, so a bf16 underflow at
+  fp32 master precision is an fp32 subnormal XLA's FTZ already
+  zeroed), ``ratio`` (update-to-param norm ratio; update rows only).
+- **Emission** — each sampled step lands in the typed metrics registry
+  (``mxnet_tpu_numerics_stat`` by tensor/stat,
+  ``mxnet_tpu_numerics_grad_norm``), the flight recorder (kind
+  ``numerics``), and a bounded history ring.
+- **Divergence conditions** — the tap evaluates three detectors:
+  ``nonfinite`` (onset of a non-finite gradient — judged from the
+  program's fused all-finite flag EVERY step under the gating
+  ``halt``/``skip`` policies, and from the sampled matrix's nonfinite
+  column under ``record``), ``grad_explosion`` (global grad norm outside
+  median + k*1.4826*MAD of its own clean history), and ``dead_layer``
+  (a layer whose gradient stays ~0 / fully fp16-underflowed for N
+  consecutive samples while the rest of the net trains). A condition
+  turning active writes an automatic **numerics snapshot** (offending
+  tensors + optimizer state + the batch, via the checkpoint
+  machinery's atomic-write discipline) that
+  ``tools/numerics_bisect.py`` replays eagerly to name the first bad
+  layer, and surfaces through the ``numerics_*`` alert rules
+  (``observability.alerts``) as a correlated Incident.
+- **Policy** — ``MXNET_TPU_NONFINITE_POLICY``: ``halt`` raises
+  :class:`NumericsDivergenceError` at onset, ``skip`` lets the
+  in-program select gate the weight write (the batch never touches the
+  weights), ``record`` observes only (bitwise-transparent).
+
+Env knobs (docs/env_vars.md): ``MXNET_TPU_NUMERICS``,
+``MXNET_TPU_NUMERICS_INTERVAL``, ``MXNET_TPU_NUMERICS_STATS``,
+``MXNET_TPU_NUMERICS_SNAPSHOT_DIR``, ``MXNET_TPU_NUMERICS_SNAPSHOT_KEEP``,
+``MXNET_TPU_NONFINITE_POLICY``. Stdlib-only at import (numpy/jax load
+lazily inside the capture/emission paths).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from collections import deque
+
+from . import _STATS
+from . import flight as _flight
+from . import metrics as _metrics
+from . import trace as _trace
+
+__all__ = ["NumericsTap", "NumericsDivergenceError", "NUMERICS_STATS",
+           "NUMERICS_CONDITIONS", "POLICIES", "default_tap", "condition",
+           "conditions", "history", "last_snapshot", "snapshots",
+           "snapshot_state", "load_snapshot", "reset"]
+
+# THE stat-column registry (graftlint RD007: every token must be
+# documented under docs/ and exercised by tests/test_numerics.py or the
+# chaos harness). Column order is the on-device matrix layout.
+NUMERICS_STATS = ("l2", "maxabs", "nonfinite", "underflow", "ratio")
+
+# Divergence detectors the tap evaluates; each maps 1:1 onto a
+# ``numerics_<name>`` alert rule in observability/alerts.py.
+NUMERICS_CONDITIONS = ("nonfinite", "grad_explosion", "dead_layer")
+
+POLICIES = ("halt", "skip", "record")
+
+_LOCK = threading.Lock()
+
+# Module-level view the alert rules probe (sys-modules-free: alerts
+# lives in the same package). Conditions reflect the most recent tap's
+# detector state; history is the sampled time series.
+_CONDITIONS: dict = {}
+_HISTORY: deque = deque(maxlen=512)
+_SNAPSHOTS: list = []
+_LAST_SAMPLE = None
+
+_GAUGE = _metrics.gauge(
+    "mxnet_tpu_numerics_stat",
+    "latest in-graph numerics statistic, by tapped tensor and stat",
+    labels=("tensor", "stat"))
+_GAUGE_GRAD_NORM = _metrics.gauge(
+    "mxnet_tpu_numerics_grad_norm",
+    "global gradient L2 norm from the captured step's in-graph tap")
+
+
+class NumericsDivergenceError(ArithmeticError):
+    """Training numerics diverged (non-finite gradients) under the
+    ``halt`` policy of the in-graph numerics tap."""
+
+
+def _env_policy():
+    p = os.environ.get("MXNET_TPU_NONFINITE_POLICY", "halt").strip() \
+        or "halt"
+    if p not in POLICIES:
+        raise ValueError(
+            f"MXNET_TPU_NONFINITE_POLICY must be one of {POLICIES}, "
+            f"got {p!r}")
+    return p
+
+
+def default_tap():
+    """The tap ``capture.CapturedTrainerStep`` arms when the operator
+    sets ``MXNET_TPU_NUMERICS`` (truthy); None otherwise, which keeps
+    the captured program bit-identical to the pre-telemetry build."""
+    if os.environ.get("MXNET_TPU_NUMERICS", "").strip().lower() in (
+            "", "0", "false", "off", "no"):
+        return None
+    return NumericsTap()
+
+
+def condition(name):
+    """The detector state the ``numerics_<name>`` alert rule probes:
+    ``{"active", "since_step", "evidence", "snapshot"}`` — or None when
+    no tap has ever judged this condition (rule stays inert)."""
+    with _LOCK:
+        c = _CONDITIONS.get(name)
+        return dict(c) if c is not None else None
+
+
+def conditions():
+    with _LOCK:
+        return {k: dict(v) for k, v in _CONDITIONS.items()}
+
+
+def history():
+    """Sampled numerics observations, oldest first: ``{"t", "step",
+    "grad_norm", "grads": {tensor: l2}, "nonfinite_rows": [...]}``."""
+    with _LOCK:
+        return [dict(h) for h in _HISTORY]
+
+
+def last_snapshot():
+    with _LOCK:
+        return _SNAPSHOTS[-1] if _SNAPSHOTS else None
+
+
+def snapshots():
+    with _LOCK:
+        return list(_SNAPSHOTS)
+
+
+def snapshot_state():
+    """The ``observability.dump()["numerics"]`` section."""
+    with _LOCK:
+        last = dict(_LAST_SAMPLE) if _LAST_SAMPLE else None
+    return {"stats": list(NUMERICS_STATS),
+            "conditions": conditions(),
+            "last_sample": last,
+            "history_len": len(_HISTORY),
+            "snapshots": snapshots()}
+
+
+def reset():
+    """Clear conditions, history and snapshot bookkeeping (tests and
+    drills call this between cases; on-disk snapshots are not
+    deleted)."""
+    global _LAST_SAMPLE
+    with _LOCK:
+        _CONDITIONS.clear()
+        _HISTORY.clear()
+        del _SNAPSHOTS[:]
+        _LAST_SAMPLE = None
+
+
+def _set_condition(name, active, evidence=None, step=None, snapshot=None):
+    """Transition one detector; records a flight event on every flip so
+    the incident's evidence window shows exactly when numerics went bad
+    (and came back)."""
+    with _LOCK:
+        cur = _CONDITIONS.get(name)
+        was = bool(cur and cur["active"])
+        if cur is None:
+            cur = _CONDITIONS[name] = {
+                "active": False, "since_step": None, "evidence": None,
+                "snapshot": None}
+        cur["active"] = bool(active)
+        if active:
+            if not was:
+                cur["since_step"] = step
+            cur["evidence"] = evidence or {}
+            if snapshot is not None:
+                cur["snapshot"] = snapshot
+    if bool(active) != was:
+        _flight.record("numerics", op="condition", condition=name,
+                       active=bool(active), step=step)
+    return bool(active) != was
+
+
+# ------------------------------------------------------------------ the tap
+
+class NumericsTap:
+    """Per-layer/per-param numerics telemetry compiled into a captured
+    training step.
+
+    Parameters
+    ----------
+    interval : int — sample every Nth step (``MXNET_TPU_NUMERICS_INTERVAL``,
+        default 10; ``0`` disables sampling — the side output stays in
+        the program, zero-filled, so flipping sampling back on never
+        retraces). Change at runtime with :meth:`set_interval`.
+    stats : iterable of ``NUMERICS_STATS`` names — the selected columns
+        (``MXNET_TPU_NUMERICS_STATS`` comma list, default all).
+        Unselected columns are zeroed by the in-program mask operand;
+        change at runtime with :meth:`set_stats` — never a retrace.
+    policy : ``halt`` | ``skip`` | ``record`` — what a non-finite
+        gradient does (``MXNET_TPU_NONFINITE_POLICY``, default
+        ``halt``). ``halt``/``skip`` gate the weight write in-program
+        (so the bad batch never lands) and then raise / skip on the
+        host; ``record`` is observation-only and keeps the program
+        bitwise-transparent even on bad batches. Baked into the program
+        (changing it recaptures).
+    snapshot_dir : where divergence snapshots publish
+        (``MXNET_TPU_NUMERICS_SNAPSHOT_DIR``; default
+        ``<tempdir>/mxnet_tpu_numerics``).
+    """
+
+    def __init__(self, interval=None, stats=None, policy=None,
+                 snapshot_dir=None, history_n=128, mad_k=None,
+                 explosion_min_n=8, dead_eps=1e-12, dead_n=8):
+        if interval is None:
+            try:
+                interval = int(os.environ.get(
+                    "MXNET_TPU_NUMERICS_INTERVAL", "10"))
+            except ValueError:
+                interval = 10
+        if stats is None:
+            raw = os.environ.get("MXNET_TPU_NUMERICS_STATS", "").strip()
+            stats = tuple(s.strip() for s in raw.split(",") if s.strip()) \
+                if raw else NUMERICS_STATS
+        unknown = sorted(set(stats) - set(NUMERICS_STATS))
+        if unknown:
+            raise ValueError(
+                f"unknown numerics stats {unknown}; pick from "
+                f"{NUMERICS_STATS}")
+        self.policy = _env_policy() if policy is None else policy
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"policy must be one of {POLICIES}, got {self.policy!r}")
+        self.snapshot_dir = snapshot_dir
+        self._interval = max(0, int(interval))
+        self._selected = tuple(s for s in NUMERICS_STATS if s in set(stats))
+        self._step = 0
+        self._force_next = False
+        self._sel_cache = None
+        self._listeners = []
+        # capture-build state
+        self.rows = ()          # ((name, size), ...) fixed at build
+        self._net = None
+        self._trainer = None
+        self._last_batch = None
+        # detector state
+        self._mad_k = float(os.environ.get("MXNET_TPU_NUMERICS_MAD_K",
+                                           "8") if mad_k is None else mad_k)
+        self._explosion_min_n = int(explosion_min_n)
+        self._norm_hist = deque(maxlen=int(history_n))
+        self._dead_eps = float(dead_eps)
+        self._dead_n = int(dead_n)
+        self._dead_counts = {}
+        self._clean_steps = 0
+        self._nonfinite_steps = 0
+
+    # ------------------------------------------------------ runtime knobs
+    @property
+    def interval(self):
+        return self._interval
+
+    def set_interval(self, n):
+        """Change the sampling cadence at runtime — a pure operand
+        change, never a retrace (tested by the compile-count probe)."""
+        self._interval = max(0, int(n))
+        return self
+
+    @property
+    def selected(self):
+        return self._selected
+
+    def set_stats(self, stats):
+        """Change the selected stat columns at runtime — the in-program
+        column mask is an operand, never a retrace."""
+        unknown = sorted(set(stats) - set(NUMERICS_STATS))
+        if unknown:
+            raise ValueError(
+                f"unknown numerics stats {unknown}; pick from "
+                f"{NUMERICS_STATS}")
+        self._selected = tuple(s for s in NUMERICS_STATS
+                               if s in set(stats))
+        self._sel_cache = None
+        return self
+
+    def request_sample(self):
+        """Force the NEXT step to sample regardless of cadence (the
+        compiled-tap ``Monitor`` calls this from ``tic()``)."""
+        self._force_next = True
+        return self
+
+    def add_listener(self, fn):
+        """``fn(step, stats_by_tensor)`` called on every sampled step
+        (``stats_by_tensor``: ``{name: {"size": n, <stat>: value}}``)."""
+        self._listeners.append(fn)
+        return fn
+
+    def sel_values(self):
+        """The column-mask operand for the selected stats (cached: the
+        steady-state step builds no per-step numpy garbage)."""
+        cached = self._sel_cache
+        if cached is None:
+            import numpy as np
+
+            cached = self._sel_cache = np.asarray(
+                [1.0 if s in self._selected else 0.0
+                 for s in NUMERICS_STATS], np.float32)
+        return cached
+
+    def tick(self):
+        """Advance the tap's step counter; True when this step samples
+        (cadence hit or a forced sample)."""
+        step = self._step
+        self._step += 1
+        sampled = self._force_next or (
+            self._interval > 0 and step % self._interval == 0)
+        self._force_next = False
+        return sampled
+
+    @property
+    def gates_updates(self):
+        """Whether the captured program's weight-write select also gates
+        on the fused finite flag for this tap (``halt``/``skip``): a
+        non-finite batch never touches the weights. ``record`` keeps
+        the program bitwise-transparent."""
+        return self.policy in ("halt", "skip")
+
+    # -------------------------------------------------------- capture-side
+    def bind(self, net, trainer):
+        self._net = net
+        self._trainer = trainer
+        return self
+
+    def plan_signature(self):
+        """The tap's contribution to the capture fingerprint: row plan +
+        column schema + gating semantics (a changed plan or policy is a
+        different program; interval/selection are operands and do NOT
+        appear here)."""
+        return {"rows": tuple(n for n, _ in self.rows),
+                "stats": NUMERICS_STATS,
+                "gates": self.gates_updates}
+
+    def install_hooks(self, net):
+        """Register transient forward hooks on every leaf block; returns
+        ``(handles, acts)`` where ``acts`` fills with
+        ``(name, raw_jax_value)`` in forward call order. The caller
+        removes the handles right after the forward (the hooks must not
+        leak into later eager use of the net)."""
+        handles = []
+        acts = []
+        counts = {}
+
+        def make_hook(name):
+            def hook(block, inputs, out):
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                k = counts.get(name, 0)
+                counts[name] = k + 1
+                for i, o in enumerate(outs):
+                    data = getattr(o, "data_", None)
+                    if data is None:
+                        continue
+                    tag = name if k == 0 else f"{name}#{k}"
+                    if len(outs) > 1:
+                        tag = f"{tag}:{i}"
+                    acts.append((tag, data))
+            return hook
+
+        def register(blk):
+            if not blk._children:
+                handles.append(
+                    blk.register_forward_hook(make_hook(blk.name)))
+                return
+            for child in blk._children.values():
+                register(child)
+
+        register(net)
+        return handles, acts
+
+    @staticmethod
+    def remove_hooks(handles):
+        for h in handles:
+            h.detach()
+
+    def tapped_params(self, trainer):
+        return [p for p in trainer._params if p.grad_req != "null"]
+
+    def graph_stats(self, grads, params_pre, params_post, acts, sel_t):
+        """Build the on-device ``(rows, len(NUMERICS_STATS))`` float32
+        stats matrix from the traced step's tensors — the side output
+        of the SAMPLED-step program variant (off-cadence steps run the
+        base variant, which contains none of this). ``sel_t`` is the
+        column-mask operand (stat selection changes re-bind the mask,
+        never retrace). Also records ``self.rows`` (name, size) — the
+        fixed row plan the emission path decodes by."""
+        import jax.numpy as jnp
+
+        # (row-name, kind, payload) — payloads are the RAW traced
+        # tensors; derived tensors (updates) materialize inside compute
+        named = [(f"grad:{name}", "plain", g) for name, g in grads]
+        named += [(f"param:{name}", "plain", p) for name, p in params_pre]
+        named += [(f"update:{name}", "update", (post, pre))
+                  for (name, pre), (_, post) in zip(params_pre,
+                                                    params_post)]
+        named += [(f"act:{name}", "plain", a) for name, a in acts]
+
+        def size_of(kind, x):
+            return int(getattr(x[0] if kind == "update" else x,
+                               "size", 1))
+
+        self.rows = tuple((name, size_of(kind, x))
+                          for name, kind, x in named)
+        n_rows = len(named)
+        n_cols = len(NUMERICS_STATS)
+        if n_rows == 0:
+            return jnp.zeros((0, n_cols), jnp.float32)
+
+        def one_row(x, den):
+            v = jnp.asarray(x).astype(jnp.float32).ravel()
+            l2 = jnp.sqrt(jnp.sum(v * v))
+            maxabs = jnp.max(jnp.abs(v))
+            nonfinite = jnp.sum(
+                (~jnp.isfinite(v)).astype(jnp.float32))
+            # fraction of NONZERO elements flushing to zero in fp16 —
+            # the low-precision regime the AMP LossScaler guards (bf16
+            # shares fp32's exponent range, so a "bf16 underflow" at
+            # fp32 master precision is already an fp32 subnormal that
+            # XLA's FTZ zeroes before any comparison could see it).
+            # Nonzero denominator: a ReLU gradient that is 40% exact
+            # zeros and otherwise fully sub-fp16 must read 1.0, or the
+            # dead-layer detector's >=0.99 bar could never fire
+            nonzero = jnp.sum((v != 0.0).astype(jnp.float32))
+            under = jnp.sum(jnp.logical_and(
+                v != 0.0,
+                v.astype(jnp.float16) == 0.0).astype(jnp.float32)) \
+                / jnp.maximum(nonzero, 1.0)
+            if den is None:
+                ratio = jnp.float32(0.0)
+            else:
+                d = jnp.asarray(den).astype(jnp.float32).ravel()
+                ratio = l2 / (jnp.sqrt(jnp.sum(d * d)) + 1e-12)
+            return jnp.stack([l2, maxabs, nonfinite, under, ratio])
+
+        rows = []
+        for _name, kind, x in named:
+            if kind == "update":
+                post, pre = x
+                rows.append(one_row(
+                    jnp.asarray(post) - jnp.asarray(pre), pre))
+            else:
+                rows.append(one_row(x, None))
+        return jnp.stack(rows) * jnp.asarray(sel_t, jnp.float32)[None, :]
+
+    # --------------------------------------------------------- host-side
+    def on_step(self, step, finite_ok, stats_np, batch=None):
+        """Per-step host hook from the captured call: ``finite_ok`` is
+        the program's fused all-finite flag (every step), ``stats_np``
+        the pulled stats matrix on sampled steps (None otherwise).
+        Updates metrics/flight/history, evaluates the divergence
+        conditions, and applies the non-finite policy."""
+        if batch is not None:
+            self._last_batch = batch
+        sample = None
+        if stats_np is not None:
+            with _trace.span("numerics.sample", step=step):
+                sample = self._emit(step, stats_np)
+        if finite_ok is None and sample is not None \
+                and "nonfinite" in self._selected:
+            # record-policy programs carry no per-step finite flag: the
+            # sampled matrix's nonfinite column is the finite signal
+            finite_ok = not sample["nonfinite_rows"]
+        if finite_ok is not None:
+            self._judge_nonfinite(step, finite_ok, sample)
+        if sample is not None and (finite_ok is None or finite_ok):
+            self._judge_explosion(step, sample)
+            self._judge_dead_layers(step, sample)
+
+    # emission ----------------------------------------------------------
+    def _emit(self, step, stats_np):
+        global _LAST_SAMPLE
+        import numpy as np
+
+        _STATS["numerics_samples"] += 1
+        mat = np.asarray(stats_np, np.float64)
+        by_tensor = {}
+        grads = {}
+        under = {}
+        nonfinite_rows = []
+        grad_sq = 0.0
+        sel = set(self._selected)
+        for i, (name, size) in enumerate(self.rows):
+            if i >= mat.shape[0]:
+                break
+            rec = {"size": size}
+            for j, stat in enumerate(NUMERICS_STATS):
+                if stat not in sel:
+                    continue
+                val = float(mat[i, j])
+                rec[stat] = val
+                self._gauge_set(name, stat, val)
+            by_tensor[name] = rec
+            l2 = rec.get("l2")
+            if name.startswith("grad:"):
+                if l2 is not None:
+                    grads[name[5:]] = l2
+                    if np.isfinite(l2):
+                        grad_sq += l2 * l2
+                if "underflow" in rec:
+                    under[name[5:]] = rec["underflow"]
+            nf = rec.get("nonfinite")
+            if nf:
+                nonfinite_rows.append(name)
+        grad_norm = float(np.sqrt(grad_sq)) if "l2" in sel else None
+        if grad_norm is not None:
+            _GAUGE_GRAD_NORM.set(grad_norm)
+        sample = {"t": time.time(), "step": step, "grad_norm": grad_norm,
+                  "grads": grads, "underflow": under,
+                  "nonfinite_rows": nonfinite_rows,
+                  # full per-tensor stats: what a numerics snapshot
+                  # records as the CAPTURED run's reference values for
+                  # tools/numerics_bisect.py's eager-replay comparison
+                  "tensors": by_tensor}
+        with _LOCK:
+            _HISTORY.append(sample)
+            _LAST_SAMPLE = sample
+        _flight.record("numerics", op="sample", step=step,
+                       grad_norm=grad_norm,
+                       nonfinite_rows=len(nonfinite_rows))
+        for fn in self._listeners:
+            try:
+                fn(step, by_tensor)
+            except Exception:
+                pass  # a broken listener must never fail the step
+        return sample
+
+    def _gauge_set(self, tensor, stat, value):
+        _GAUGE.set(value, tensor=tensor, stat=stat)
+
+    # detectors ---------------------------------------------------------
+    def _judge_nonfinite(self, step, finite_ok, sample):
+        if finite_ok:
+            self._clean_steps += 1
+            # a few consecutive clean steps = the divergence is over
+            if self._nonfinite_steps and self._clean_steps >= 4:
+                self._nonfinite_steps = 0
+                _set_condition("nonfinite", False, step=step)
+            return
+        self._clean_steps = 0
+        self._nonfinite_steps += 1
+        _STATS["numerics_nonfinite_steps"] += 1
+        evidence = {"nonfinite_steps": self._nonfinite_steps,
+                    "policy": self.policy}
+        if sample is not None and sample["nonfinite_rows"]:
+            evidence["nonfinite_rows"] = sample["nonfinite_rows"]
+            evidence["first_nonfinite"] = sample["nonfinite_rows"][0]
+            # forward-order activation onset names the offending LAYER
+            # (a NaN source poisons every gradient via backward, but
+            # only the layers downstream of it in the forward)
+            for name in sample["nonfinite_rows"]:
+                if name.startswith("act:"):
+                    evidence["first_nonfinite_act"] = name
+                    break
+        flipped = _set_condition("nonfinite", True, evidence=evidence,
+                                 step=step)
+        if flipped:
+            path = self.write_snapshot("nonfinite", step=step,
+                                       stats=sample)
+            if path is not None:
+                _set_condition("nonfinite", True, evidence=evidence,
+                               step=step, snapshot=path)
+        if self.policy == "halt":
+            _STATS["numerics_halts"] += 1
+            raise NumericsDivergenceError(
+                f"non-finite gradient at captured step {step} "
+                f"(policy=halt; snapshot: {last_snapshot()})")
+
+    def _judge_explosion(self, step, sample):
+        norm = sample.get("grad_norm")
+        if norm is None or not _finite(norm):
+            return
+        hist = self._norm_hist
+        if len(hist) >= self._explosion_min_n:
+            med = _median(hist)
+            mad = _median([abs(v - med) for v in hist])
+            sigma = 1.4826 * mad
+            # spread floor (5% of median) + a hard 4x floor: only a
+            # multiple-of-itself explosion can page, never CI jitter
+            limit = max(med + self._mad_k * max(sigma, 0.05 * med),
+                        4.0 * med)
+            if med > 0 and norm > limit:
+                evidence = {"grad_norm": norm, "limit": limit,
+                            "median": med, "mad": mad, "k": self._mad_k,
+                            "n": len(hist), "step": step}
+                flipped = _set_condition("grad_explosion", True,
+                                         evidence=evidence, step=step)
+                if flipped:
+                    path = self.write_snapshot("grad_explosion",
+                                               step=step, stats=sample)
+                    if path is not None:
+                        _set_condition("grad_explosion", True,
+                                       evidence=evidence, step=step,
+                                       snapshot=path)
+                return  # outliers stay out of their own baseline
+        hist.append(norm)
+        _set_condition("grad_explosion", False, step=step)
+
+    def _judge_dead_layers(self, step, sample):
+        grads = sample.get("grads") or {}
+        under = sample.get("underflow") or {}
+        norm = sample.get("grad_norm")
+        if not grads:
+            return
+        dead = []
+        for name, l2 in grads.items():
+            is_dead = l2 <= self._dead_eps \
+                or under.get(name, 0.0) >= 0.99
+            n = self._dead_counts.get(name, 0) + 1 if is_dead else 0
+            self._dead_counts[name] = n
+            if n >= self._dead_n:
+                dead.append(name)
+        # a globally-dead net (norm ~0) is "training finished/broken",
+        # not one dead layer among live ones
+        if dead and norm is not None and norm > self._dead_eps \
+                and len(dead) < len(grads):
+            evidence = {"dead_layers": sorted(dead),
+                        "samples": self._dead_n, "step": step}
+            flipped = _set_condition("dead_layer", True,
+                                     evidence=evidence, step=step)
+            if flipped:
+                path = self.write_snapshot("dead_layer", step=step,
+                                           stats=sample)
+                if path is not None:
+                    _set_condition("dead_layer", True, evidence=evidence,
+                                   step=step, snapshot=path)
+        else:
+            _set_condition("dead_layer", False, step=step)
+
+    # snapshots ---------------------------------------------------------
+    def _snapshot_root(self):
+        d = self.snapshot_dir \
+            or os.environ.get("MXNET_TPU_NUMERICS_SNAPSHOT_DIR", "").strip()
+        if not d:
+            import tempfile
+
+            d = os.path.join(tempfile.gettempdir(), "mxnet_tpu_numerics")
+        return d
+
+    def write_snapshot(self, reason, step=None, stats=None):
+        """Publish one numerics snapshot — the forensic bundle
+        ``tools/numerics_bisect.py`` replays: the batch, every
+        parameter, the optimizer state (``Trainer.get_states_bytes``)
+        and the tap's row stats — through the checkpoint machinery's
+        atomic-write discipline (fsynced files in a temp dir, one final
+        rename). Returns the published path, or None when the tap has
+        no bound net/trainer. Never raises: a full disk must not take
+        the training step down with it."""
+        if self._net is None or self._trainer is None:
+            return None
+        try:
+            return self._write_snapshot_impl(reason, step, stats)
+        except Exception:
+            return None
+
+    def _write_snapshot_impl(self, reason, step, stats):
+        import io as _io
+
+        import numpy as np
+
+        from ..resilience.checkpoint import atomic_write_bytes
+
+        root = self._snapshot_root()
+        os.makedirs(root, exist_ok=True)
+        tag = f"numerics-{step if step is not None else self._step:08d}" \
+              f"-{int(time.time() * 1000) % 100000:05d}"
+        final = os.path.join(root, tag)
+        tmp = os.path.join(root, f".tmp-{tag}-{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+
+        params = {name: nd.asnumpy()
+                  for name, nd in
+                  self._net._collect_params_with_prefix().items()}
+        buf = _io.BytesIO()
+        np.savez(buf, **params)
+        atomic_write_bytes(os.path.join(tmp, "params.npz"),
+                           buf.getvalue())
+        batch_files = None
+        if self._last_batch is not None:
+            x_nd, y_nd = self._last_batch
+            buf = _io.BytesIO()
+            np.savez(buf, x=np.asarray(x_nd.asnumpy()),
+                     y=np.asarray(y_nd.asnumpy()))
+            atomic_write_bytes(os.path.join(tmp, "batch.npz"),
+                               buf.getvalue())
+            batch_files = "batch.npz"
+        atomic_write_bytes(os.path.join(tmp, "trainer.state"),
+                           self._trainer.get_states_bytes())
+        manifest = {
+            "schema": 1,
+            "reason": reason,
+            "step": step,
+            "t": time.time(),
+            "policy": self.policy,
+            "stats_schema": list(NUMERICS_STATS),
+            "selected": list(self._selected),
+            "rows": [[n, s] for n, s in self.rows],
+            "sample": stats,
+            "params": "params.npz",
+            "batch": batch_files,
+            "trainer_state": "trainer.state",
+            "param_names": sorted(params),
+        }
+        atomic_write_bytes(
+            os.path.join(tmp, "manifest.json"),
+            json.dumps(manifest, sort_keys=True, default=str).encode())
+        os.replace(tmp, final)
+        _STATS["numerics_snapshots"] += 1
+        _flight.record("numerics", op="snapshot", reason=reason,
+                       step=step, path=final)
+        with _LOCK:
+            _SNAPSHOTS.append(final)
+            del _SNAPSHOTS[:-16]
+        self._prune(root)
+        return final
+
+    @staticmethod
+    def _prune(root):
+        try:
+            keep = int(os.environ.get(
+                "MXNET_TPU_NUMERICS_SNAPSHOT_KEEP", "4"))
+        except ValueError:
+            keep = 4
+        if keep <= 0:
+            return
+        try:
+            entries = []
+            for name in os.listdir(root):
+                if not name.startswith("numerics-"):
+                    continue
+                path = os.path.join(root, name)
+                try:
+                    entries.append((os.path.getmtime(path), path))
+                except OSError:
+                    continue
+        except OSError:
+            return
+        import shutil
+
+        # mtime order, NOT name order: the tag leads with the step
+        # number, so after a restart a new run's step-5 snapshot would
+        # sort before an old run's step-400 ones and be pruned first —
+        # deleting exactly the forensic bundle the fresh incident's
+        # evidence points at
+        entries.sort()
+        for _, path in entries[:-keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+def load_snapshot(path):
+    """Read one published numerics snapshot back:
+    ``{"manifest", "params": {name: np}, "batch": (x, y) | None,
+    "trainer_state": bytes}`` (the bisect tool's input)."""
+    import numpy as np
+
+    with open(os.path.join(path, "manifest.json"), encoding="utf-8") as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(path, manifest["params"])) as z:
+        params = {k: z[k].copy() for k in z.files}
+    batch = None
+    if manifest.get("batch"):
+        with np.load(os.path.join(path, manifest["batch"])) as z:
+            batch = (z["x"].copy(), z["y"].copy())
+    state = None
+    st = manifest.get("trainer_state")
+    if st and os.path.isfile(os.path.join(path, st)):
+        with open(os.path.join(path, st), "rb") as f:
+            state = f.read()
+    return {"manifest": manifest, "params": params, "batch": batch,
+            "trainer_state": state}
+
+
+def _median(values):
+    vals = sorted(values)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def _finite(v):
+    return v == v and v not in (float("inf"), float("-inf"))

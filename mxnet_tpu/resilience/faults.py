@@ -11,6 +11,22 @@ Supported fault kinds (the hook that honours each is noted):
 
 - ``nan_grad``                  — poison one parameter gradient with NaN
                                   (gluon ``Trainer.step``/``update``)
+- ``nonfinite_grad``            — poison ONE targeted layer's numerics
+                                  with NaN (layer from
+                                  ``MXNET_TPU_FAULT_NONFINITE_LAYER``,
+                                  default: the middle parameter): the
+                                  eager hook poisons that layer's
+                                  gradient; the captured-step hook
+                                  poisons its weight instead (a compiled
+                                  program cannot be poisoned from the
+                                  outside per-step), so the NaN flows
+                                  through the real fwd/bwd into the
+                                  in-graph numerics tap, which must FIRE
+                                  the divergence alert, publish a
+                                  numerics snapshot that
+                                  ``tools/numerics_bisect.py`` localizes
+                                  to the poisoned layer, and halt-or-skip
+                                  per ``MXNET_TPU_NONFINITE_POLICY``
 - ``ckpt_enospc``               — checkpoint byte-write raises ENOSPC
                                   (``resilience.checkpoint.atomic_write_bytes``)
 - ``ckpt_partial_write``        — checkpoint byte-write silently truncates
@@ -125,6 +141,7 @@ from ..observability import flight as _obs_flight
 __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "inject", "arm", "disarm", "reset", "active", "get", "stats",
            "reset_stats", "maybe_nan_grads", "checkpoint_write_filter",
+           "maybe_nonfinite_grad",
            "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
            "maybe_hang", "maybe_oom_step", "maybe_peer_death",
            "maybe_replica_crash", "maybe_replica_hang",
@@ -282,6 +299,49 @@ def maybe_nan_grads(params):
         g._set_data((g * float("nan"))._data)
         return True
     return False
+
+
+def maybe_nonfinite_grad(params, where="grad"):
+    """Poison ONE targeted layer's numerics with NaN (kind
+    ``nonfinite_grad``). The victim parameter is named by
+    ``MXNET_TPU_FAULT_NONFINITE_LAYER`` (substring of the parameter
+    name), defaulting to the middle trainable parameter so the drill's
+    first-bad-layer answer is non-trivial. ``where="grad"`` (the eager
+    Trainer hook) poisons the gradient directly; ``where="param"`` (the
+    captured-step hook) poisons the weight, so the NaN flows through
+    the real compiled forward/backward into the per-layer tap rows —
+    same detection surface, no injection shortcut. Returns the poisoned
+    parameter's name, or None when the fault did not fire."""
+    if not _ACTIVE:
+        return None
+    fault = _ACTIVE.get("nonfinite_grad")
+    if fault is None:
+        return None
+    cands = [p for p in params
+             if getattr(p, "grad_req", "write") != "null"]
+    if not cands:
+        return None
+    # resolve the victim BEFORE consuming the fire window: a bad layer
+    # spec must fail the drill loudly, not silently burn the injection
+    want = os.environ.get("MXNET_TPU_FAULT_NONFINITE_LAYER", "").strip()
+    target = None
+    if want:
+        for p in cands:
+            if want in p.name:
+                target = p
+                break
+        if target is None:
+            raise FaultInjected(
+                f"nonfinite_grad armed but no parameter matches "
+                f"MXNET_TPU_FAULT_NONFINITE_LAYER={want!r} "
+                f"(params: {[p.name for p in cands]})")
+    else:
+        target = cands[len(cands) // 2]
+    if not fault.should_fire():
+        return None
+    victim = target.data() if where == "param" else target.grad()
+    victim._set_data((victim * float("nan"))._data)
+    return target.name
 
 
 def checkpoint_write_filter(path, data):

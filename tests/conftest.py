@@ -87,3 +87,10 @@ def pytest_configure(config):
         "deterministic mid-epoch resume (mxnet_tpu/io/stream.py, "
         "docs/data.md); fast cases run in tier-1, the dp=8 input-stall "
         "bench gate carries the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "numerics: in-graph numerics telemetry inside the captured "
+        "step — divergence sentinels, snapshots, first-bad-layer "
+        "bisection (mxnet_tpu/observability/numerics.py, "
+        "docs/observability.md); fast cases run in tier-1, the "
+        "obs_bench steady-state gate carries the slow marker too")

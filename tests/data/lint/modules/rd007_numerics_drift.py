@@ -1,0 +1,23 @@
+"""RD007 fixture: exactly ONE numerics stat-registry finding.
+
+The fixture project has no docs/ and no coverage sources, so any stat
+declared in a module-level ``NUMERICS_STATS`` literal fires — except
+the waived one. Near-misses that must stay clean: a registry tuple
+under a different name, a non-string element, and an inner-scope
+declaration.
+"""
+
+NUMERICS_STATS = (
+    "fixture_undocumented_stat",   # <- the one RD007 finding
+    "fixture_waived_stat",         # graftlint: disable=RD007
+    7,                             # non-string element: skipped
+)
+
+# a tuple that merely looks registry-ish: not a declared registry name
+OTHER_STATS = ("fixture_other_stat",)
+
+
+def _inner():
+    # inner-scope declaration is not the module-level registry
+    NUMERICS_STATS = ("fixture_inner_stat",)
+    return NUMERICS_STATS

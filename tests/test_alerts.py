@@ -474,6 +474,43 @@ def test_health_skip_spike_rule():
     assert ev["by_counter"]["health_skipped_steps"] == 5
 
 
+def test_numerics_condition_rules_fire_and_resolve():
+    """The three ``numerics_*`` rules lift the in-graph tap's detector
+    state — ``numerics_nonfinite``, ``numerics_grad_explosion``,
+    ``numerics_dead_layer`` — into FIRING incidents whose evidence
+    carries the automatic snapshot path, and RESOLVE when the
+    condition clears. Inert (value None) until a tap has ever judged
+    the condition."""
+    from mxnet_tpu.observability import numerics
+
+    numerics.reset()
+    t = 1000.0
+    got = alerts.evaluate(now=t, force=True)
+    assert not got  # no tap state: every numerics rule inert
+    try:
+        for cond, rule_id in (
+                ("nonfinite", "numerics_nonfinite"),
+                ("grad_explosion", "numerics_grad_explosion"),
+                ("dead_layer", "numerics_dead_layer")):
+            numerics._set_condition(
+                cond, True, evidence={"detail": cond}, step=7,
+                snapshot=f"/snapshots/{cond}")
+            t += 10
+            got = alerts.evaluate(now=t, force=True)
+            assert got.get(rule_id) == "FIRING", (rule_id, got)
+            inc = [i for i in alerts.open_incidents()
+                   if i["rule"] == rule_id][0]
+            assert inc["evidence"]["snapshot"] == f"/snapshots/{cond}"
+            assert inc["evidence"]["detail"] == cond
+            assert inc["evidence"]["since_step"] == 7
+            numerics._set_condition(cond, False, step=9)
+            t += alerts.get_rule(rule_id).cooldown_s + 1
+            got = alerts.evaluate(now=t, force=True)
+            assert got.get(rule_id) == "RESOLVED", (rule_id, got)
+    finally:
+        numerics.reset()
+
+
 # ------------------------------------------------------------------ incidents
 
 def test_incident_assembly_is_correlated():

@@ -187,6 +187,40 @@ def test_rd006_documented_and_covered_is_clean(tmp_path):
                    ("RD006", "test_only_rule")], got
 
 
+def test_rd007_exact(fixture_findings):
+    # one undocumented/unexercised numerics stat fires; the waived
+    # stat, the non-registry tuple, the non-string element and the
+    # inner-scope declaration stay clean
+    got = _in_file(fixture_findings, "rd007_numerics_drift.py")
+    assert got == [("RD007", "<module>",
+                    "fixture_undocumented_stat")], got
+
+
+def test_rd007_documented_and_covered_is_clean(tmp_path):
+    # a stat that is BOTH documented under docs/ and exercised by the
+    # numerics coverage sources passes; documented-only or
+    # covered-only fires
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "numerics.py").write_text(
+        'NUMERICS_STATS = ("clean_stat", "doc_only_stat", '
+        '"test_only_stat")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| `clean_stat` | covered |\n| `doc_only_stat` | covered |\n")
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_numerics.py").write_text(
+        'def test_x():\n    assert stat("clean_stat")\n'
+        '    assert stat("test_only_stat")\n')
+    project = core.Project(str(tmp_path))
+    got = sorted((f.rule, f.token)
+                 for f in core.run_all(project, rules={"RD007"}))
+    assert got == [("RD007", "doc_only_stat"),
+                   ("RD007", "test_only_stat")], got
+
+
 def test_rd001_rd003_miniproject():
     # the mini-project mirrors the repo's default layout, so this is
     # also a test of the CLI's zero-config Project defaults
@@ -224,7 +258,7 @@ def test_no_unexpected_fixture_findings(fixture_findings):
                "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
                "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1,
                "rd004_obs_drift.py": 2, "rd005_perf_drift.py": 1,
-               "rd006_alert_drift.py": 1}
+               "rd006_alert_drift.py": 1, "rd007_numerics_drift.py": 1}
     per_file = {}
     for f in fixture_findings:
         per_file[os.path.basename(f.path)] = \

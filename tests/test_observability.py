@@ -886,6 +886,8 @@ OBS_KEYS = frozenset({
     "perf_ledger_entries", "perf_device_timings",
     "alert_evaluations", "alert_transitions",
     "alert_incidents_opened", "alert_incidents_resolved",
+    "numerics_samples", "numerics_nonfinite_steps",
+    "numerics_snapshots", "numerics_halts",
 })
 
 

@@ -58,7 +58,7 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
               "perf_regression", "slo_burn", "step_time_anomaly",
-              "record_corrupt")
+              "record_corrupt", "nonfinite_grad")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -747,6 +747,129 @@ def _drill_step_time_anomaly(mx, workdir):
         alerts.reset()
 
 
+def _drill_nonfinite_grad(mx, workdir):
+    """A NaN lands in ONE layer's numerics mid-run under a CAPTURED
+    step with the in-graph telemetry tap armed: the fused finite flag
+    trips, the ``numerics_nonfinite`` alert FIRES with exactly one
+    correlated incident whose evidence carries the automatic numerics
+    snapshot, ``tools/numerics_bisect.py`` replays that snapshot
+    eagerly and names the poisoned layer, training keeps running under
+    ``MXNET_TPU_NONFINITE_POLICY=skip`` (the in-program select gated
+    every bad update), and a fresh run under ``policy=halt`` raises a
+    structured NumericsDivergenceError at onset."""
+    import importlib.util
+
+    import numpy as np
+
+    from mxnet_tpu import capture
+    from mxnet_tpu.observability import alerts, numerics, trace
+    from mxnet_tpu.resilience import faults
+
+    alerts.reset()
+    numerics.reset()
+    prev_trace = trace.set_enabled(True)
+    prev_alerts = alerts.set_enabled(False)
+    trace.clear()
+    saved_env = {k: os.environ.get(k) for k in
+                 ("MXNET_TPU_NUMERICS_SNAPSHOT_DIR",
+                  "MXNET_TPU_FAULT_NONFINITE_LAYER")}
+    os.environ["MXNET_TPU_NUMERICS_SNAPSHOT_DIR"] = \
+        os.path.join(workdir, "numerics")
+    os.environ["MXNET_TPU_FAULT_NONFINITE_LAYER"] = "dense1"
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).sum()
+
+    def make_net(prefix):
+        mx.random.seed(7)
+        net = mx.gluon.nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(mx.gluon.nn.Dense(16, activation="relu"))
+            net.add(mx.gluon.nn.Dense(8, activation="relu"))
+            net.add(mx.gluon.nn.Dense(4))
+        net.initialize()
+        net(mx.nd.zeros((2, 8)))
+        return net
+
+    def build(policy, prefix):
+        net = make_net(prefix)
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.05})
+        tap = numerics.NumericsTap(interval=1, policy=policy)
+        return net, capture.capture(trainer, net=net, loss_fn=loss_fn,
+                                    numerics=tap)
+
+    def batch(k):
+        rs = np.random.RandomState(k)
+        return (mx.nd.array(rs.rand(8, 8).astype(np.float32)),
+                mx.nd.ones((8, 4)))
+
+    try:
+        # --- policy=skip: detect, snapshot, alert, keep training
+        net, step = build("skip", "chaosnum_")
+        for k in range(4):
+            step(*batch(k), batch_size=8)
+        t = 1000.0
+        alerts.evaluate(now=t, force=True)
+        if alerts.incidents():
+            return False, "incident open before the injection"
+        with faults.inject("nonfinite_grad", times=1) as f:
+            step(*batch(4), batch_size=8)  # poisons dense1's weight
+        survived = 0
+        for k in range(5, 8):  # skip policy: the loop keeps running
+            step(*batch(k), batch_size=8)
+            survived += 1
+        t += 5.0
+        alerts.evaluate(now=t, force=True)
+        ok_inc, why, inc = _assert_one_incident(alerts,
+                                                "numerics_nonfinite")
+        if not ok_inc:
+            return False, why
+        snap = inc["evidence"].get("snapshot")
+        if not snap or not os.path.isdir(snap):
+            return False, f"incident carries no numerics snapshot: {snap}"
+        # --- the snapshot bisects back to the poisoned layer
+        spec = importlib.util.spec_from_file_location(
+            "numerics_bisect", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "numerics_bisect.py"))
+        bisect_tool = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bisect_tool)
+        # run_bisect replays EAGERLY and replaces every param from the
+        # snapshot: a bare structurally-identical net suffices — no
+        # capture (and no pair of XLA compiles) for the replay
+        replay_net = make_net("chaosnumr_")
+        report = bisect_tool.run_bisect(snap, replay_net, loss_fn)
+        named = report.get("first_bad_layer") or ""
+        localized = "dense1" in named
+        # --- policy=halt: a fresh run raises at onset
+        numerics.reset()
+        alerts.reset()
+        net2, step2 = build("halt", "chaoshalt_")
+        halted = False
+        with faults.inject("nonfinite_grad", times=1) as f2:
+            try:
+                for k in range(3):
+                    step2(*batch(k), batch_size=8)
+            except numerics.NumericsDivergenceError:
+                halted = True
+        ok = (f.fired == 1 and f2.fired == 1 and survived == 3
+              and localized and halted)
+        return ok, (f"fired={f.fired}+{f2.fired} survived={survived} "
+                    f"first_bad_layer={named!r} localized={localized} "
+                    f"halted={halted} snapshot={os.path.basename(snap)}")
+    finally:
+        trace.set_enabled(prev_trace)
+        alerts.set_enabled(prev_alerts)
+        alerts.reset()
+        numerics.reset()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _drill_record_corrupt(mx, workdir):
     """A streamed RecordIO payload is corrupted in flight (bitrot the
     range read can't see — same length, only the index CRC catches it):
@@ -851,6 +974,8 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_step_time_anomaly(mx, tmp)
     if kind == "record_corrupt":
         return _drill_record_corrupt(mx, tmp)
+    if kind == "nonfinite_grad":
+        return _drill_nonfinite_grad(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

@@ -1,0 +1,289 @@
+"""First-bad-layer bisection over a numerics snapshot.
+
+When the in-graph numerics tap (``observability.numerics``) detects a
+divergence inside the captured training step — non-finite onset, a
+grad-norm explosion — it publishes a snapshot: the batch, every
+parameter, the optimizer state, and the captured run's per-tensor
+statistics. This tool localizes the failure to a LAYER:
+
+1. **replay** (:func:`run_bisect`, library entry) — load the snapshot's
+   parameters into a structurally-identical net, re-run the step
+   **eagerly** over the snapshot batch with per-layer forward taps, and
+   walk the activations in forward order: the first layer whose output
+   is non-finite — or whose L2 diverges from the CAPTURED run's
+   recorded value beyond tolerance — is the first bad layer. (A NaN
+   source poisons every gradient via backward, so gradients alone
+   cannot localize it; forward activation order can.) With a
+   ``loss_fn`` the backward is replayed too and per-parameter gradient
+   stats ride along.
+2. **inspect** (:func:`inspect_snapshot`, ``--snapshot`` CLI mode) —
+   no net needed: read the captured run's own recorded row stats and
+   report the forward-order activation onset.
+
+Prints ONE JSON line (the repo-wide tool contract)::
+
+    {"metric": "numerics_bisect_diverged_layers", "value": <n>,
+     "unit": "layers", "extra": {"first_bad_layer": ..., "mode": ...}}
+
+Exit code: non-zero when the snapshot cannot be read or (in ``--demo``
+mode) when the injected layer is not localized. ``--demo`` is the
+self-contained proof: build a small net, capture it with the tap,
+poison one layer's weight via the ``nonfinite_grad`` fault, and bisect
+the automatic snapshot back to that layer.
+
+Run: JAX_PLATFORMS=cpu python tools/numerics_bisect.py --snapshot DIR
+     JAX_PLATFORMS=cpu python tools/numerics_bisect.py --demo
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _tensor_stats(a):
+    import numpy as np
+
+    v = np.asarray(a, np.float64).ravel()
+    if not v.size:
+        return {"l2": 0.0, "maxabs": 0.0, "nonfinite": 0}
+    finite = np.isfinite(v)
+    return {"l2": float(np.sqrt(np.sum(v * v))),
+            "maxabs": float(np.max(np.abs(v))),
+            "nonfinite": int((~finite).sum())}
+
+
+def inspect_snapshot(snapshot):
+    """Report from the snapshot's own recorded (captured-run) stats —
+    the forward-order activation rows name the onset layer without
+    replaying anything."""
+    from mxnet_tpu.observability import numerics as _numerics
+
+    snap = _numerics.load_snapshot(snapshot) \
+        if isinstance(snapshot, str) else snapshot
+    man = snap["manifest"]
+    tensors = (man.get("sample") or {}).get("tensors") or {}
+    layers = []
+    first_bad = None
+    for name, _size in man.get("rows", ()):
+        if not name.startswith("act:"):
+            continue
+        rec = tensors.get(name, {})
+        bad = bool(rec.get("nonfinite"))
+        layers.append({"layer": name[4:], "diverged": bad,
+                       **{k: rec.get(k) for k in ("l2", "maxabs",
+                                                  "nonfinite")}})
+        if bad and first_bad is None:
+            first_bad = name[4:]
+    return {"mode": "inspect", "reason": man.get("reason"),
+            "step": man.get("step"), "first_bad_layer": first_bad,
+            "first_bad_grad": None,
+            "diverged": sum(1 for r in layers if r["diverged"]),
+            "layers": layers}
+
+
+def run_bisect(snapshot, net, loss_fn=None, rtol=1e-2):
+    """Replay ``snapshot`` through ``net`` **eagerly** and localize the
+    first layer whose output diverges from the captured run.
+
+    ``net`` must be structurally identical to the snapshotted one (same
+    parameter names); its live parameter values are saved, replaced by
+    the snapshot's, and restored afterwards. Divergence per layer =
+    non-finite output, or |L2 - captured L2| / captured L2 > ``rtol``
+    when the snapshot carries the captured run's recorded stats.
+    Returns the report dict (see module docstring).
+    """
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.observability import numerics as _numerics
+
+    snap = _numerics.load_snapshot(snapshot) \
+        if isinstance(snapshot, str) else snapshot
+    man = snap["manifest"]
+    if snap["batch"] is None:
+        raise ValueError(
+            "snapshot records no batch — nothing to replay (the tap "
+            "had not seen a step yet?)")
+    pmap = net._collect_params_with_prefix()
+    if set(pmap) != set(snap["params"]):
+        diff = sorted(set(pmap) ^ set(snap["params"]))
+        raise ValueError(
+            f"net parameters do not match the snapshot (mismatched: "
+            f"{diff[:6]}); pass a structurally identical net")
+    saved = {k: nd.asnumpy().copy() for k, nd in pmap.items()}
+    tap = _numerics.NumericsTap(interval=0, policy="record")
+    try:
+        for k, nd in pmap.items():
+            nd._set_data(mx.nd.array(snap["params"][k])._data)
+        x_nd = mx.nd.array(snap["batch"][0])
+        y_nd = mx.nd.array(snap["batch"][1])
+        hooks, acts = tap.install_hooks(net)
+        try:
+            if loss_fn is not None:
+                with autograd.record():
+                    out = net(x_nd)
+                    loss = loss_fn(out, y_nd)
+                loss.backward()
+            else:
+                net(x_nd)
+        finally:
+            tap.remove_hooks(hooks)
+        captured = (man.get("sample") or {}).get("tensors") or {}
+        layers = []
+        first_bad = None
+        for name, data in acts:
+            st = _tensor_stats(np.asarray(data))
+            row = {"layer": name}
+            row.update(st)
+            ref = captured.get(f"act:{name}") or {}
+            base = ref.get("l2")
+            if base is not None and st["nonfinite"] == 0 \
+                    and not ref.get("nonfinite"):
+                row["captured_l2"] = base
+                row["rel_diff"] = abs(st["l2"] - base) / (abs(base) + 1e-9)
+            row["diverged"] = bool(st["nonfinite"]
+                                   or row.get("rel_diff", 0.0) > rtol)
+            if row["diverged"] and first_bad is None:
+                first_bad = name
+            layers.append(row)
+        grads = []
+        first_bad_grad = None
+        if loss_fn is not None:
+            for p in net.collect_params().values():
+                if p.grad_req == "null":
+                    continue
+                st = _tensor_stats(p.grad().asnumpy())
+                if st["nonfinite"] and first_bad_grad is None:
+                    first_bad_grad = p.name
+                grads.append({"param": p.name, **st})
+        return {"mode": "replay", "reason": man.get("reason"),
+                "step": man.get("step"), "first_bad_layer": first_bad,
+                "first_bad_grad": first_bad_grad,
+                "diverged": sum(1 for r in layers if r["diverged"]),
+                "layers": layers, "grads": grads}
+    finally:
+        for k, nd in pmap.items():
+            nd._set_data(mx.nd.array(saved[k])._data)
+
+
+# ------------------------------------------------------------------- demo
+
+def _demo_net(mx, prefix="bisect_demo_"):
+    mx.random.seed(7)
+    net = mx.gluon.nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(mx.gluon.nn.Dense(16, activation="relu"))
+        net.add(mx.gluon.nn.Dense(8, activation="relu"))
+        net.add(mx.gluon.nn.Dense(4))
+    net.initialize()
+    net(mx.nd.zeros((2, 8)))
+    return net
+
+
+def _demo_loss(out, y):
+    return ((out - y) ** 2).sum()
+
+
+def demo(workdir):
+    """Self-contained proof: poison one layer's weight under a captured
+    step with the tap armed, then bisect the automatic snapshot back to
+    that layer. Returns (report, localized)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import capture
+    from mxnet_tpu.observability import numerics as _numerics
+    from mxnet_tpu.resilience import faults
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("MXNET_TPU_NUMERICS_SNAPSHOT_DIR",
+                  "MXNET_TPU_FAULT_NONFINITE_LAYER")}
+    os.environ["MXNET_TPU_NUMERICS_SNAPSHOT_DIR"] = \
+        os.path.join(workdir, "numerics")
+    os.environ["MXNET_TPU_FAULT_NONFINITE_LAYER"] = "dense1"
+    _numerics.reset()
+    try:
+        net = _demo_net(mx)
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.05})
+        tap = _numerics.NumericsTap(interval=1, policy="skip")
+        step = capture.capture(trainer, net=net, loss_fn=_demo_loss,
+                               numerics=tap)
+
+        def batch(k):
+            rs = np.random.RandomState(k)
+            return (mx.nd.array(rs.rand(8, 8).astype(np.float32)),
+                    mx.nd.ones((8, 4)))
+
+        for k in range(3):
+            step(*batch(k), batch_size=8)
+        with faults.inject("nonfinite_grad", times=1):
+            step(*batch(3), batch_size=8)
+        snap = _numerics.last_snapshot()
+        if snap is None:
+            return {"error": "no snapshot published"}, False
+        report = run_bisect(snap, _demo_net(mx), _demo_loss)
+        report["snapshot"] = snap
+        first = report.get("first_bad_layer") or ""
+        return report, "dense1" in first
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--snapshot", help="published numerics snapshot dir "
+                                       "(inspect mode: recorded stats, "
+                                       "no replay)")
+    ap.add_argument("--demo", action="store_true",
+                    help="self-contained poison->snapshot->bisect proof")
+    ap.add_argument("--rtol", type=float, default=1e-2)
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        with tempfile.TemporaryDirectory(prefix="bisect_demo_") as tmp:
+            report, localized = demo(tmp)
+        ok = localized
+        mode = "demo"
+    elif args.snapshot:
+        try:
+            report = inspect_snapshot(args.snapshot)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"numerics_bisect: cannot read snapshot: {e}",
+                  file=sys.stderr)
+            return 1
+        ok = True
+        mode = "inspect"
+    else:
+        ap.error("pass --snapshot DIR or --demo (replay mode is the "
+                 "run_bisect() library entry — it needs the live net)")
+        return 2
+
+    first = report.get("first_bad_layer")
+    print(f"numerics_bisect[{mode}]: first_bad_layer={first} "
+          f"diverged={report.get('diverged')}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "numerics_bisect_diverged_layers",
+        "value": int(report.get("diverged") or 0),
+        "unit": "layers",
+        "extra": {"mode": mode, "first_bad_layer": first,
+                  "first_bad_grad": report.get("first_bad_grad"),
+                  "reason": report.get("reason"),
+                  "snapshot": report.get("snapshot", args.snapshot),
+                  "localized": bool(first)},
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,8 +152,39 @@ def _collect_once(steps, trials):
             outs[0].wait_to_read()
             serve_ms = min(serve_ms, (time.perf_counter() - t0) / steps * 1e3)
 
+        # numerics telemetry rides a FIXED key (like stream_ingest): the
+        # tapped program's structural fingerprint folds the row plan, so
+        # a ledger-derived key would re-baseline on any tap-plan tweak
+        # instead of gating the telemetry cost's erosion. step_ms is the
+        # amortized per-step wall at the production sampling interval 10
+        # (ISSUE 14's <=2%-overhead surface; a committed TPU baseline is
+        # the evidence for the production claim).
+        mx.random.seed(11)
+        tap_net = mx.gluon.nn.Dense(8, in_units=16,
+                                    prefix="perfgate_tapnet_")
+        tap_net.initialize()
+        tap_trainer = mx.gluon.Trainer(tap_net.collect_params(), "sgd",
+                                       {"learning_rate": 0.1,
+                                        "momentum": 0.9})
+        from mxnet_tpu.observability import numerics as _numerics
+
+        tap_step = capture.capture(
+            tap_trainer, net=tap_net, loss_fn=_loss_fn,
+            numerics=_numerics.NumericsTap(interval=10, policy="record"),
+            label="numerics_trainer_step")
+        tap_step(x, y, batch_size=16)
+        tap_ms = 1e9
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _k in range(steps):
+                tap_step(x, y, batch_size=16)
+            mx.nd.waitall()
+            tap_ms = min(tap_ms, (time.perf_counter() - t0) / steps * 1e3)
+
         measured = {}
         for key, e in perf.ledger().items():
+            if e["label"].startswith("numerics_trainer_step"):
+                continue  # carried by the fixed numerics_tap key below
             rec = {"compile_ms": e["compile_ms"],
                    "peak_hbm_bytes": e["peak_hbm_bytes"]}
             if e["label"] == "trainer_step":
@@ -161,6 +192,7 @@ def _collect_once(steps, trials):
             elif e["label"].startswith("serving_bucket"):
                 rec["step_ms"] = serve_ms
             measured[key] = rec
+        measured["numerics_tap@capture"] = {"step_ms": tap_ms}
         measured["stream_ingest@host_pipeline"] = {
             "step_ms": _measure_stream_ingest(steps, trials)}
         return measured
