@@ -139,6 +139,23 @@ def _cache_salt():
     return os.environ.get("MXNET_TPU_COMPILE_CACHE_SALT", "")
 
 
+def _schedule_token():
+    """The kernel schedule-table identity folded into every AOT cache
+    key (mxnet_tpu/tune/, docs/autotune.md): kernel builders resolve
+    Pallas block sizes / int8 arrangements from the table at trace
+    time, so a table change is a program change — a tuned program
+    warm-loads fleet-wide, and a schedule edit can never false-hit an
+    artifact compiled under the old schedule. '' when autotuning is
+    disabled or the table is empty (both compile the default-schedule
+    programs)."""
+    try:
+        from .tune import schedule as _tune_schedule
+
+        return _tune_schedule.fingerprint_token()
+    except Exception:
+        return ""
+
+
 # -------------------------------------------------------- retrace forensics
 
 # Structured reasons for every captured-program recompile, newest last.
@@ -492,6 +509,7 @@ class CompileCache:
         blob = json.dumps({
             "label": label, "fingerprint": fingerprint, "sig": repr(sig),
             "backend": _backend_sig(), "salt": _cache_salt(),
+            "schedule": _schedule_token(),
         }, sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()[:40]
 

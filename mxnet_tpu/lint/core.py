@@ -41,6 +41,9 @@ RULES = {
              "control flow) on traced values in kernel or segment bodies",
     "TS002": "no raw jax.jit outside the interned executable cache",
     "TS003": "no read of donated input buffers after a donating dispatch",
+    "TS004": "Pallas block sizes come from the tune/schedule module — no "
+             "hardcoded block constants or literal BlockSpec tiles "
+             "elsewhere",
     "CC001": "module-level mutable state in a threaded module is only "
              "mutated under its declared lock",
     "CC002": "no lock-acquisition-order cycles (deadlock potential)",
@@ -122,6 +125,10 @@ def _infer_role(relpath):
     base = os.path.basename(p)
     if base == "registry.py" and "/ops/" in p:
         return "registry"
+    if "/tune/" in p:
+        # the schedule registry (mxnet_tpu/tune/) is the ONE place block
+        # constants may live (TS004)
+        return "schedule"
     if "/ops/" in p:
         return "ops"
     if base == "engine.py":

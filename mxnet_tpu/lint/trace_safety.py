@@ -1,4 +1,4 @@
-"""Trace-safety passes (TS001-TS003).
+"""Trace-safety passes (TS001-TS004).
 
 The whole-program-compilation contract (ROADMAP item 3, the Julia-to-TPU
 paper): code that runs under a jax trace — op kernel bodies in
@@ -19,10 +19,18 @@ trace and drop taint. An ``isinstance(x, <Tracer>)`` check whose body
 raises/returns is recognized as a *tracer guard* and untaints ``x`` —
 the sanctioned idiom for host-only ops (see
 ``_contrib_calibrate_entropy``).
+TS004 (schedule discipline): kernel block sizes are *measured
+schedules*, not constants (docs/autotune.md). The one home for block
+constants and candidate spaces is the schedule registry
+(``mxnet_tpu/tune/``, role ``schedule``); anywhere else, a module-level
+``*BLOCK*`` integer constant or an integer tile literal inside a
+``pl.BlockSpec`` block shape is a kernel the autotuner cannot steer —
+and a shape the legalizer never validated.
 """
 from __future__ import annotations
 
 import ast
+import re
 
 from .core import ParentedWalk, call_name, emit, qualname_of
 
@@ -569,9 +577,62 @@ def _check_ts003(mod, findings):
                      "(donate_argnums)")
 
 
+# names that smell like a block-size constant; matching is
+# case-sensitive on the UPPER convention so loop variables (`block`,
+# `kb`) never fire — only declared constants do
+_BLOCK_NAME_RE = re.compile(r"(^|_)BLOCK(S)?(_|$)")
+
+# the smallest tile anyone would schedule: literals below this inside a
+# BlockSpec are structural dims (batch 1, kernel taps 3), not schedules
+_MIN_BLOCK_LITERAL = 16
+
+
+def _check_ts004(mod, findings):
+    """Hardcoded Pallas schedules outside the schedule registry: a
+    module-level/class-level ``*BLOCK*`` integer constant, or an integer
+    literal >= 16 inside a ``BlockSpec`` block-shape tuple. The
+    ``schedule`` role (mxnet_tpu/tune/) is the sanctioned home."""
+    if mod.role == "schedule":
+        return
+    for node, parents in ParentedWalk(mod.tree):
+        if isinstance(node, ast.Assign):
+            if not (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                    and not isinstance(node.value.value, bool)
+                    and node.value.value >= _MIN_BLOCK_LITERAL):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and _BLOCK_NAME_RE.search(t.id):
+                    emit(findings, mod, "TS004", node,
+                         qualname_of(parents, node), t.id,
+                         f"hardcoded block constant `{t.id} = "
+                         f"{node.value.value}` — kernel schedules live in "
+                         "mxnet_tpu/tune/schedule.py and resolve through "
+                         "the schedule table (docs/autotune.md)")
+        elif isinstance(node, ast.Call) and \
+                call_name(node).split(".")[-1] == "BlockSpec" and node.args:
+            blk = node.args[0]
+            if not isinstance(blk, (ast.Tuple, ast.List)):
+                continue
+            for elt in blk.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int) and \
+                        not isinstance(elt.value, bool) and \
+                        elt.value >= _MIN_BLOCK_LITERAL:
+                    emit(findings, mod, "TS004", node,
+                         qualname_of(parents, node),
+                         f"BlockSpec:{elt.value}",
+                         f"literal tile size {elt.value} inside a "
+                         "BlockSpec block shape — route the block through "
+                         "the schedule registry (mxnet_tpu/tune/, "
+                         "docs/autotune.md)")
+                    break  # one finding per BlockSpec call
+
+
 def run(project):
     findings = []
     for mod in project.modules():
+        _check_ts004(mod, findings)
         if mod.role in ("ops", "engine", "registry"):
             _check_ts001(mod, findings)
             _check_ts002(mod, findings)

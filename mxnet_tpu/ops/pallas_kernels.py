@@ -21,9 +21,17 @@ import numpy as _np
 __all__ = ["flash_attention", "flash_attention_with_grad",
            "flash_attention_with_lse", "pallas_available"]
 
-_BLOCK_Q = 128
-_BLOCK_K = 128
+# Block sizes are SCHEDULES, not constants: they resolve per
+# (kernel, shape, dtype, backend) through mxnet_tpu/tune/schedule.py —
+# explicit override > measured schedule table > legalized default
+# (graftlint TS004 keeps hardcoded blocks out of kernel files).
 _NEG = -1e30
+
+
+def _schedule():
+    from ..tune import schedule
+
+    return schedule
 
 
 def pallas_available():
@@ -108,14 +116,15 @@ def _mha_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 @functools.lru_cache(maxsize=32)
-def _build_flash(bh, t, d, dtype_str, scale, causal, interpret):
+def _build_flash(bh, t, d, dtype_str, scale, causal, interpret, bq, bk):
+    """One pallas_call per (shape, dtype, config, SCHEDULE): bq/bk are
+    part of the cache key, so a schedule-table change re-builds instead
+    of serving the old tiling."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bq = min(_BLOCK_Q, t)
-    bk = min(_BLOCK_K, t)
     n_kb = t // bk
     kernel = functools.partial(_mha_kernel, scale=scale, causal=causal,
                                n_kb=n_kb)
@@ -162,7 +171,8 @@ def _unwrap_nd(q, k, v, interpret):
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
-                    return_lse=False, q_offset=0, k_offset=0):
+                    return_lse=False, q_offset=0, k_offset=0,
+                    block_q=None, block_k=None):
     """Fused attention forward: q/k/v (B, H, T, D) -> (B, H, T, D)
     (plus the per-row log-sum-exp when return_lse=True).
 
@@ -170,9 +180,14 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
     a larger global sequence for causal masking — the ring-attention hop
     case, where K/V blocks rotate past stationary local queries.
 
-    Requirements: T divisible by the 128 block (or T <= 128), D <= 256,
-    self-attention shapes. Raises ValueError otherwise — callers fall back
-    to the XLA composition (ops/nn.py scaled_dot_product_attention).
+    Block sizes resolve through the schedule registry
+    (mxnet_tpu/tune/schedule.py, docs/autotune.md): explicit
+    block_q/block_k override (must divide T — the search driver's path),
+    else the measured schedule table, else the legalized default.
+    Requirements: a legal block exists (T itself, or a multiple-of-8
+    divisor of T up to the scheduled block), D <= 256, self-attention
+    shapes. Raises ValueError otherwise — callers fall back to the XLA
+    composition (ops/nn.py scaled_dot_product_attention).
 
     Accepts NDArrays or jax arrays. Eager NDArray calls are placed on the
     TPU device automatically (or run in interpret mode on CPU-only hosts),
@@ -187,21 +202,24 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
         raw, interpret = _unwrap_nd(q, k, v, interpret)
         out = flash_attention(*raw, causal=causal, scale=scale,
                               interpret=interpret, return_lse=return_lse,
-                              q_offset=q_offset, k_offset=k_offset)
+                              q_offset=q_offset, k_offset=k_offset,
+                              block_q=block_q, block_k=block_k)
         if return_lse:
             return NDArray(out[0], ctx), NDArray(out[1], ctx)
         return NDArray(out, ctx)
     b, h, t, d = q.shape
-    bq = min(_BLOCK_Q, t)
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(
             f"flash_attention: unsupported shape — q {q.shape} vs k "
             f"{k.shape} / v {v.shape} (self-attention only)")
-    if t % bq != 0 or d > 256:
-        raise ValueError(f"flash_attention: unsupported shape T={t} D={d}")
+    # ScheduleError subclasses ValueError, so the no-legal-block case
+    # keeps the documented fall-back contract
+    bq, bk = _schedule().flash_fwd_blocks(
+        b * h, t, d, str(q.dtype), interpret=bool(interpret),
+        block_q=block_q, block_k=block_k)
     s = scale if scale is not None else 1.0 / _np.sqrt(d)
     fn = _build_flash(b * h, t, d, str(q.dtype), float(s), bool(causal),
-                      bool(interpret))
+                      bool(interpret), bq, bk)
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)
@@ -226,14 +244,25 @@ def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k,
     scanned over K blocks; `lse` comes from the forward kernel's scratch
     (no recomputation sweep). `dlse` carries the cotangent of the emitted
     log-sum-exp (nonzero when the caller merges hop results by lse, as
-    ring attention does): d lse / d s = p folds in as ds += p * dlse."""
+    ring attention does): d lse / d s = p folds in as ds += p * dlse.
+
+    ``block_k`` need not divide T: the trailing partial block is padded
+    and masked to probability zero (a schedule-table block must never
+    silently drop the sequence tail), and the padded dk/dv rows are
+    trimmed after the scan."""
     import jax
     import jax.numpy as jnp
 
     b, h, t, d = q.shape
-    n_kb = t // block_k
+    block_k = max(1, min(int(block_k), t))
+    pad = (-t) % block_k
+    n_kb = (t + pad) // block_k
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     o32, do32 = out.astype(jnp.float32), dout.astype(jnp.float32)
+    if pad:
+        widen = ((0, 0), (0, 0), (0, pad), (0, 0))
+        k32 = jnp.pad(k32, widen)
+        v32 = jnp.pad(v32, widen)
     D = jnp.sum(do32 * o32, axis=-1, keepdims=True)  # (b,h,t,1)
     if dlse is not None:
         D = D - dlse.astype(jnp.float32)
@@ -243,9 +272,14 @@ def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k,
         ks = jax.lax.dynamic_slice_in_dim(k32, kb * block_k, block_k, axis=2)
         vs = jax.lax.dynamic_slice_in_dim(v32, kb * block_k, block_k, axis=2)
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, ks) * scale
+        kcol = kb * block_k + jnp.arange(block_k)
         if causal:
-            kpos = k_offset + kb * block_k + jnp.arange(block_k)
+            kpos = k_offset + kcol
             s = jnp.where(qpos[:, None] >= kpos[None, :], s, _NEG)
+        if pad:
+            # padded K columns are outside the sequence: mask them to
+            # p = exp(_NEG - lse) = 0 so they contribute to nothing
+            s = jnp.where((kcol < t)[None, :], s, _NEG)
         p = jnp.exp(s - lse)  # (b,h,t,bk)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vs)
         ds = p * (dp - D)
@@ -256,34 +290,42 @@ def _flash_bwd_blockwise(q, k, v, out, lse, dout, scale, causal, block_k,
 
     dq0 = jnp.zeros_like(q32)
     dq, (dk_blks, dv_blks) = jax.lax.scan(body, dq0, jnp.arange(n_kb))
-    # scan stacks over the leading axis: (n_kb, b, h, bk, d) -> (b, h, t, d)
-    dk = jnp.moveaxis(dk_blks, 0, 2).reshape(b, h, t, d)
-    dv = jnp.moveaxis(dv_blks, 0, 2).reshape(b, h, t, d)
+    # scan stacks over the leading axis: (n_kb, b, h, bk, d) ->
+    # (b, h, t+pad, d), padded tail rows (exactly zero) trimmed off
+    dk = jnp.moveaxis(dk_blks, 0, 2).reshape(b, h, t + pad, d)[:, :, :t]
+    dv = jnp.moveaxis(dv_blks, 0, 2).reshape(b, h, t + pad, d)[:, :, :t]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             interpret=False, q_offset=0, k_offset=0):
+                             interpret=False, q_offset=0, k_offset=0,
+                             block_q=None, block_k=None, bwd_block_k=None):
     """Differentiable (out, lse) pair — the ring-attention building block:
     per-hop results merge by log-sum-exp, so the lse output needs a
     gradient path too (folded into the blockwise backward as ds += p*dlse).
     Offsets may be traced scalars (lax.axis_index inside shard_map);
     custom_vjp cannot close over tracers, so they ride along as float
-    primals with zero cotangents."""
+    primals with zero cotangents. Block sizes resolve through the
+    schedule registry (docs/autotune.md); bwd_block_k overrides the
+    backward's K-scan width."""
     import functools as _ft
 
     import jax
     import jax.numpy as jnp
 
-    s = scale if scale is not None else 1.0 / _np.sqrt(q.shape[-1])
-    bk = min(_BLOCK_K, q.shape[2])
+    b, h, t, d = q.shape
+    s = scale if scale is not None else 1.0 / _np.sqrt(d)
+    bk = _schedule().flash_bwd_block(b * h, t, d, str(q.dtype),
+                                     interpret=bool(interpret),
+                                     block_k=bwd_block_k)
 
     @_ft.partial(jax.custom_vjp)
     def f(q, k, v, qo, ko):
         return flash_attention(q, k, v, causal=causal, scale=s,
                                interpret=interpret, return_lse=True,
                                q_offset=qo.astype(jnp.int32),
-                               k_offset=ko.astype(jnp.int32))
+                               k_offset=ko.astype(jnp.int32),
+                               block_q=block_q, block_k=block_k)
 
     def f_fwd(q, k, v, qo, ko):
         out, lse = f(q, k, v, qo, ko)
@@ -303,12 +345,14 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
 
 
 def flash_attention_with_grad(q, k, v, causal=False, scale=None,
-                              interpret=False):
+                              interpret=False, block_q=None, block_k=None,
+                              bwd_block_k=None):
     """Differentiable flash attention: the Pallas kernel forward paired
     with a blockwise backward via jax.custom_vjp (probabilities
     recomputed from the forward's saved log-sum-exp — no extra Q.K^T
-    sweep). Same shape/placement rules as flash_attention, NDArrays
-    included."""
+    sweep). Same shape/placement/schedule rules as flash_attention,
+    NDArrays included; bwd_block_k overrides the backward's K-scan
+    width (any width — the backward pads non-dividing tails)."""
     import functools as _ft
 
     import jax
@@ -319,19 +363,26 @@ def flash_attention_with_grad(q, k, v, causal=False, scale=None,
         ctx = getattr(q, "_ctx", None)
         raw, interpret = _unwrap_nd(q, k, v, interpret)
         return NDArray(flash_attention_with_grad(
-            *raw, causal=causal, scale=scale, interpret=interpret), ctx)
+            *raw, causal=causal, scale=scale, interpret=interpret,
+            block_q=block_q, block_k=block_k,
+            bwd_block_k=bwd_block_k), ctx)
 
-    s = scale if scale is not None else 1.0 / _np.sqrt(q.shape[-1])
-    bk = min(_BLOCK_K, q.shape[2])
+    b, h, t, d = q.shape
+    s = scale if scale is not None else 1.0 / _np.sqrt(d)
+    bk = _schedule().flash_bwd_block(b * h, t, d, str(q.dtype),
+                                     interpret=bool(interpret),
+                                     block_k=bwd_block_k)
 
     @_ft.partial(jax.custom_vjp)
     def f(q, k, v):
         return flash_attention(q, k, v, causal=causal, scale=s,
-                               interpret=interpret)
+                               interpret=interpret,
+                               block_q=block_q, block_k=block_k)
 
     def f_fwd(q, k, v):
         out, lse = flash_attention(q, k, v, causal=causal, scale=s,
-                                   interpret=interpret, return_lse=True)
+                                   interpret=interpret, return_lse=True,
+                                   block_q=block_q, block_k=block_k)
         return out, (q, k, v, out, lse)
 
     def f_bwd(res, dout):

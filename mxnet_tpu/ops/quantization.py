@@ -120,7 +120,7 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
     min_r = min_range.reshape(())
     max_r = max_range.reshape(())
     real_in = _int8_range(min_r, max_r)
-    fp = data.astype(jnp.float32) * (real_in / 2147483647.0)
+    path = "via_fp32"
     if min_calib_range is not None and max_calib_range is not None:
         out_min = jnp.asarray(min_calib_range, jnp.float32)
         out_max = jnp.asarray(max_calib_range, jnp.float32)
@@ -130,13 +130,19 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
             flag = 0.0 * real_in
             out_min = out_min + flag
             out_max = out_max + flag
+        # calibrated ranges are static, so the epilogue arrangement is a
+        # tunable schedule axis (docs/autotune.md); the data-dependent
+        # branch below always runs the reference form
+        path = _kernel_schedule(
+            "int8_requant", lambda s: s.int8_requant_shape_key(
+                data.shape[0] if data.ndim else 1,
+                data.shape[-1] if data.ndim else 1)).get(
+                    "path", "via_fp32")
     else:
+        fp = data.astype(jnp.float32) * (real_in / 2147483647.0)
         out_max = jnp.max(jnp.abs(fp))
         out_min = -out_max
-    real_out = _int8_range(out_min, out_max)
-    q = jnp.clip(jnp.round(fp * 127.0 / jnp.maximum(real_out, 1e-20)),
-                 -127, 127)
-    return q.astype(jnp.int8), -real_out, real_out
+    return _requant_epilogue(data, real_in, out_min, out_max, path=path)
 
 
 # ---------------------------------------------------------------------------
@@ -145,6 +151,77 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
 # — the throughput half of the reference's quantized_fully_connected.cc /
 # quantized_conv.cc, not just the fake-quant accuracy flow
 # ---------------------------------------------------------------------------
+
+def _kernel_schedule(kernel, shape_key_fn):
+    """Trace-time measured-schedule lookup for the int8 compute kernels
+    (mxnet_tpu/tune/, docs/autotune.md): the winning operand/epilogue
+    arrangement per (kernel, shape, backend) from the schedule table,
+    declared defaults otherwise. ``shape_key_fn(schedule_module)``
+    derives the key through the registry's shared shape-key builders,
+    so the kernel and the search workloads can never disagree on the
+    format. Static metadata only (shapes) — never traced values. Table
+    edits apply at the next trace; across processes the table digest
+    folds into the AOT cache key, so a stale compiled artifact can
+    never be served under a new schedule."""
+    try:
+        from ..tune import schedule as _sched
+    except Exception:  # pragma: no cover - vendored standalone use
+        return {}
+    return _sched.kernel_schedule(kernel, shape_key_fn(_sched), "int8",
+                                  _sched.resolve_backend(False))
+
+
+def _s8_matmul(x, weight, operand_width="int8"):
+    """The int8 GEMM compute core: x (..., K) @ weight (N, K)^T with
+    int32 accumulation. operand_width='int32' widens the operands first
+    — exact same integer results, different backend kernel selection
+    (the measured schedule axis)."""
+    import jax
+
+    lhs, rhs = x, weight
+    if operand_width == "int32":
+        lhs = lhs.astype(jnp.int32)
+        rhs = rhs.astype(jnp.int32)
+    return jax.lax.dot_general(
+        lhs, rhs, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def _s8_conv(data, weight, stride, pads, dilate, dn, groups,
+             operand_width="int8"):
+    """The int8 convolution compute core (int32 accumulation); same
+    operand_width schedule axis as :func:`_s8_matmul`."""
+    import jax
+
+    lhs, rhs = data, weight
+    if operand_width == "int32":
+        lhs = lhs.astype(jnp.int32)
+        rhs = rhs.astype(jnp.int32)
+    return jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=stride, padding=pads,
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+
+
+def _requant_epilogue(data, real_in, out_min, out_max, path="via_fp32"):
+    """int32-accumulator -> int8 epilogue under a calibrated output
+    range. path='via_fp32' is the reference two-multiply form;
+    'fused_scale' folds both scales into one multiplier (may differ in
+    the last ULP — only a numerics-validated table entry selects it).
+    Returns (int8, -real_out, real_out)."""
+    real_out = _int8_range(out_min, out_max)
+    if path == "fused_scale":
+        scale = (real_in / 2147483647.0) * \
+            (127.0 / jnp.maximum(real_out, 1e-20))
+        q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                     -127, 127)
+    else:
+        fp = data.astype(jnp.float32) * (real_in / 2147483647.0)
+        q = jnp.clip(jnp.round(fp * 127.0 / jnp.maximum(real_out, 1e-20)),
+                     -127, 127)
+    return q.astype(jnp.int8), -real_out, real_out
+
 
 def _s8s8_out_range(min_d, max_d, min_w, max_w):
     """Output float range of an int32 accumulator of int8*int8 products
@@ -164,14 +241,14 @@ def _quantized_fully_connected(data, weight, bias, min_data, max_data,
     (src/operator/quantization/quantized_fully_connected.cc). data/weight
     int8; bias int8 with its own range, rescaled into the accumulator
     grid. Returns (int32 out, min_out, max_out)."""
-    import jax
-
     x = data
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
-    out = jax.lax.dot_general(
-        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)
+    sched = _kernel_schedule(
+        "int8_fc", lambda s: s.int8_fc_shape_key(
+            x.shape[0], x.shape[-1], weight.shape[0]))
+    out = _s8_matmul(x, weight,
+                     operand_width=sched.get("operand_width", "int8"))
     lo, hi, level = _s8s8_out_range(min_data, max_data, min_weight,
                                     max_weight)
     if bias is not None and not no_bias:
@@ -201,11 +278,12 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
     pad = pad if isinstance(pad, (tuple, list)) else _pair(pad or 0, sdims)
     dn = jax.lax.conv_dimension_numbers(
         data.shape, weight.shape, _conv_dn(data.ndim, layout))
-    out = jax.lax.conv_general_dilated(
-        data, weight, window_strides=stride, padding=_conv_pads(pad),
-        rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.int32)
+    sched = _kernel_schedule(
+        "int8_conv", lambda s: s.int8_conv_shape_key(
+            data.shape, weight.shape, stride))
+    out = _s8_conv(data, weight, stride, _conv_pads(pad), dilate, dn,
+                   num_group,
+                   operand_width=sched.get("operand_width", "int8"))
     lo, hi, level = _s8s8_out_range(min_data, max_data, min_weight,
                                     max_weight)
     if bias is not None and not no_bias:

@@ -124,9 +124,14 @@ def ring_attention_inner(q, k, v, axis_name="sp", causal=False, scale=None,
 
 
 @functools.lru_cache(maxsize=64)
-def _ring_fn(mesh, axis_name, causal, scale, impl, interpret):
+def _ring_fn(mesh, axis_name, causal, scale, impl, interpret,
+             sched_tag=""):
     """One jitted SPMD program per config — re-built closures would defeat
-    jax.jit's identity-keyed cache and recompile on every call."""
+    jax.jit's identity-keyed cache and recompile on every call.
+    ``sched_tag`` is the schedule-table digest (tune.table_digest()): the
+    per-hop flash kernel resolves its blocks from the table at trace
+    time, so a table change must re-key this cache instead of serving a
+    program built under the old schedule."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -143,13 +148,12 @@ def _ring_fn(mesh, axis_name, causal, scale, impl, interpret):
 
 
 def _pick_impl(impl, t_local, d, ring=True):
-    from ..ops.pallas_kernels import pallas_available, _BLOCK_Q
+    from ..ops.pallas_kernels import pallas_available
+    from ..tune import schedule as _tune_schedule
 
     if impl != "auto":
         return impl, False
-    bq = min(_BLOCK_Q, t_local)
-    shapes_ok = (t_local % bq == 0 and d <= 256)
-    if not shapes_ok:
+    if not _tune_schedule.flash_shape_supported(t_local, d):
         return "dense", False
     if pallas_available():
         return "flash", False
@@ -195,7 +199,14 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     chosen, auto_interp = _pick_impl(impl, t // n, raw[0].shape[3])
     interpret = interpret or auto_interp
     spec = P(None, None, axis_name, None)
-    fn = _ring_fn(mesh, axis_name, causal, scale, chosen, bool(interpret))
+    from ..tune import schedule as _tune_schedule
+
+    # fingerprint_token (not table_digest): the MXNET_TPU_AUTOTUNE kill
+    # switch collapses the token to '' exactly like the AOT cache key,
+    # so flipping it re-keys the cached jitted program too
+    fn = _ring_fn(mesh, axis_name, causal, scale, chosen, bool(interpret),
+                  _tune_schedule.fingerprint_token()
+                  if chosen == "flash" else "")
     arrs = [jax.device_put(a, NamedSharding(mesh, spec)) for a in raw]
     out = fn(*arrs)
     if hasattr(q, "_data"):

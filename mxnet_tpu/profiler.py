@@ -208,6 +208,11 @@ def dispatch_stats(reset=False, lock_timeout=None):
       perf_ledger_entries/perf_device_timings (perf attribution), and
       the alert engine's alert_evaluations/alert_transitions/
       alert_incidents_opened/alert_incidents_resolved
+    - kernel-autotuning counters (docs/autotune.md): autotune_searches/
+      autotune_candidates/autotune_rejected (measured schedule searches,
+      candidates timed, candidates killed by the numerics gate) and
+      autotune_table_hits/autotune_table_misses (kernel-builder schedule
+      lookups answered by the table vs the defaults)
 
     The snapshot (and an optional ``reset=True``) runs under the
     profiler lock, so two concurrent callers — or a caller racing
@@ -219,7 +224,7 @@ def dispatch_stats(reset=False, lock_timeout=None):
     because the stalled thread it is reporting on may be wedged while
     holding the profiler lock, and forensics beat atomicity there.
     """
-    from . import capture, engine, observability, resilience, serving
+    from . import capture, engine, observability, resilience, serving, tune
     from .contrib import quantization
     from .gluon.data import dataloader
     from .io import stream
@@ -239,6 +244,7 @@ def dispatch_stats(reset=False, lock_timeout=None):
         stats.update(capture.stats())
         stats.update(quantization.stats())
         stats.update(observability.stats())
+        stats.update(tune.stats())
         if reset and locked:
             _reset_dispatch_stats_locked()
     finally:
@@ -250,7 +256,7 @@ def dispatch_stats(reset=False, lock_timeout=None):
 def reset_dispatch_stats():
     """Zero all dispatch counters (registry + engine + resilience +
     serving + dataloader + stream + capture + quantization +
-    observability).
+    observability + tune).
     Takes the profiler lock so a concurrent ``dispatch_stats()`` sees
     either the pre-reset or the post-reset world, never a mix."""
     with _LOCK:
@@ -258,7 +264,7 @@ def reset_dispatch_stats():
 
 
 def _reset_dispatch_stats_locked():
-    from . import capture, engine, observability, resilience, serving
+    from . import capture, engine, observability, resilience, serving, tune
     from .contrib import quantization
     from .gluon.data import dataloader
     from .io import stream
@@ -274,6 +280,7 @@ def _reset_dispatch_stats_locked():
     capture.reset_stats()
     quantization.reset_stats()
     observability.reset_stats()
+    tune.reset_stats()
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):

@@ -1,0 +1,60 @@
+"""Kernel autotuning: measured schedule search for the Pallas/INT8 hot
+paths (ROADMAP item 5; TVM / TPU-MLIR, PAPERS.md).
+
+The package has three layers, all stdlib-only at import (jax loads
+lazily inside functions, like the rest of the runtime):
+
+- :mod:`~mxnet_tpu.tune.schedule` — the schedule *registry*: the
+  declared per-kernel search space, block legalization shared by the
+  flash-attention forward and backward, and the persistent schema-
+  versioned schedule table (``tools/schedule_table.json`` + the
+  ``MXNET_TPU_SCHEDULE_TABLE`` per-host override) that kernel builders
+  consult at trace time. Its content digest folds into the AOT cache
+  key (``capture.AOTCache.key``) so a schedule change can never
+  false-hit a stale compiled artifact.
+- :mod:`~mxnet_tpu.tune.measure` — the timing/validation substrate:
+  block-on-outputs + min-of-rounds wall timing (the PERF.md
+  dependency-chained discipline) and the numerics gate that rejects any
+  candidate whose outputs disagree with the reference schedule.
+- :mod:`~mxnet_tpu.tune.search` — the search driver: candidate
+  generation from the declared space, measured cost, winner
+  persistence, and one ``autotune`` flight-recorder event per run.
+
+``tools/autotune.py`` is the operator entrypoint (``--demo`` runs the
+whole loop on CPU/interpret). See docs/autotune.md.
+"""
+from __future__ import annotations
+
+# Flat counters, merged into profiler.dispatch_stats() (docs/autotune.md).
+_STATS = {
+    "autotune_searches": 0,        # measured searches actually run
+    "autotune_candidates": 0,      # candidates timed (validation passed)
+    "autotune_rejected": 0,        # candidates rejected by the numerics gate
+    "autotune_table_hits": 0,      # kernel-builder schedule-table hits
+    "autotune_table_misses": 0,    # lookups answered by the default schedule
+}
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+from .schedule import (  # noqa: E402
+    SCHEMA_VERSION, SEARCH_SPACE, ScheduleError, autotune_enabled,
+    fingerprint_token, flash_bwd_block, flash_fwd_blocks,
+    flash_shape_supported, kernel_schedule, legalize_block, load_table,
+    lookup, put_entry, table_digest, validate_table,
+)
+
+__all__ = [
+    "SCHEMA_VERSION", "SEARCH_SPACE", "ScheduleError", "autotune_enabled",
+    "fingerprint_token", "flash_bwd_block", "flash_fwd_blocks",
+    "flash_shape_supported", "kernel_schedule", "legalize_block",
+    "load_table", "lookup", "put_entry", "table_digest", "validate_table",
+    "stats", "reset_stats",
+]

@@ -1,0 +1,429 @@
+"""Kernel schedule registry: search space, block legalization, table.
+
+THE one home for Pallas block constants and kernel schedule choices
+(graftlint TS004 flags hardcoded block sizes anywhere else): the flash-
+attention forward/backward block sizes, the ring-attention per-hop
+blocks (the hop kernel IS the flash forward, keyed at the hop's local
+shape), and the INT8 conv/FC/requantize arrangement choices all resolve
+here at trace time, in this order:
+
+1. an explicit override from the caller (how the search driver times a
+   candidate without touching the table),
+2. the persistent schedule table — the committed
+   ``tools/schedule_table.json`` merged under the per-host
+   ``MXNET_TPU_SCHEDULE_TABLE`` override, keyed
+   ``kernel|backend|dtype|shape`` — when ``MXNET_TPU_AUTOTUNE`` is on,
+3. the declared default schedule,
+
+followed by *legalization* (shared by forward and backward): a block
+must divide the sequence length and sit on the TPU sublane grid
+(multiple of 8), with the single-block case (block == T) always legal —
+exactly the envelope the hand-written kernels supported, now centralized
+so a tuned or defaulted block can never silently drop a tail.
+
+The table's content digest (:func:`fingerprint_token`) folds into the
+AOT compile-cache key (``capture.AOTCache.key``): tuned programs
+warm-load fleet-wide from the compile cache, and a schedule change can
+never false-hit an artifact compiled under another schedule.
+
+This module is importable standalone (``tools/validate_baselines.py``
+loads it by file path to audit the table schema without jax or the
+package import).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+try:
+    from . import _STATS
+except ImportError:  # standalone (file-path) import: local counters
+    _STATS = {"autotune_table_hits": 0, "autotune_table_misses": 0}
+
+SCHEMA_VERSION = 1
+
+# TPU sublane granularity: a non-final block must sit on this grid or
+# Mosaic rejects the tile (docs/autotune.md "Legalization").
+MIN_SUBLANE = 8
+
+# The declared candidate axes per kernel — what the search driver sweeps
+# and what validate_table() accepts. Block axes are legal-subset-filtered
+# per shape at candidate-generation time.
+FLASH_BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
+SEARCH_SPACE = {
+    # Pallas streaming flash-attention forward (ops/pallas_kernels.py);
+    # also the ring-attention per-hop kernel, keyed at the hop's local
+    # (bh, t, d) shape (parallel/ring_attention.py)
+    "flash_fwd": {"block_q": FLASH_BLOCK_CANDIDATES,
+                  "block_k": FLASH_BLOCK_CANDIDATES},
+    # blockwise-recomputation backward (K-block scan width)
+    "flash_bwd": {"block_k": FLASH_BLOCK_CANDIDATES},
+    # INT8 GEMM / conv operand arrangement: feed the MXU int8 operands
+    # directly, or widen to int32 first (exact same integer results;
+    # which one the backend runs faster is a measured fact)
+    "int8_fc": {"operand_width": ("int8", "int32")},
+    "int8_conv": {"operand_width": ("int8", "int32")},
+    # requantize epilogue arrangement for calibrated boundaries: the
+    # reference two-multiply form, or one fused combined scale (may
+    # differ in the last ULP — the numerics gate decides per shape)
+    "int8_requant": {"path": ("via_fp32", "fused_scale")},
+}
+
+# What a kernel runs when the table has no entry — the hand-written
+# pre-autotune constants, so an empty table is bitwise the old behavior.
+DEFAULT_SCHEDULES = {
+    "flash_fwd": {"block_q": 128, "block_k": 128},
+    "flash_bwd": {"block_k": 128},
+    "int8_fc": {"operand_width": "int8"},
+    "int8_conv": {"operand_width": "int8"},
+    "int8_requant": {"path": "via_fp32"},
+}
+
+_LOCK = threading.Lock()
+_TABLE_CACHE: dict = {"stamp": None, "table": None}
+
+
+class ScheduleError(ValueError):
+    """No legal schedule for the requested shape (subclass of
+    ``ValueError`` so kernel callers' fallback paths keep working)."""
+
+
+# ----------------------------------------------------------- legalization
+
+def legalize_block(t, want):
+    """The largest legal block ``<= want`` for sequence length ``t``:
+    either ``t`` itself (a single block covering the whole sequence,
+    legal at any length), or a multiple of :data:`MIN_SUBLANE` that
+    divides ``t``. Returns None when no legal block exists — callers
+    raise :class:`ScheduleError` or fall back to the XLA composition."""
+    t = int(t)
+    want = int(want)
+    if t <= 0 or want <= 0:
+        return None
+    if want >= t:
+        return t
+    b = (min(want, t) // MIN_SUBLANE) * MIN_SUBLANE
+    while b >= MIN_SUBLANE:
+        if t % b == 0:
+            return b
+        b -= MIN_SUBLANE
+    return None
+
+
+def legal_flash_blocks(t, cap=None):
+    """The legal subset of :data:`FLASH_BLOCK_CANDIDATES` for length
+    ``t`` (plus the single-block ``t`` itself), largest first — the
+    candidate axis the search driver sweeps."""
+    t = int(t)
+    out = []
+    for b in FLASH_BLOCK_CANDIDATES:
+        if cap is not None and b > cap:
+            continue
+        if b == t or (b < t and t % b == 0):
+            out.append(b)
+    if t not in out and (cap is None or t <= cap):
+        out.insert(0, t)
+    return out
+
+
+def flash_shape_supported(t, d):
+    """Whether the Pallas flash kernel has ANY legal schedule for a
+    (T, D) shape — the shared gate ``parallel.ring_attention._pick_impl``
+    and the kernel entrypoints both consult."""
+    default = DEFAULT_SCHEDULES["flash_fwd"]["block_q"]
+    return int(d) <= 256 and legalize_block(t, default) is not None
+
+
+# ------------------------------------------------------------------ table
+
+def default_table_path():
+    """The committed schedule table: ``tools/schedule_table.json`` next
+    to the package (absent in installed trees — empty table)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "schedule_table.json")
+
+
+def host_table_path():
+    """Per-host override table (``MXNET_TPU_SCHEDULE_TABLE``), or None."""
+    p = os.environ.get("MXNET_TPU_SCHEDULE_TABLE", "").strip()
+    return p or None
+
+
+def autotune_enabled():
+    """``MXNET_TPU_AUTOTUNE=0`` is the kill switch: kernel builders run
+    the declared default schedules and ignore the table entirely."""
+    return os.environ.get("MXNET_TPU_AUTOTUNE", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _stamp():
+    """Cache stamp over the table sources: paths + mtime/size, so an
+    edited or re-pointed table is picked up without a process restart."""
+    parts = []
+    for p in (default_table_path(), host_table_path()):
+        if not p:
+            parts.append(("", 0, 0))
+            continue
+        try:
+            st = os.stat(p)
+            parts.append((p, st.st_mtime_ns, st.st_size))
+        except OSError:
+            parts.append((p, 0, -1))
+    return tuple(parts)
+
+
+def load_single_table(path):
+    """One table file -> its ``entries`` dict ({} on absent/unreadable/
+    wrong schema — a corrupt table must degrade to defaults, never
+    crash a kernel build)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or \
+            data.get("schema_version") != SCHEMA_VERSION:
+        return {}
+    entries = data.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def load_table(refresh=False):
+    """The merged entries view kernels read: committed table with the
+    per-host override's entries layered on top. Cached on file stamps."""
+    stamp = _stamp()
+    with _LOCK:
+        if not refresh and _TABLE_CACHE["stamp"] == stamp:
+            return _TABLE_CACHE["table"]
+    merged = dict(load_single_table(default_table_path()))
+    host = host_table_path()
+    if host:
+        merged.update(load_single_table(host))
+    with _LOCK:
+        _TABLE_CACHE["stamp"] = stamp
+        _TABLE_CACHE["table"] = merged
+    return merged
+
+
+def table_digest():
+    """Stable 16-hex content digest of the merged entries ('' when the
+    merged table is empty)."""
+    entries = load_table()
+    if not entries:
+        return ""
+    blob = json.dumps(entries, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def fingerprint_token():
+    """What the AOT cache key folds in: the merged-table digest, or ''
+    when autotuning is disabled OR the table is empty — both of which
+    compile the identical default-schedule programs, so they must share
+    cache identity."""
+    if not autotune_enabled():
+        return ""
+    return table_digest()
+
+
+def entry_key(kernel, shape_key, dtype, backend):
+    return f"{kernel}|{backend}|{dtype}|{shape_key}"
+
+
+def resolve_backend(interpret=False):
+    """The table's backend axis: 'interpret' for Pallas interpret mode
+    (CPU emulation — its measured costs must never steer a chip), else
+    the live jax backend."""
+    if interpret:
+        return "interpret"
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def lookup(kernel, shape_key, dtype, backend):
+    """Raw table lookup -> the entry's schedule dict or None. Counts
+    hits/misses (``autotune_table_hits``/``autotune_table_misses``)."""
+    entry = load_table().get(entry_key(kernel, shape_key, dtype, backend))
+    sched = entry.get("schedule") if isinstance(entry, dict) else None
+    if isinstance(sched, dict) and sched:
+        _STATS["autotune_table_hits"] += 1
+        return dict(sched)
+    _STATS["autotune_table_misses"] += 1
+    return None
+
+
+def kernel_schedule(kernel, shape_key, dtype, backend):
+    """The schedule a kernel builder runs: declared defaults, overlaid
+    with the table entry when autotuning is enabled."""
+    sched = dict(DEFAULT_SCHEDULES.get(kernel, {}))
+    if autotune_enabled():
+        hit = lookup(kernel, shape_key, dtype, backend)
+        if hit:
+            sched.update(hit)
+    return sched
+
+
+# ------------------------------------------------------------- shape keys
+# ONE owner for every kernel's table shape key: the kernel builders and
+# the search workloads both derive keys here, so a tuned entry can never
+# go dead because the two sides formatted the same shape differently.
+
+def flash_shape_key(bh, t, d):
+    return f"bh{int(bh)}-t{int(t)}-d{int(d)}"
+
+
+def int8_fc_shape_key(m, k, n):
+    return f"m{int(m)}-k{int(k)}-n{int(n)}"
+
+
+def int8_conv_shape_key(data_shape, weight_shape, stride):
+    return ("d" + "x".join(str(int(s)) for s in data_shape)
+            + "-w" + "x".join(str(int(s)) for s in weight_shape)
+            + "-s" + "x".join(str(int(s)) for s in stride))
+
+
+def int8_requant_shape_key(rows, cols):
+    return f"r{int(rows)}-c{int(cols)}"
+
+
+# ----------------------------------------------- flash-kernel resolution
+
+
+def flash_fwd_blocks(bh, t, d, dtype, interpret=False, block_q=None,
+                     block_k=None):
+    """Resolved + legalized (block_q, block_k) for the flash forward.
+    Explicit overrides must already be legal (the search driver's
+    contract); table/default blocks are legalized down. Raises
+    :class:`ScheduleError` when the shape has no legal schedule."""
+    t = int(t)
+    if int(d) > 256:
+        raise ScheduleError(f"flash schedule: unsupported D={d} (> 256)")
+    if block_q is not None or block_k is not None:
+        bq = int(block_q) if block_q is not None else None
+        bk = int(block_k) if block_k is not None else None
+        for name, b in (("block_q", bq), ("block_k", bk)):
+            if b is None:
+                continue
+            if b <= 0 or t % b != 0:
+                raise ScheduleError(
+                    f"flash schedule: explicit {name}={b} does not "
+                    f"divide T={t}")
+            # hold overrides to the SAME legality bar the resolver
+            # applies everywhere else: off-grid tiles fail here with a
+            # ScheduleError, not deep inside Mosaic on the chip
+            if b != t and b % MIN_SUBLANE != 0:
+                raise ScheduleError(
+                    f"flash schedule: explicit {name}={b} is off the "
+                    f"sublane grid (multiple of {MIN_SUBLANE}, or T "
+                    "itself)")
+    else:
+        bq = bk = None
+    if bq is None or bk is None:
+        sched = kernel_schedule("flash_fwd", flash_shape_key(bh, t, d),
+                                str(dtype), resolve_backend(interpret))
+        if bq is None:
+            bq = legalize_block(t, sched["block_q"])
+        if bk is None:
+            bk = legalize_block(t, sched["block_k"])
+    if bq is None or bk is None:
+        raise ScheduleError(
+            f"flash schedule: no legal block for T={t} (needs T itself "
+            f"or a multiple-of-{MIN_SUBLANE} divisor)")
+    return bq, bk
+
+
+def flash_bwd_block(bh, t, d, dtype, interpret=False, block_k=None):
+    """Resolved backward K-block width. Unlike the forward, any width in
+    [1, T] is legal — the blockwise backward pads the trailing partial
+    block and masks it (ops/pallas_kernels._flash_bwd_blockwise)."""
+    t = int(t)
+    if block_k is None:
+        sched = kernel_schedule("flash_bwd", flash_shape_key(bh, t, d),
+                                str(dtype), resolve_backend(interpret))
+        block_k = sched["block_k"]
+    return max(1, min(int(block_k), t))
+
+
+# -------------------------------------------------------------- persistence
+
+def put_entry(path, kernel, shape_key, dtype, backend, sched, **meta):
+    """Write/merge one tuned entry into the table at ``path``
+    (atomic tmp + rename; schema-versioned). Returns the entry key."""
+    entries = load_single_table(path)
+    key = entry_key(kernel, shape_key, dtype, backend)
+    rec = {"schedule": dict(sched)}
+    rec.update({k: v for k, v in sorted(meta.items()) if v is not None})
+    entries[key] = rec
+    data = {"schema_version": SCHEMA_VERSION,
+            "entries": {k: entries[k] for k in sorted(entries)}}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    with _LOCK:  # force a reload on next read even within one mtime tick
+        _TABLE_CACHE["stamp"] = None
+    return key
+
+
+def validate_table(data):
+    """Structural validation of a schedule-table store; returns problem
+    strings (empty = valid). Checked: schema version, the
+    ``kernel|backend|dtype|shape`` key format, known kernels, known
+    axes, and values drawn from the declared candidate space (block
+    axes accept any sane positive int — legalization may have landed
+    between named candidates)."""
+    problems = []
+    if not isinstance(data, dict):
+        return ["schedule table is not a JSON object"]
+    if data.get("schema_version") != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {data.get('schema_version')!r} != supported "
+            f"{SCHEMA_VERSION}")
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        problems.append("no 'entries' object")
+        return problems
+    for key, rec in sorted(entries.items()):
+        parts = key.split("|")
+        if len(parts) != 4 or not all(parts):
+            problems.append(
+                f"{key!r} is not a kernel|backend|dtype|shape key")
+            continue
+        kernel = parts[0]
+        axes = SEARCH_SPACE.get(kernel)
+        if axes is None:
+            problems.append(f"{key}: unknown kernel {kernel!r} "
+                            f"(known: {sorted(SEARCH_SPACE)})")
+            continue
+        sched = rec.get("schedule") if isinstance(rec, dict) else None
+        if not isinstance(sched, dict) or not sched:
+            problems.append(f"{key}: entry has no 'schedule' dict")
+            continue
+        for axis, val in sorted(sched.items()):
+            cands = axes.get(axis)
+            if cands is None:
+                problems.append(
+                    f"{key}: unknown schedule axis {axis!r} "
+                    f"(declared: {sorted(axes)})")
+            elif isinstance(cands[0], int):
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or not 1 <= val <= 65536:
+                    problems.append(
+                        f"{key}.{axis} is not a positive block size: "
+                        f"{val!r}")
+            elif val not in cands:
+                problems.append(
+                    f"{key}.{axis} value {val!r} not in the declared "
+                    f"candidate set {list(cands)}")
+    return problems

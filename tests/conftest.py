@@ -89,6 +89,13 @@ def pytest_configure(config):
         "bench gate carries the slow marker too")
     config.addinivalue_line(
         "markers",
+        "tune: measured kernel-schedule search — legalization, table "
+        "persistence, AOT re-keying, the autotune demo "
+        "(mxnet_tpu/tune/, tools/autotune.py, docs/autotune.md); fast "
+        "cases run in tier-1, the subprocess CLI contract carries the "
+        "slow marker too")
+    config.addinivalue_line(
+        "markers",
         "numerics: in-graph numerics telemetry inside the captured "
         "step — divergence sentinels, snapshots, first-bad-layer "
         "bisection (mxnet_tpu/observability/numerics.py, "

@@ -1,0 +1,24 @@
+"""TS004 fixture: hardcoded Pallas block schedules outside the schedule
+registry — exactly two findings (one *BLOCK* module constant, one
+literal BlockSpec tile), everything else a clean near-miss."""
+
+_BLOCK_Q = 128                 # FIRES: module-level block constant
+
+_BLOCK_FROM_TABLE = None       # clean: not an integer literal
+_NEG = -1e30                   # clean: no BLOCK in the name
+SMALL_BLOCK_PAD = 2            # clean: below the tile floor
+kb = 128                       # clean: lowercase, not the constant idiom
+
+
+def lookup_blocks(sched):
+    # clean: blocks resolved from the schedule registry, not literals
+    bq = sched["block_q"]
+    return bq
+
+
+def build(pl, d, bq):
+    spec = pl.BlockSpec((1, 128, d), lambda b, i, kb: (b, i, 0))  # FIRES
+    structural = pl.BlockSpec((3, 3, d, d), lambda i: (0, 0, 0, 0))  # clean
+    dynamic = pl.BlockSpec((1, bq, d), lambda b, i, kb: (b, i, 0))  # clean
+    waived = pl.BlockSpec((1, 256, d), lambda b, i, kb: (b, i, 0))  # graftlint: disable=TS004
+    return spec, structural, dynamic, waived

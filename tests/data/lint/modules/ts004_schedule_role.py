@@ -1,0 +1,10 @@
+# graftlint: role=schedule
+"""TS004 near-miss: the schedule registry itself (role=schedule) is the
+sanctioned home for block constants — zero findings here."""
+
+_BLOCK_Q_DEFAULT = 128
+FLASH_BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
+
+
+def default_blocks(t):
+    return min(_BLOCK_Q_DEFAULT, t)

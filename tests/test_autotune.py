@@ -1,0 +1,565 @@
+"""Kernel autotuning: schedule registry, legalization, measured search,
+table persistence, AOT-fingerprint interaction, and the demo contract
+(marker: tune; docs/autotune.md).
+
+The safety properties under test:
+- numerics: flash attention is numerically identical (fwd + grad,
+  causal and not) across legal schedule candidates, and the search
+  driver REJECTS a candidate whose outputs disagree — tuning can never
+  change results;
+- tails: a backward block that does not divide T pads and masks
+  instead of silently dropping the tail (regression: odd T);
+- identity: a schedule-table change re-keys the AOT compile cache (no
+  stale artifact hit), an unchanged table reuses the cached executable
+  bit-for-bit.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (fixes the jax platform first)
+from mxnet_tpu import capture, tune
+from mxnet_tpu.tune import measure, schedule, search
+
+pytestmark = pytest.mark.tune
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ legalization
+
+def test_legalize_block_rules():
+    # divisor on the sublane grid, largest first
+    assert schedule.legalize_block(256, 128) == 128
+    assert schedule.legalize_block(256, 64) == 64
+    assert schedule.legalize_block(192, 128) == 96
+    assert schedule.legalize_block(200, 128) == 40
+    # single block covers any length when the cap allows
+    assert schedule.legalize_block(65, 128) == 65
+    assert schedule.legalize_block(4, 128) == 4
+    # no legal block: T > cap and no sublane divisor
+    assert schedule.legalize_block(130, 128) is None
+    assert schedule.legalize_block(0, 128) is None
+
+
+def test_legal_flash_blocks_subset():
+    assert schedule.legal_flash_blocks(256) == [256, 128, 64, 32, 16, 8]
+    assert schedule.legal_flash_blocks(96) == [96, 32, 16, 8]
+    assert 65 in schedule.legal_flash_blocks(65)  # single block only
+    assert schedule.legal_flash_blocks(65)[1:] == []
+
+
+def test_flash_shape_supported_gate():
+    assert schedule.flash_shape_supported(256, 64)
+    assert schedule.flash_shape_supported(65, 64)   # single block
+    assert not schedule.flash_shape_supported(130, 64)
+    assert not schedule.flash_shape_supported(256, 512)  # D > 256
+
+
+def test_explicit_override_must_divide():
+    with pytest.raises(ValueError):
+        schedule.flash_fwd_blocks(2, 256, 32, "float32", interpret=True,
+                                  block_q=48)
+    # divides T but sits OFF the sublane grid: must fail at the
+    # ScheduleError boundary, not deep inside Mosaic on a chip
+    with pytest.raises(ValueError):
+        schedule.flash_fwd_blocks(2, 200, 32, "float32", interpret=True,
+                                  block_q=25)
+    # the single-block exception applies to overrides too
+    assert schedule.flash_fwd_blocks(
+        1, 65, 32, "float32", interpret=True,
+        block_q=65, block_k=65) == (65, 65)
+    assert schedule.flash_fwd_blocks(
+        2, 256, 32, "float32", interpret=True,
+        block_q=64, block_k=32) == (64, 32)
+
+
+# ----------------------------------------------- candidate numerics parity
+
+def _qkv(b, h, t, d, seed=0):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    return [jnp.asarray(rs.randn(b, h, t, d).astype(np.float32) * 0.3)
+            for _ in range(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_identical_across_schedules(causal):
+    """THE tuner safety property: fwd output and all three grads agree
+    across legal schedule candidates (within f32 block-reorder
+    tolerance), so a table change can never change results."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import (flash_attention,
+                                              flash_attention_with_grad)
+
+    q, k, v = _qkv(1, 2, 128, 16, seed=3)
+    candidates = [(128, 128), (64, 128), (128, 64), (32, 32), (16, 64)]
+
+    ref_out = None
+    ref_g = None
+    for bq, bk in candidates:
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              block_q=bq, block_k=bk)
+
+        def loss(q_, k_, v_, bq=bq, bk=bk):
+            o = flash_attention_with_grad(
+                q_, k_, v_, causal=causal, interpret=True,
+                block_q=bq, block_k=bk, bwd_block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        if ref_out is None:
+            ref_out, ref_g = out, g
+            continue
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"fwd {bq}x{bk}")
+        for a, b, name in zip(g, ref_g, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"grad {name} {bq}x{bk}")
+
+
+def test_flash_bwd_nondivisible_block_pads_tail():
+    """Regression (ISSUE 15 satellite): `_flash_bwd_blockwise` used to
+    compute n_kb = t // block_k and silently DROP the tail for
+    non-dividing blocks. Odd T with a forced small block must match
+    dense autodiff exactly like the dividing case."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_with_grad
+
+    t, d = 33, 8
+    q, k, v = _qkv(1, 1, t, d, seed=5)
+
+    def loss_flash(q_, k_, v_, bk=None):
+        out = flash_attention_with_grad(q_, k_, v_, causal=True,
+                                        interpret=True, bwd_block_k=bk)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_dense(q_, k_, v_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) / np.sqrt(d)
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -1e30)
+        w = jax.nn.softmax(s, -1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", w, v_) ** 2)
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for bk in (8, 4, t):  # 33 % 8 = 1, 33 % 4 = 1 — both padded paths
+        gf = jax.grad(lambda a, b, c: loss_flash(a, b, c, bk=bk),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gd, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4,
+                                       err_msg=f"grad {name} bk={bk}")
+
+
+def test_int8_operand_width_exactly_equal():
+    """The int8 operand-width axis is EXACT by construction (same
+    integer arithmetic, different backend kernel selection)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.quantization import _s8_conv, _s8_matmul
+
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randint(-127, 128, (4, 32)).astype(np.int8))
+    w = jnp.asarray(rs.randint(-127, 128, (16, 32)).astype(np.int8))
+    a = np.asarray(_s8_matmul(x, w, operand_width="int8"))
+    b = np.asarray(_s8_matmul(x, w, operand_width="int32"))
+    assert a.dtype == b.dtype == np.int32
+    assert np.array_equal(a, b)
+
+    xc = jnp.asarray(rs.randint(-127, 128, (2, 8, 6, 6)).astype(np.int8))
+    wc = jnp.asarray(rs.randint(-127, 128, (4, 8, 3, 3)).astype(np.int8))
+    dn = jax.lax.conv_dimension_numbers(xc.shape, wc.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    ca = np.asarray(_s8_conv(xc, wc, (1, 1), ((1, 1), (1, 1)), (1, 1),
+                             dn, 1, operand_width="int8"))
+    cb = np.asarray(_s8_conv(xc, wc, (1, 1), ((1, 1), (1, 1)), (1, 1),
+                             dn, 1, operand_width="int32"))
+    assert np.array_equal(ca, cb)
+
+
+# --------------------------------------------------------- table semantics
+
+def test_table_roundtrip_and_validation(tmp_path):
+    tbl = str(tmp_path / "table.json")
+    key = schedule.put_entry(tbl, "flash_fwd", "bh2-t256-d32", "float32",
+                             "interpret", {"block_q": 64, "block_k": 128},
+                             margin_pct=12.5)
+    assert key == "flash_fwd|interpret|float32|bh2-t256-d32"
+    data = json.load(open(tbl))
+    assert schedule.validate_table(data) == []
+    assert data["schema_version"] == schedule.SCHEMA_VERSION
+    assert data["entries"][key]["schedule"] == {"block_q": 64,
+                                                "block_k": 128}
+
+    # corrupt variants each name a problem
+    assert schedule.validate_table([]) != []
+    assert any("schema_version" in p for p in schedule.validate_table(
+        {"schema_version": 99, "entries": {}}))
+    bad = {"schema_version": 1, "entries": {"nokey": {"schedule": {}}}}
+    assert any("kernel|backend|dtype|shape" in p
+               for p in schedule.validate_table(bad))
+    bad = {"schema_version": 1, "entries": {
+        "mystery|cpu|int8|s": {"schedule": {"x": 1}}}}
+    assert any("unknown kernel" in p for p in schedule.validate_table(bad))
+    bad = {"schema_version": 1, "entries": {
+        "flash_fwd|cpu|float32|s": {"schedule": {"warp": 4}}}}
+    assert any("unknown schedule axis" in p
+               for p in schedule.validate_table(bad))
+    bad = {"schema_version": 1, "entries": {
+        "int8_fc|cpu|int8|s": {"schedule": {"operand_width": "int7"}}}}
+    assert any("candidate set" in p for p in schedule.validate_table(bad))
+
+
+def test_table_feeds_kernel_builders(tmp_path, monkeypatch):
+    """A per-host table entry steers the flash builder (counted as a
+    table hit) and the kernel still matches the default schedule."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+    tbl = str(tmp_path / "host.json")
+    schedule.put_entry(tbl, "flash_fwd", "bh2-t128-d16", "float32",
+                       "interpret", {"block_q": 32, "block_k": 64})
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", tbl)
+    tune.reset_stats()
+    assert schedule.flash_fwd_blocks(2, 128, 16, "float32",
+                                     interpret=True) == (32, 64)
+    assert tune.stats()["autotune_table_hits"] == 1
+
+    q, k, v = _qkv(1, 2, 128, 16, seed=1)
+    tuned = flash_attention(q, k, v, causal=True, interpret=True)
+    monkeypatch.delenv("MXNET_TPU_SCHEDULE_TABLE")
+    default = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(default),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_int8_table_entries_reach_the_kernels(tmp_path, monkeypatch):
+    """Closure between the search workloads and the registered int8 ops:
+    an entry persisted under a WORKLOAD's shape key must be the entry
+    the KERNEL's trace-time lookup hits (review regression: the conv
+    sides once formatted the same shape differently, so tuned conv
+    wins were silently dead weight in the table)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.quantization import (_quantized_conv,
+                                            _quantized_fully_connected,
+                                            _requantize)
+
+    backend = schedule.resolve_backend(False)
+    tbl = str(tmp_path / "host.json")
+    fc_wl = search.int8_fc_workload(m=4, k=16, n=8)
+    conv_wl = search.int8_conv_workload(n=2, c=4, hw=6, o=8)
+    rq_wl = search.int8_requant_workload(rows=4, cols=8)
+    for wl, sched in ((fc_wl, {"operand_width": "int32"}),
+                      (conv_wl, {"operand_width": "int32"}),
+                      (rq_wl, {"path": "fused_scale"})):
+        schedule.put_entry(tbl, wl.kernel, wl.shape_key, "int8",
+                           backend, sched)
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", tbl)
+
+    rs = np.random.RandomState(1)
+    lo = jnp.asarray(-1.0, jnp.float32)
+    hi = jnp.asarray(1.0, jnp.float32)
+
+    tune.reset_stats()
+    x = jnp.asarray(rs.randint(-127, 128, (4, 16)).astype(np.int8))
+    w = jnp.asarray(rs.randint(-127, 128, (8, 16)).astype(np.int8))
+    _quantized_fully_connected(x, w, None, lo, hi, lo, hi, no_bias=True)
+    assert tune.stats()["autotune_table_hits"] == 1
+
+    tune.reset_stats()
+    xc = jnp.asarray(rs.randint(-127, 128, (2, 4, 6, 6)).astype(np.int8))
+    wc = jnp.asarray(rs.randint(-127, 128, (8, 4, 3, 3)).astype(np.int8))
+    _quantized_conv(xc, wc, None, lo, hi, lo, hi, stride=(1, 1),
+                    pad=(1, 1), no_bias=True)
+    assert tune.stats()["autotune_table_hits"] == 1
+
+    tune.reset_stats()
+    acc = jnp.asarray(
+        rs.randint(-2 ** 28, 2 ** 28, (4, 8)).astype(np.int32))
+    _requantize(acc, lo, hi, min_calib_range=-0.9, max_calib_range=0.9)
+    assert tune.stats()["autotune_table_hits"] == 1
+
+
+def test_autotune_kill_switch(tmp_path, monkeypatch):
+    tbl = str(tmp_path / "host.json")
+    schedule.put_entry(tbl, "flash_fwd", "bh2-t128-d16", "float32",
+                       "interpret", {"block_q": 32, "block_k": 64})
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", tbl)
+    monkeypatch.setenv("MXNET_TPU_AUTOTUNE", "0")
+    # table ignored -> legalized defaults; and the AOT token collapses
+    # to '' (default programs share cache identity with no-table hosts)
+    assert schedule.flash_fwd_blocks(2, 128, 16, "float32",
+                                     interpret=True) == (128, 128)
+    assert schedule.fingerprint_token() == ""
+    monkeypatch.setenv("MXNET_TPU_AUTOTUNE", "1")
+    assert schedule.fingerprint_token() != ""
+
+
+def test_counters_reach_profiler():
+    from mxnet_tpu import profiler
+
+    s = profiler.dispatch_stats()
+    for k in tune._STATS:
+        assert k in s, k
+
+
+# ------------------------------------------------------------- the search
+
+def _toy_workload(tmp_ignored, bad_candidate=False):
+    """Synthetic workload driving the gate logic: candidate 'b' returns
+    WRONG outputs and must be rejected; 'c' is valid and faster-ish."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(64, dtype=jnp.float32)
+
+    def build(sched):
+        mode = sched["operand_width"]
+        if mode == "int8":          # reference
+            fn = jax.jit(lambda x: (x * 2.0).sum())
+        elif mode == "int32":       # equal value, different arrangement
+            fn = jax.jit(lambda x: (x + x).sum())
+        return fn, (x,)
+
+    def build_bad(sched):
+        if sched["operand_width"] == "int32":
+            return jax.jit(lambda x: (x * 3.0).sum()), (x,)
+        return build(sched)
+
+    return search.Workload(
+        "int8_fc", "toy", "float32", "test",
+        build_bad if bad_candidate else build,
+        [{"operand_width": "int8"}, {"operand_width": "int32"}])
+
+
+def test_search_rejects_wrong_candidate(tmp_path):
+    from mxnet_tpu.observability import flight
+
+    tbl = str(tmp_path / "t.json")
+    tune.reset_stats()
+    mark = flight.last_seq()
+    res = search.run_search(_toy_workload(tbl, bad_candidate=True), tbl,
+                            rounds=1, iters=2)
+    assert res["rejected"] == 1
+    assert res["winner"] == {"operand_width": "int8"}  # only the ref
+    assert tune.stats()["autotune_rejected"] == 1
+    assert tune.stats()["autotune_searches"] == 1
+    # one autotune flight event carries winner + margin
+    evs = flight.events(kind="autotune", since_seq=mark)
+    assert len(evs) == 1
+    assert evs[0]["winner"] == {"operand_width": "int8"}
+    assert "margin_pct" in evs[0] and evs[0]["rejected"] == 1
+
+
+def test_search_warm_skip_and_force(tmp_path):
+    tbl = str(tmp_path / "t.json")
+    res = search.run_search(_toy_workload(tbl), tbl, rounds=1, iters=2)
+    assert not res["skipped"] and res["rejected"] == 0
+    res2 = search.run_search(_toy_workload(tbl), tbl)
+    assert res2["skipped"]
+    res3 = search.run_search(_toy_workload(tbl), tbl, rounds=1, iters=2,
+                             force=True)
+    assert not res3["skipped"]
+
+
+def test_outputs_match_semantics():
+    ok, _ = measure.outputs_match(np.float32([1.0, 2.0]),
+                                  np.float32([1.0, 2.0 + 1e-6]))
+    assert ok
+    ok, _ = measure.outputs_match(np.float32([1.0]), np.float32([1.1]))
+    assert not ok
+    ok, _ = measure.outputs_match(np.int32([1, 2]), np.int32([1, 3]))
+    assert not ok  # integer grids are exact
+    ok, _ = measure.outputs_match(np.int32([1]), np.float32([1.0]))
+    assert not ok  # dtype is identity
+
+
+# ------------------------------------------------- AOT fingerprint re-key
+
+def test_schedule_table_rekeys_aot_cache(tmp_path, monkeypatch):
+    """Acceptance: a schedule-table change re-keys the AOT fingerprint
+    (no stale compile-cache hit); an unchanged table + shapes reuses
+    the cached executable bit-for-bit."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "cache"))
+    tbl = str(tmp_path / "host.json")
+
+    def f(a, b):
+        return (a * b + 1.0).sum()
+
+    args = (jnp.ones((4, 4)), jnp.ones((4, 4)))
+
+    capture.reset_stats()
+    ex = capture.aot_compile(f, label="t", fingerprint="fp",
+                             example_args=args)
+    cold = np.asarray(ex(*args))
+    assert capture.stats()["aot_cache_writes"] == 1
+
+    # unchanged world -> warm hit, bit-for-bit
+    capture.reset_stats()
+    ex2 = capture.aot_compile(f, label="t", fingerprint="fp",
+                              example_args=args)
+    s = capture.stats()
+    assert s["aot_cache_hits"] == 1 and s["aot_cache_misses"] == 0
+    assert np.array_equal(cold, np.asarray(ex2(*args)))
+
+    # a schedule table appears -> key changes -> miss + fresh store
+    schedule.put_entry(tbl, "flash_fwd", "bh2-t128-d16", "float32",
+                       "interpret", {"block_q": 64, "block_k": 64})
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", tbl)
+    capture.reset_stats()
+    capture.aot_compile(f, label="t", fingerprint="fp", example_args=args)
+    s = capture.stats()
+    assert s["aot_cache_misses"] == 1 and s["aot_cache_hits"] == 0
+
+    # same table content -> warm again
+    capture.reset_stats()
+    capture.aot_compile(f, label="t", fingerprint="fp", example_args=args)
+    assert capture.stats()["aot_cache_hits"] == 1
+
+    # an EDIT to the table -> re-key again
+    schedule.put_entry(tbl, "flash_fwd", "bh2-t128-d16", "float32",
+                       "interpret", {"block_q": 32, "block_k": 64})
+    capture.reset_stats()
+    capture.aot_compile(f, label="t", fingerprint="fp", example_args=args)
+    s = capture.stats()
+    assert s["aot_cache_misses"] == 1 and s["aot_cache_hits"] == 0
+
+
+def test_ring_fn_cache_keys_on_table_digest(tmp_path, monkeypatch):
+    """The in-process jitted ring-attention program re-keys when the
+    table changes (the per-hop flash blocks resolve at trace time), and
+    the re-traced program agrees numerically — a table edit can change
+    the schedule, never the results."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import ring as ra
+
+    import jax
+
+    tbl = str(tmp_path / "host.json")
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", tbl)
+    mesh = parallel.create_mesh({"sp": 2}, jax.devices()[:2])
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 128, 16).astype(np.float32) * 0.3)
+
+    info0 = ra._ring_fn.cache_info()
+    out1 = ra.ring_attention(q, q, q, mesh=mesh, causal=True,
+                             impl="flash", interpret=True)
+    # tune the hop shape (t_local = 64) -> digest moves -> fresh program
+    schedule.put_entry(tbl, "flash_fwd", "bh1-t64-d16", "float32",
+                       "interpret", {"block_q": 32, "block_k": 32})
+    out2 = ra.ring_attention(q, q, q, mesh=mesh, causal=True,
+                             impl="flash", interpret=True)
+    info1 = ra._ring_fn.cache_info()
+    assert info1.misses - info0.misses == 2
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=2e-5, atol=2e-5)
+    # the kill switch collapses the tag too (review regression: the
+    # cached tuned program must not survive MXNET_TPU_AUTOTUNE=0)
+    monkeypatch.setenv("MXNET_TPU_AUTOTUNE", "0")
+    out3 = ra.ring_attention(q, q, q, mesh=mesh, causal=True,
+                             impl="flash", interpret=True)
+    info2 = ra._ring_fn.cache_info()
+    assert info2.misses - info1.misses == 1
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out3),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ----------------------------------------------------------- demo contract
+
+def _autotune_main():
+    spec = importlib.util.spec_from_file_location(
+        "autotune_under_test", os.path.join(ROOT, "tools", "autotune.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_autotune_demo_cold_then_warm(tmp_path, monkeypatch, capsys):
+    """Acceptance: --demo runs end-to-end on CPU/interpret (candidate
+    generation -> numerics validation -> winner persisted) and a second
+    run does ZERO searches because the table is warm."""
+    tbl = str(tmp_path / "demo.json")
+    monkeypatch.delenv("MXNET_TPU_SCHEDULE_TABLE", raising=False)
+    mod = _autotune_main()
+    assert mod.main(["--demo", "--table", tbl]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "autotune_searches"
+    assert out["value"] == 6 and out["extra"]["errors"] == 0
+    data = json.load(open(tbl))
+    assert schedule.validate_table(data) == []
+    assert len(data["entries"]) == 6
+    # the sweep covers flash fwd/bwd, the ring hop shape, and int8
+    kernels = {k.split("|")[0] for k in data["entries"]}
+    assert kernels == {"flash_fwd", "flash_bwd", "int8_fc", "int8_conv",
+                       "int8_requant"}
+    labels = {r["label"] for r in out["extra"]["results"]}
+    assert "ring_hop" in labels
+
+    # warm second run: zero searches, all skipped
+    assert mod.main(["--demo", "--table", tbl]) == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["value"] == 0
+    assert out2["extra"]["skipped_warm"] == 6
+
+
+@pytest.mark.slow
+def test_autotune_demo_cli_contract(tmp_path):
+    """Subprocess contract: one JSON line on stdout, exit 0."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXNET_TPU_SCHEDULE_TABLE", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "autotune.py"),
+         "--demo", "--table", str(tmp_path / "cli.json")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "autotune_searches" and out["value"] == 6
+
+
+def test_validate_baselines_schedule_table_cli(tmp_path):
+    """tools/validate_baselines.py --schedule-table audits the table
+    offline (no jax import needed for the check itself)."""
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "schema_version": 1,
+        "entries": {"mystery|cpu|int8|s": {"schedule": {"x": 1}}}}))
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "validate_baselines.py"),
+         "--schedule-table", str(bad),
+         "--report", str(tmp_path / "rep.json")],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode != 0
+    rep = json.load(open(tmp_path / "rep.json"))
+    [res] = [x for x in rep["results"] if x["name"] == "schedule_table"]
+    assert res["status"] == "failed" and res["problems"]
+
+    # the committed table passes
+    r2 = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "validate_baselines.py"),
+         "--schedule-table",
+         "--report", str(tmp_path / "rep2.json")],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r2.returncode == 0, r2.stdout + r2.stderr

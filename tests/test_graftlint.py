@@ -78,6 +78,18 @@ def test_ts003_exact(fixture_findings):
     assert got == [("TS003", "dispatch_donated", "arrays")], got
 
 
+def test_ts004_exact(fixture_findings):
+    # one hardcoded *BLOCK* module constant and one literal BlockSpec
+    # tile fire; structural dims (< 16), schedule-resolved blocks, the
+    # waived BlockSpec and the role=schedule module stay clean
+    got = _in_file(fixture_findings, "ts004_block_hardcode.py")
+    assert got == sorted([
+        ("TS004", "<module>", "_BLOCK_Q"),
+        ("TS004", "build", "BlockSpec:128"),
+    ]), got
+    assert _in_file(fixture_findings, "ts004_schedule_role.py") == []
+
+
 def test_cc001_exact_and_waiver(fixture_findings):
     # the locked, counter-dict, import-time and waived mutations are
     # silent; only the unlocked one fires
@@ -255,6 +267,7 @@ def test_no_unexpected_fixture_findings(fixture_findings):
     # claimed by one of the per-rule assertions above
     claimed = {"ts001_host_sync.py": 9, "ts002_raw_jit.py": 3,
                "ts002_capture.py": 1, "ts003_donated_read.py": 1,
+               "ts004_block_hardcode.py": 2,
                "cc001_unlocked.py": 1, "cc002_lock_order.py": 1,
                "cc003_unjoined.py": 1, "rd002_counter_drift.py": 1,
                "rd004_obs_drift.py": 2, "rd005_perf_drift.py": 1,

@@ -58,9 +58,27 @@ def test_small_sequence_single_block():
 def test_rejects_unsupported_shapes():
     import jax.numpy as jnp
 
-    q = jnp.zeros((1, 1, 200, 64))  # not divisible by the 128 block
+    # T=130 has no legal block: > 128 (no single block) and its only
+    # divisors (65, 26, 13, ...) are off the sublane grid
+    q = jnp.zeros((1, 1, 130, 64))
     with pytest.raises(ValueError):
         flash_attention(q, q, q)
+
+
+def test_legalized_nondivisible_t():
+    """T=200 used to be rejected (not a multiple of the hardcoded 128
+    block); the centralized legalizer now picks the largest
+    multiple-of-8 divisor (40) and the kernel matches dense."""
+    import jax.numpy as jnp
+
+    q, k, v = _qkv(B=1, H=1, T=200, D=32, seed=7)
+    from mxnet_tpu.tune.schedule import legalize_block
+
+    assert legalize_block(200, 128) == 40
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _dense(q, k, v, True),
+                               atol=1e-5)
 
 
 def test_cross_attention_rejected():

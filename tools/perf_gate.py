@@ -195,6 +195,16 @@ def _collect_once(steps, trials):
         measured["numerics_tap@capture"] = {"step_ms": tap_ms}
         measured["stream_ingest@host_pipeline"] = {
             "step_ms": _measure_stream_ingest(steps, trials)}
+        # the tuned Pallas flash kernels ride fixed keys too
+        # (docs/autotune.md): the schedule table steers their blocks at
+        # trace time, so these keys deliberately do NOT re-key with the
+        # table — the gate watches the kernels' wall-time trajectory
+        # ACROSS schedule changes (a tuned table that slows the kernel
+        # fails here like any compute regression)
+        measured["flash_attn_fwd@tuned"] = {
+            "step_ms": _measure_flash(trials, bwd=False)}
+        measured["flash_attn_bwd@tuned"] = {
+            "step_ms": _measure_flash(trials, bwd=True)}
         return measured
     finally:
         if saved_cache is not None:
@@ -240,6 +250,43 @@ def _measure_stream_ingest(steps, trials):
         return stream_ms
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
+
+
+def _measure_flash(trials, bwd, steps=5):
+    """Best-of-N wall ms for the schedule-resolved flash-attention
+    forward (or forward+backward) at a fixed shape — Pallas interpret
+    mode off-chip, the real kernel on a TPU host. Blocks resolve
+    through the schedule table exactly as production callers' do."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    interpret = not pk.pallas_available()
+    rs = np.random.RandomState(7)
+    q, k, v = [jnp.asarray(rs.randn(1, 2, 256, 32).astype(np.float32) * 0.3)
+               for _ in range(3)]
+    if bwd:
+        def loss(q, k, v):
+            out = pk.flash_attention_with_grad(q, k, v, causal=True,
+                                               interpret=interpret)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    else:
+        fn = jax.jit(lambda q, k, v: pk.flash_attention(
+            q, k, v, causal=True, interpret=interpret))
+    jax.block_until_ready(fn(q, k, v))  # warmup absorbs trace+compile
+    best = 1e9
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = None
+        for _k in range(steps):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / steps * 1e3)
+    return best
 
 
 def compare(current, baseline_entries, tolerance_pct=None,

@@ -33,6 +33,13 @@ schema-version and key-schema checks plus per-entry structure, via
 ``tools/perf_gate.py``'s ``validate_baseline``. A fingerprint-schema
 change therefore fails HERE, loudly, instead of silently orphaning
 every key the perf gate would ever compare against.
+
+``--schedule-table [PATH]`` likewise audits the kernel schedule table
+(``tools/schedule_table.json``, docs/autotune.md) offline through
+``mxnet_tpu/tune/schedule.py``'s ``validate_table`` (loaded by file
+path — no jax, no package import): schema version, the
+``kernel|backend|dtype|shape`` key format, known kernels/axes, and
+values drawn from the declared candidate space.
 """
 from __future__ import annotations
 
@@ -152,6 +159,36 @@ def check_perf_baseline(path):
     }
 
 
+def check_schedule_table(path):
+    """Validate the kernel schedule table at ``path`` through the tune
+    subsystem's schema knowledge; returns a report-result dict."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tune_schedule",
+        os.path.join(REPO, "mxnet_tpu", "tune", "schedule.py"))
+    sched = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sched)
+    if not os.path.isfile(path):
+        problems = [f"schedule table {path} does not exist "
+                    "(run tools/autotune.py to create it)"]
+    else:
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            data, problems = None, [f"cannot read schedule table {path}: {e}"]
+        else:
+            problems = sched.validate_table(data)
+    return {
+        "name": "schedule_table",
+        "status": "passed" if not problems else "failed",
+        "path": path,
+        "problems": problems,
+        "reference": "docs/autotune.md (table schema)",
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -176,6 +213,13 @@ def main():
                     help="validate the perf-regression baseline store "
                          "(schema/key-schema/entry checks; default "
                          "tools/perf_baseline.json)")
+    ap.add_argument("--schedule-table", nargs="?", metavar="PATH",
+                    const=os.path.join(REPO, "tools",
+                                       "schedule_table.json"),
+                    default=None,
+                    help="validate the kernel schedule table "
+                         "(schema/key/axis/candidate checks; default "
+                         "tools/schedule_table.json)")
     args = ap.parse_args()
 
     candidates = [
@@ -194,6 +238,13 @@ def main():
         report["results"].append(res)
         report["all_passed"] &= res["status"] == "passed"
         print(f"== perf_baseline: {res['status']}"
+              + "".join(f"\n   ! {p}" for p in res["problems"]),
+              flush=True)
+    if args.schedule_table is not None:
+        res = check_schedule_table(args.schedule_table)
+        report["results"].append(res)
+        report["all_passed"] &= res["status"] == "passed"
+        print(f"== schedule_table: {res['status']}"
               + "".join(f"\n   ! {p}" for p in res["problems"]),
               flush=True)
     for path, build in candidates:
