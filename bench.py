@@ -12,6 +12,15 @@ Analysis (stderr): per-config img/s and MFU against the v5e bf16 peak
 (tools/stream_bench.py): a dp=8 synthetic-decode training run gated on
 ``mxnet_tpu_input_stall_fraction`` <= 0.05 with device prefetch on and
 > 0.2 with it off (docs/data.md).
+
+``--model=transformer`` switches to the dp×fsdp×tp transformer
+pretraining bench (docs/parallel.md): a model-zoo decoder-only LM,
+SpecLayout-sharded, trained in bf16 through ONE donated captured
+executable per step with dependency-chained device timing on, so the
+reported MFU is read back from the perf ledger's ``mxnet_tpu_mfu``
+gauge (observability/perf.py) rather than re-derived from an analytic
+flop count. Gated against TRANSFORMER_MFU_FLOOR; the companion
+regression key is ``transformer_step@tuned`` in tools/perf_gate.py.
 """
 from __future__ import annotations
 
@@ -22,6 +31,13 @@ import time
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
 V5E_BF16_PEAK = 197e12
 BASELINE_IMG_S = 109.0  # reference K80 img/s, bs=32
+
+# MFU floor for --model=transformer. The gauge divides XLA-analyzed
+# flops by dependency-chained device wall against the backend's nominal
+# peak (observability/perf.py), so even the CI-sized CPU config clears
+# this by orders of magnitude; a step that stops overlapping or silently
+# falls off the captured path lands under it.
+TRANSFORMER_MFU_FLOOR = 1e-4
 
 
 def _throughput(trainer, x, y, iters, warmup=2, step=None):
@@ -135,6 +151,107 @@ def main(capture_mode=False):
     print(json.dumps(out))
 
 
+def main_transformer(capture_mode=True):
+    """dp×fsdp×tp transformer pretraining at measured MFU.
+
+    Must set the virtual-device flag before jax initializes (the 2x2x2
+    mesh needs 8 devices on a CPU host). The step count is CI-sized;
+    the point of this mode is the *measurement path* — captured donated
+    executable, device timing, ledger-derived MFU — not a big number.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import numpy as np
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import capture, gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import transformer as tzoo
+    from mxnet_tpu.observability import metrics, perf
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    ndev = len(jax.devices())
+    if ndev >= 8:
+        spec = {"dp": 2, "fsdp": 2, "tp": 2}
+    elif ndev >= 4:
+        spec = {"fsdp": 2, "tp": 2}
+    else:
+        spec = {"dp": 1}
+    n = 1
+    for s in spec.values():
+        n *= s
+    mesh = parallel.create_mesh(spec, jax.devices()[:n])
+    layout = parallel.SpecLayout.for_mesh(mesh)
+
+    mx.random.seed(0)
+    net = tzoo.transformer_lm(prefix="benchtlm_")
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 8)))  # materialize params
+
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        "adam", {"learning_rate": 1e-3}, mesh=mesh,
+        param_rules=layout.param_rules(),
+        batch_axis_name=layout.batch_axes() or "dp",
+        dtype="bfloat16")
+    step = capture.capture(trainer) if capture_mode else trainer.step
+
+    rng = np.random.RandomState(0)
+    batch, seqlen = 8, 32
+    # int32 token ids: float ids would be bf16-cast with the activations
+    x = (rng.rand(batch, seqlen) * 64).astype(np.int32)
+    y = (rng.rand(batch, seqlen) * 64).astype(np.int32)
+    xd = jax.device_put(x, trainer.batch_sharding)
+    yd = jax.device_put(y, trainer.batch_sharding)
+
+    iters = 30 if on_tpu else 8
+    prev = perf.set_device_time(True)
+    try:
+        step(xd, yd).block_until_ready()  # compile -> ledger entry
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(iters):
+            loss = step(xd, yd)
+        loss.block_until_ready()
+        dt = time.perf_counter() - t0
+    finally:
+        perf.set_device_time(prev)
+
+    # MFU comes from the gauge, not a local formula: update_gauges()
+    # folds the ledger's derived numbers into mxnet_tpu_mfu exactly as
+    # the exporters do, and the bench reads the same labelset back
+    perf.update_gauges()
+    key, mfu = None, None
+    for k, e in sorted(perf.ledger().items()):
+        if e["label"] == "sharded_step" and e["mfu"] is not None:
+            key, mfu = k, metrics.get("mxnet_tpu_mfu").value(executable=k)
+            break
+    tok_s = batch * seqlen * iters / dt
+    print(f"# mesh={spec} dtype=bfloat16 captured={capture_mode}: "
+          f"{tok_s:.0f} tok/s, loss={float(loss):.4f}, "
+          f"MFU={'n/a' if mfu is None else f'{100 * mfu:.3f}%'}",
+          file=sys.stderr)
+    ok = mfu is not None and mfu >= TRANSFORMER_MFU_FLOOR
+    out = {
+        "metric": "transformer_train_mfu",
+        "value": round(mfu, 6) if mfu is not None else 0.0,
+        "unit": "mfu_fraction",
+        "vs_baseline": round((mfu or 0.0) / TRANSFORMER_MFU_FLOOR, 3),
+        "extra": {"mesh": spec, "tokens_per_s": round(tok_s, 1),
+                  "ledger_key": key, "mfu_floor": TRANSFORMER_MFU_FLOOR,
+                  "captured": capture_mode, "passed": ok},
+    }
+    if capture_mode:
+        out["extra"]["capture_steps"] = capture.stats()["capture_steps"]
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def main_stream():
     """Delegate to the streaming-ingestion gate (tools/stream_bench.py
     owns the workload; this entry point keeps the one-bench front door).
@@ -153,4 +270,7 @@ def main_stream():
 if __name__ == "__main__":
     if "--data=stream" in sys.argv[1:]:
         sys.exit(main_stream())
+    if "--model=transformer" in sys.argv[1:]:
+        sys.exit(main_transformer(
+            capture_mode="--no-capture" not in sys.argv[1:]))
     main(capture_mode="--capture" in sys.argv[1:])

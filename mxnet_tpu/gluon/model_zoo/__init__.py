@@ -1,2 +1,4 @@
 """Model zoo (parity: python/mxnet/gluon/model_zoo/)."""
 from . import vision
+from . import transformer
+from .transformer import TransformerBlock, TransformerLM, transformer_lm

@@ -1,0 +1,99 @@
+"""Decoder-only transformer LM for the model zoo (docs/parallel.md).
+
+The pretraining workload the dp×fsdp×tp stack is measured on: built
+entirely from existing nn blocks (Embedding / MultiHeadAttention /
+Dense / LayerNorm / contrib.Remat) with the stable parameter prefixes
+``parallel.SpecLayout.param_rules`` is written against —
+``attn_qkv_``/``attn_out_`` (tp column/row parallel), ``ff1_``/``ff2_``
+(MLP up/down), ``embed_``/``head_`` (vocab tables over fsdp×tp).
+
+Pre-norm residual blocks (ln -> attn -> +x; ln -> ff -> +x), GELU MLP,
+learned positional embeddings, causal attention. ``impl`` selects the
+attention kernel exactly as MultiHeadAttention does: 'dense' (XLA),
+'flash' (Pallas, schedules from the PR-15 table), 'ring'
+(sequence-parallel over ``sp_axis``), or 'auto'. ``remat`` wraps every
+block in contrib.Remat with a resolve_policy spec (remat.py).
+``final_norm=False`` builds the deliberately overflow-prone config the
+numerics drills train to divergence.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..block import HybridBlock
+from ..contrib import nn as contrib_nn
+
+__all__ = ["TransformerBlock", "TransformerLM", "transformer_lm"]
+
+
+class TransformerBlock(HybridBlock):
+    """One pre-norm decoder block: causal self-attention + GELU MLP."""
+
+    def __init__(self, units, num_heads, impl="dense", mesh=None,
+                 sp_axis="sp", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.attn = contrib_nn.MultiHeadAttention(
+                units, num_heads, impl=impl, causal=True, mesh=mesh,
+                sp_axis=sp_axis, prefix="attn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.ff1 = nn.Dense(units * 4, activation="gelu",
+                                flatten=False, in_units=units,
+                                prefix="ff1_")
+            self.ff2 = nn.Dense(units, flatten=False, in_units=units * 4,
+                                prefix="ff2_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.ff2(self.ff1(self.ln2(x)))
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only LM: token+position embed -> blocks -> [norm] -> head.
+
+    Input is (B, T) token ids; output is (B, T, vocab) logits.
+    """
+
+    def __init__(self, vocab, units, num_heads, num_layers, max_len=512,
+                 impl="dense", mesh=None, sp_axis="sp", remat=None,
+                 final_norm=True, **kwargs):
+        super().__init__(**kwargs)
+        self._max_len = max_len
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab, units, prefix="embed_")
+            self.pos = nn.Embedding(max_len, units, prefix="pos_")
+            self.blocks = nn.HybridSequential(prefix="blocks_")
+            with self.blocks.name_scope():
+                for _ in range(num_layers):
+                    blk = TransformerBlock(units, num_heads, impl=impl,
+                                           mesh=mesh, sp_axis=sp_axis)
+                    if remat is not None:
+                        blk = contrib_nn.Remat(blk, policy=remat)
+                    self.blocks.add(blk)
+            self.norm = nn.LayerNorm(prefix="norm_") if final_norm else None
+            self.head = nn.Dense(vocab, flatten=False, in_units=units,
+                                 prefix="head_")
+
+    def hybrid_forward(self, F, x):
+        t = x.shape[1]
+        if t > self._max_len:
+            raise ValueError(f"sequence length {t} exceeds max_len "
+                             f"{self._max_len}")
+        # int32 positions on purpose: a float arange would ride the AMP
+        # bf16 cast, where integers above 256 stop being exact
+        h = self.embed(x) + self.pos(F.arange(0, t, dtype="int32"))
+        h = self.blocks(h)
+        if self.norm is not None:
+            h = self.norm(h)
+        return self.head(h)
+
+
+def transformer_lm(vocab=64, units=64, num_heads=2, num_layers=2,
+                   max_len=512, impl="dense", mesh=None, sp_axis="sp",
+                   remat=None, final_norm=True, **kwargs):
+    """Factory with CI-sized defaults (shapes divide a dp=2×fsdp=2×tp=2
+    mesh: vocab % (fsdp·tp) == 0, 3·units % tp == 0, units % fsdp == 0)."""
+    return TransformerLM(vocab, units, num_heads, num_layers,
+                         max_len=max_len, impl=impl, mesh=mesh,
+                         sp_axis=sp_axis, remat=remat,
+                         final_norm=final_norm, **kwargs)

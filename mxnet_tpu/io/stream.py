@@ -62,7 +62,8 @@ from .. import recordio as _recordio
 
 __all__ = ["RecordStream", "StreamBatchIter", "StreamBatch",
            "DevicePrefetcher", "raw_decoder", "image_decoder",
-           "live_positions", "stats", "reset_stats", "STATE_VERSION"]
+           "token_decoder", "resolve_bucket_edges", "live_positions",
+           "stats", "reset_stats", "STATE_VERSION"]
 
 # docs/observability.md "streaming ingestion" counters; merged into
 # profiler.dispatch_stats() like every subsystem's _STATS.
@@ -71,6 +72,8 @@ _STATS = {
     "io_records_corrupt": 0,    # CRC-failed records skipped (policy=skip)
     "io_prefetch_depth": 0,     # DevicePrefetcher ring occupancy (last seen)
     "io_stream_resumes": 0,     # iterators restored from a resume token
+    "io_bucket_batches": 0,     # batches padded to a token-length bucket
+    "io_bucket_pad_rows": 0,    # rows that needed padding to their bucket
 }
 
 STATE_VERSION = 1
@@ -116,6 +119,35 @@ def _corrupt_policy(override=None):
     return policy
 
 
+def resolve_bucket_edges(override=None):
+    """Token-length bucket boundaries: an explicit iterable of ints, or
+    the ``MXNET_TPU_DATA_BUCKET_EDGES`` env knob ('32,64,128'); None/''
+    disables bucketing. Returned sorted ascending and de-duplicated —
+    the FIXED set of sequence shapes every padded batch snaps to, so a
+    captured step compiles at most ``len(edges)`` signatures no matter
+    how batch membership shifts (docs/data.md)."""
+    if override is not None:
+        raw = list(override)
+    else:
+        env = os.environ.get("MXNET_TPU_DATA_BUCKET_EDGES", "").strip()
+        if not env:
+            return None
+        raw = [p for p in env.split(",") if p.strip()]
+    try:
+        edges = sorted({int(e) for e in raw})
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"bucket edges must be integers, got {raw!r} "
+            "(MXNET_TPU_DATA_BUCKET_EDGES)")
+    if not edges:
+        return None
+    if edges[0] < 1:
+        raise ValueError(
+            f"bucket edges must be positive, got {edges} "
+            "(MXNET_TPU_DATA_BUCKET_EDGES)")
+    return tuple(edges)
+
+
 # ------------------------------------------------------------------ decoders
 
 def raw_decoder(data_shape, label_width=1, cost_s=0.0):
@@ -138,6 +170,27 @@ def raw_decoder(data_shape, label_width=1, cost_s=0.0):
         label = _np.zeros(label_width, _np.float32)
         label[:min(label_width, lab.size)] = lab[:label_width]
         return arr, label
+
+    return decode
+
+
+def token_decoder(lm_shift=True, dtype=_np.float32):
+    """Decoder for variable-length text records: the payload is raw
+    little-endian int32 token ids (any count — this is the decoder the
+    token-length buckets exist for). With ``lm_shift`` (default) each
+    record yields the next-token LM pair ``(tokens[:-1], tokens[1:])``
+    — both length T-1, padded together to the bucket edge; otherwise
+    the full sequence with the record header's label."""
+
+    def decode(header, payload):
+        toks = _np.frombuffer(payload, dtype=_np.int32).astype(dtype)
+        if lm_shift:
+            if toks.size < 2:
+                raise ValueError(
+                    f"LM records need >= 2 tokens, got {toks.size}")
+            return toks[:-1], toks[1:]
+        lab = _np.atleast_1d(_np.asarray(header.label, _np.float32))
+        return toks, lab.ravel()[:1]
 
     return decode
 
@@ -379,14 +432,21 @@ class RecordStream:
 class StreamBatch:
     """One assembled host batch plus the resume token that re-produces
     every batch AFTER it (``state`` — feed it to
-    ``StreamBatchIter.restore`` / ``CheckpointManager.save(data_iter=)``)."""
+    ``StreamBatchIter.restore`` / ``CheckpointManager.save(data_iter=)``).
 
-    __slots__ = ("data", "label", "state")
+    ``length`` is None except on token-length-bucketed text batches
+    (``bucket_edges`` / ``MXNET_TPU_DATA_BUCKET_EDGES``), where it is
+    the (batch,) int32 vector of REAL per-row sequence lengths — the
+    mask consumers apply over the pad positions ``data``/``label`` were
+    padded to (the bucket edge)."""
 
-    def __init__(self, data, label, state):
+    __slots__ = ("data", "label", "state", "length")
+
+    def __init__(self, data, label, state, length=None):
         self.data = data
         self.label = label
         self.state = state
+        self.length = length
 
     def __iter__(self):  # (x, y) unpacking convenience
         return iter((self.data, self.label))
@@ -412,7 +472,7 @@ class StreamBatchIter:
     def __init__(self, source, batch_size, decode, part_index=0,
                  num_parts=1, shuffle=False, seed=0, chunk_records=None,
                  corrupt_policy=None, epochs=None, decode_threads=None,
-                 batch_cost_s=0.0):
+                 batch_cost_s=0.0, bucket_edges=None, bucket_pad=0):
         from concurrent.futures import ThreadPoolExecutor
 
         if isinstance(source, RecordStream):
@@ -454,6 +514,15 @@ class StreamBatchIter:
         self._pool = ThreadPoolExecutor(
             max_workers=self._pool_workers,
             thread_name_prefix="mxnet-tpu-data-decode")
+        # token-length bucketing (variable-length text rows): pad every
+        # batch's sequence dim up to the smallest edge that fits it, so
+        # decoded lengths never leak into batch shapes — a captured step
+        # compiles at most len(edges) signatures. Deliberately NOT part
+        # of the resume token (like the decode fn, bucketing is
+        # configuration the resuming iterator must be rebuilt with; the
+        # token's order arithmetic is untouched by padding).
+        self._bucket_edges = resolve_bucket_edges(bucket_edges)
+        self._bucket_pad = bucket_pad
         self._epoch = 0
         self._cursor = 0        # within-epoch global position cursor
         self._epochs_done = 0
@@ -551,12 +620,50 @@ class StreamBatchIter:
                 "batches", path=shard.rec_path, key=entry.key,
                 offset=entry.offset)
         rows = [r if r is not None else good for r in rows]
-        data = _np.stack([r[0] for r in rows])
-        label = _np.stack([r[1] for r in rows])
+        if self._bucket_edges is not None:
+            data, label, length = self._bucket_stack(rows)
+        else:
+            length = None
+            data = _np.stack([r[0] for r in rows])
+            label = _np.stack([r[1] for r in rows])
         if label.ndim == 2 and label.shape[1] == 1:
             label = label.reshape(bs)
         self._cursor = base + bs * P
-        return StreamBatch(data, label, self.state())
+        return StreamBatch(data, label, self.state(), length=length)
+
+    def _bucket_stack(self, rows):
+        """Pad variable-length rows to the smallest bucket edge that
+        fits the batch's longest row and stack. Labels that are
+        per-token sequences (row length == data row length) pad along
+        with the data; per-example labels stack unchanged. Returns
+        (data, label, real_lengths)."""
+        lens = [int(_np.shape(r[0])[0]) for r in rows]
+        need = max(lens)
+        edge = next((e for e in self._bucket_edges if e >= need), None)
+        if edge is None:
+            raise MXNetError(
+                f"a {need}-token row exceeds the largest bucket edge "
+                f"{self._bucket_edges[-1]}; extend bucket_edges / "
+                "MXNET_TPU_DATA_BUCKET_EDGES or truncate at decode "
+                "(fixed bucket shapes are the no-retrace contract, "
+                "docs/data.md)")
+
+        def pad(a):
+            a = _np.asarray(a)
+            if a.shape[0] == edge:
+                return a
+            width = [(0, edge - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            return _np.pad(a, width, constant_values=self._bucket_pad)
+
+        seq_labels = all(
+            _np.ndim(r[1]) >= 1 and _np.shape(r[1])[0] == n
+            for r, n in zip(rows, lens))
+        data = _np.stack([pad(r[0]) for r in rows])
+        label = (_np.stack([pad(r[1]) for r in rows]) if seq_labels
+                 else _np.stack([_np.asarray(r[1]) for r in rows]))
+        _STATS["io_bucket_batches"] += 1
+        _STATS["io_bucket_pad_rows"] += sum(1 for n in lens if n != edge)
+        return data, label, _np.asarray(lens, dtype=_np.int32)
 
     def _decode_one(self, gid):
         payload = self.stream.read(gid)
@@ -712,13 +819,16 @@ class DevicePrefetcher:
         import jax
 
         with _obs_trace.span("data.h2d", rows=len(batch.data)):
+            arrs = [batch.data, batch.label]
+            if batch.length is not None:  # bucketed text: real lengths
+                arrs.append(batch.length)
             if self._sharding is not None:
-                x = jax.device_put(batch.data, self._sharding)
-                y = jax.device_put(batch.label, self._sharding)
+                out = [jax.device_put(a, self._sharding) for a in arrs]
             else:
-                x = jax.device_put(batch.data)
-                y = jax.device_put(batch.label)
-        return x, y
+                out = [jax.device_put(a) for a in arrs]
+        # bucketed batches hand (x, y, lengths) to the consumer; the
+        # common image path keeps its (x, y) contract
+        return tuple(out)
 
     # ----------------------------------------------------------- consumer
 

@@ -643,8 +643,11 @@ def _pick(a, indices, axis=-1, keepdims=False, mode="clip"):
 @register("Embedding")
 def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
     """Parity: src/operator/tensor/indexing_op.cc Embedding. Dense gather on
-    TPU (row_sparse grads are out of scope; see SURVEY.md §7 hard part 4)."""
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    TPU (row_sparse grads are out of scope; see SURVEY.md §7 hard part 4).
+    mode="clip" (the `pick` convention): ids arrive as floats in the mx
+    convention, and an AMP bf16 cast can round 63.9 up to 64.0 — jax's
+    default out-of-bounds fill would turn that one id into a NaN row."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
 
 
 @register("gather_nd")

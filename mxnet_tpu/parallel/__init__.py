@@ -5,11 +5,14 @@ DataParallelExecutorGroup) is replaced by named device meshes + GSPMD
 shardings; tp/pp/sp axes — absent in the reference — are exposed here as
 first-class (free on XLA).
 """
-from .mesh import create_mesh, default_mesh, local_devices, AXES, shard_map
+from .mesh import (create_mesh, default_mesh, named_mesh, local_devices,
+                   AXES, shard_map)
 from .functional import functional_call, param_arrays, aux_arrays
+from .layout import SpecLayout
 from .trainer import ShardedTrainer, make_update_fn
 from . import mesh
 from . import functional
+from . import layout
 from . import trainer
 
 

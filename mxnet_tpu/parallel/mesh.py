@@ -3,19 +3,20 @@
 The TPU-native replacement for the reference's device topology machinery
 (src/kvstore/gpu_topology.h link discovery, CommDeviceTree): on TPU the
 topology is a named mesh and XLA chooses collective algorithms over ICI/DCN.
-Axis convention (scaling-book style): 'dp' data, 'tp' tensor/model, 'pp'
-pipeline, 'sp' sequence/context, 'ep' expert.
+Axis convention (scaling-book style): 'dp' data, 'fsdp' fully-sharded data,
+'tp' tensor/model, 'pp' pipeline, 'sp' sequence/context, 'ep' expert.
 """
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
-__all__ = ["create_mesh", "default_mesh", "local_devices", "shrink_mesh",
-           "MeshShrinkError", "AXES"]
+__all__ = ["create_mesh", "default_mesh", "named_mesh", "parse_mesh_spec",
+           "local_devices", "shrink_mesh", "MeshShrinkError", "AXES"]
 
-AXES = ("dp", "tp", "pp", "sp", "ep")
+AXES = ("dp", "fsdp", "tp", "pp", "sp", "ep")
 
 
 def local_devices(platform=None):
@@ -50,48 +51,90 @@ def create_mesh(axes=None, devices=None):
 
 
 class MeshShrinkError(RuntimeError):
-    """No viable smaller mesh exists after excising the dead ranks."""
+    """No viable smaller mesh exists after excising the dead ranks.
+
+    Structured: carries the old mesh shape (``axes``), the ranks that
+    died (``dead_ranks``) and the axis that was being shrunk
+    (``batch_axis``) so recovery code and crash reports can say exactly
+    why the topology could not be rebuilt.
+    """
+
+    def __init__(self, msg, *, axes=None, dead_ranks=(), batch_axis=None):
+        super().__init__(msg)
+        self.axes = dict(axes or {})
+        self.dead_ranks = tuple(dead_ranks)
+        self.batch_axis = batch_axis
 
 
 def shrink_mesh(mesh, dead_ranks, batch_axis="dp"):
     """The largest viable mesh buildable from the survivors after losing
-    ``dead_ranks`` along ``batch_axis`` — the topology half of elastic
-    peer-loss recovery (resilience/elastic.py; the state half is the
-    reshardable checkpoint restore).
+    ``dead_ranks`` along the (data-parallel) shrink axis — the topology
+    half of elastic peer-loss recovery (resilience/elastic.py; the state
+    half is the reshardable checkpoint restore).
 
-    Ranks map onto ``batch_axis`` slots (on a one-device-per-process dp
-    mesh a rank IS its dp coordinate; ranks outside the axis still cost
-    a slot each, dropped from the tail). Every non-batch axis keeps its
-    full extent — losing a dp peer must not silently shrink tp/pp — and
-    the new batch extent is the largest power of two that fits the
-    survivors, so dp=8 degrades 8 -> 4 -> 2 -> 1 and batch divisibility
-    (rows % dp) is preserved for power-of-two batches. Raises
-    MeshShrinkError when nothing viable remains.
+    ``batch_axis`` may be one axis name or a tuple of names (the batch
+    dimension of a dp×fsdp mesh is sharded over both); shrinking always
+    happens along the FIRST name — the outermost data axis — and every
+    other axis keeps its full extent, because losing a dp peer must not
+    silently change the fsdp/tp layout the parameters are sharded over.
+
+    On a one-axis mesh a rank IS its slot coordinate. On a multi-axis
+    mesh a rank is the flat device ordinal in ``mesh.devices`` (C
+    order): its shrink-axis coordinate names the slot lost, and the
+    WHOLE slot — the full fsdp×tp slice that peer participated in — is
+    excised. Ranks outside the device range still cost a slot each,
+    dropped from the tail. The new extent is the largest power of two
+    that fits the survivors, so dp=8 degrades 8 -> 4 -> 2 -> 1 and
+    batch divisibility (rows % dp) is preserved for power-of-two
+    batches. Raises a structured MeshShrinkError when the survivors
+    cannot rebuild a mesh that still tiles the non-batch axes.
     """
     from jax.sharding import Mesh
 
     names = list(mesh.axis_names)
-    if batch_axis not in names:
+    old_axes = dict(zip(names, mesh.devices.shape))
+    shrink_axes = ((batch_axis,) if isinstance(batch_axis, str)
+                   else tuple(batch_axis))
+    shrink_axis = shrink_axes[0]
+    if shrink_axis not in names:
         raise MeshShrinkError(
-            f"mesh {names} has no '{batch_axis}' axis to shrink")
-    axis = names.index(batch_axis)
+            f"mesh {names} has no '{shrink_axis}' axis to shrink",
+            axes=old_axes, dead_ranks=dead_ranks, batch_axis=shrink_axis)
+    axis = names.index(shrink_axis)
     size = int(mesh.devices.shape[axis])
     dead = {int(r) for r in dead_ranks}
     if not dead:
-        raise MeshShrinkError("no dead ranks to excise")
-    in_range = sorted(r for r in dead if 0 <= r < size)
-    extra = len(dead) - len(in_range)
-    slots = [i for i in range(size) if i not in in_range]
+        raise MeshShrinkError("no dead ranks to excise",
+                              axes=old_axes, batch_axis=shrink_axis)
+    total = int(mesh.devices.size)
+    if total == size:  # one-axis fast path: rank IS the slot coordinate
+        in_range = sorted(r for r in dead if 0 <= r < size)
+        lost_slots = set(in_range)
+        extra = len(dead) - len(in_range)
+    else:  # multi-axis: rank = flat device ordinal -> shrink-axis slot
+        in_range = sorted(r for r in dead if 0 <= r < total)
+        lost_slots = {
+            int(np.unravel_index(r, mesh.devices.shape)[axis])
+            for r in in_range}
+        extra = len(dead) - len(in_range)
+    slots = [i for i in range(size) if i not in lost_slots]
     if extra:  # ranks we can't map onto the axis still each cost a slot
         slots = slots[:max(0, len(slots) - extra)]
+    non_batch = {n: s for n, s in old_axes.items() if n != shrink_axis}
     if not slots:
         raise MeshShrinkError(
-            f"all {size} '{batch_axis}' slots lost ranks; no survivors "
-            "to rebuild a mesh from")
+            f"all {size} '{shrink_axis}' slots lost ranks; no survivors "
+            "to rebuild a mesh from"
+            + (f" (non-batch axes {non_batch} left untiled)"
+               if non_batch else ""),
+            axes=old_axes, dead_ranks=dead_ranks, batch_axis=shrink_axis)
     new_size = 1 << (len(slots).bit_length() - 1)
     if new_size >= size:
         raise MeshShrinkError(
-            f"'{batch_axis}' cannot shrink below its current size {size}")
+            f"'{shrink_axis}' cannot shrink below its current size {size}"
+            + (f"; survivors cannot re-tile the non-batch axes "
+               f"{non_batch} at a smaller extent" if non_batch else ""),
+            axes=old_axes, dead_ranks=dead_ranks, batch_axis=shrink_axis)
     devices = np.take(mesh.devices, slots[:new_size], axis=axis)
     return Mesh(devices, tuple(names))
 
@@ -103,6 +146,51 @@ def default_mesh(n_devices=None):
     if n_devices is not None:
         devs = devs[:n_devices]
     return create_mesh({"dp": len(devs)}, devs)
+
+
+def parse_mesh_spec(spec):
+    """Parse a 'dp=2,fsdp=2,tp=-1' mesh-shape string into an ordered
+    axis dict (a -1 size absorbs the remaining devices, create_mesh
+    semantics). Axis names must come from AXES so a typo'd axis fails
+    loudly instead of silently replicating."""
+    axes = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh axis {part!r} in {spec!r}: want name=size")
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in AXES:
+            raise ValueError(
+                f"unknown mesh axis {name!r} in {spec!r}: want one of {AXES}")
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r} in {spec!r}")
+        axes[name] = int(val)
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+def named_mesh(spec=None, devices=None):
+    """The named multi-axis training mesh (docs/parallel.md).
+
+    ``spec`` is a 'dp=2,fsdp=2,tp=2' string, an axis dict, or None to
+    read the ``MXNET_TPU_MESH_SHAPE`` env knob; with neither set this
+    degrades to the pure data-parallel default_mesh so single-axis
+    callers need no configuration. Axes with size 1 are kept — a
+    dp=2,fsdp=1,tp=4 mesh still names all three axes so SpecLayout
+    rules resolve uniformly.
+    """
+    if spec is None:
+        spec = os.environ.get("MXNET_TPU_MESH_SHAPE", "").strip()
+        if not spec:
+            return default_mesh() if devices is None else create_mesh(
+                {"dp": len(list(devices))}, devices)
+    axes = spec if isinstance(spec, dict) else parse_mesh_spec(spec)
+    return create_mesh(axes, devices)
 
 
 def shard_map(fn, mesh, in_specs, out_specs, check=True):

@@ -74,7 +74,11 @@ class ShardedTrainer:
         self.opt_state = init(self.params)
         self._update = update
         self._rules = [(re.compile(pat), spec) for pat, spec in param_rules]
-        self._batch_axis = batch_axis_name
+        # one mesh axis name, or a tuple of names when the batch dim is
+        # sharded over several (dp×fsdp — SpecLayout.batch_axes())
+        self._batch_axis = (batch_axis_name if isinstance(batch_axis_name,
+                                                          str)
+                            else tuple(batch_axis_name))
         # elastic recovery (resilience.elastic): the manager the
         # mesh-shrink resume reloads state from on PeerLostError; without
         # one, a dead peer stays terminal (enable_recovery attaches late)
@@ -95,6 +99,20 @@ class ShardedTrainer:
             if pat.match(name):
                 return spec
         return P()
+
+    def _batch_axis_names(self):
+        """The batch axes as a tuple (a single name normalizes)."""
+        ba = self._batch_axis
+        return (ba,) if isinstance(ba, str) else tuple(ba)
+
+    def _batch_shards(self):
+        """How many ways the batch dim splits on the CURRENT mesh: the
+        product of the batch axes' extents (dp alone, or dp×fsdp when
+        the batch is sharded over both)."""
+        import math
+
+        return math.prod(int(self.mesh.shape.get(a, 1))
+                         for a in self._batch_axis_names())
 
     def _bind_mesh(self, mesh):
         """(Re)derive every mesh-dependent binding — NamedShardings for
@@ -270,6 +288,11 @@ class ShardedTrainer:
             "rules": [(p.pattern, str(s)) for p, s in self._rules],
             "dtype": self._compute_dtype,
             "batch_axis": self._batch_axis,
+            # kernel builders resolve Pallas block sizes from the tuned
+            # schedule table at trace time (tune/), so a table edit is a
+            # program change: fold the table token in so the next step()
+            # re-traces instead of reusing the stale captured program
+            "schedule": _capture._schedule_token(),
         }
         return _capture.fingerprint(parts)
 
@@ -285,9 +308,10 @@ class ShardedTrainer:
         if prev is not None and prev != fp:
             _capture.note_recapture(
                 label, prev, fp,
-                reason="step program rebind (mesh or hyperparameters "
-                       "changed)")
+                reason="step program rebind (mesh, hyperparameters or "
+                       "kernel schedule table changed)")
         self._capture_fp = fp
+        self._sched_token = _capture._schedule_token()
         return _capture.CapturedExec(fn, label=label, fingerprint=fp,
                                      **kwargs)
 
@@ -417,6 +441,18 @@ class ShardedTrainer:
         from ..resilience import faults as _faults
         from ..resilience import watchdog as _watchdog
 
+        # a schedule-table edit is a program change (kernel builders read
+        # Pallas block sizes from the table at trace time): drop the
+        # stale executables so the next build re-traces under the new
+        # table — the retrace lands in the capture forensics, and the
+        # AOT key (which folds the same token) can never false-hit
+        if self._step is not None or self._grads_fn is not None:
+            from .. import capture as _capture
+
+            if _capture._schedule_token() != getattr(self, "_sched_token",
+                                                     None):
+                self._step = None
+                self._grads_fn = self._apply_fn = None
         if self._step is None:
             self._build_step()
         if isinstance(x, NDArray):
@@ -454,7 +490,7 @@ class ShardedTrainer:
         self._step_count += 1
         _watchdog.note_step(self._step_count)
         rows = int(x.shape[0])
-        shards = int(self.mesh.shape.get(self._batch_axis, 1))
+        shards = self._batch_shards()
 
         def fit_count(k):
             # largest accumulation count <= k that divides the batch into
@@ -509,7 +545,7 @@ class ShardedTrainer:
                         or not _elastic.mesh_shrink_enabled():
                     raise
                 x, y = self._recover_peer_loss(e, x, y)
-                shards = int(self.mesh.shape.get(self._batch_axis, 1))
+                shards = self._batch_shards()
                 if microbatches is not None:
                     if rows % n or (rows // n) % max(1, shards):
                         raise ValueError(
@@ -599,10 +635,13 @@ class ShardedTrainer:
                                    batch_axis=self._batch_axis)
         except MeshShrinkError:
             raise err  # nothing viable left: the loss really is terminal
-        old_dp = int(old_axes.get(self._batch_axis, 1))
+        import math
+
+        batch_names = self._batch_axis_names()
+        old_dp = math.prod(int(old_axes.get(a, 1)) for a in batch_names)
         new_axes = {str(a): int(s) for a, s in
                     zip(new_mesh.axis_names, new_mesh.devices.shape)}
-        new_dp = int(new_axes.get(self._batch_axis, 1))
+        new_dp = math.prod(int(new_axes.get(a, 1)) for a in batch_names)
         self._bind_mesh(new_mesh)
         # the excised ranks are no longer part of the job: re-admit the
         # collectives (kvstore guards included) before the restore's
@@ -619,10 +658,11 @@ class ShardedTrainer:
         _elastic._STATS["elastic_mesh_shrinks"] += 1
         _watchdog.note_peer_recovery(err, manifest, old_axes, new_axes)
         self.last_recovery = manifest
+        axis_label = "x".join(batch_names)
         warnings.warn(
             f"peer rank(s) {dead} lost: resumed from checkpoint step "
             f"{manifest.get('step')} on a mesh shrunk "
-            f"{old_dp} -> {new_dp} '{self._batch_axis}' shard(s); "
+            f"{old_dp} -> {new_dp} '{axis_label}' shard(s); "
             "this step re-runs on the survivors (capacity is reduced — "
             "see the crash report)")
         bs = self._batch_sharding

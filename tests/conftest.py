@@ -101,3 +101,10 @@ def pytest_configure(config):
         "bisection (mxnet_tpu/observability/numerics.py, "
         "docs/observability.md); fast cases run in tier-1, the "
         "obs_bench steady-state gate carries the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "transformer: dp×fsdp×tp transformer pretraining — SpecLayout "
+        "shardings, model-zoo decoder LM, captured sharded step, "
+        "token-length bucketing (mxnet_tpu/parallel/layout.py, "
+        "gluon/model_zoo/transformer.py, docs/parallel.md); fast cases "
+        "run in tier-1, the MFU bench gate carries the slow marker too")

@@ -21,6 +21,7 @@ def test_model_forward(name, in_shape, classes):
     assert out.shape == (in_shape[0], classes)
 
 
+@pytest.mark.slow
 def test_resnet50_v1_structure():
     # flagship: parameter count must match the reference resnet50_v1 (25.6M)
     net = vision.resnet50_v1()
@@ -31,6 +32,7 @@ def test_resnet50_v1_structure():
     assert abs(n_params - 25_557_032) / 25_557_032 < 0.01, n_params
 
 
+@pytest.mark.slow
 def test_model_zoo_train_step():
     net = vision.get_model("resnet18_v1", classes=10)
     net.initialize()

@@ -1,0 +1,566 @@
+"""dp×fsdp×tp transformer pretraining (marker: transformer).
+
+Acceptance (ISSUE 16): the named multi-axis mesh (parse/env-knob/-1
+absorb), SpecLayout's per-param PartitionSpecs landing in the sharded
+trainer verbatim, multi-axis ``shrink_mesh`` excising a dp slot while
+fsdp/tp keep their extents (structured MeshShrinkError), the model-zoo
+decoder LM training through ONE donated captured executable per step
+on dp=2×fsdp=2×tp=2 bitwise-equal to the uncaptured sharded path, a
+schedule-table edit forcing a retrace of the captured step (PR-15
+registry), selectable remat policies staying bitwise, ring
+(sequence-parallel) attention training on an sp mesh, token-length
+bucketing (env knob, fixed shapes, real-length vector, resume tokens
+unchanged, dp=8 bitwise kill-resume), and the overflow-prone
+``final_norm=False`` config firing the grad-explosion condition with a
+snapshot that ``tools/numerics_bisect.py`` localizes.
+"""
+import hashlib
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import capture, gluon, recordio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import transformer as tzoo
+from mxnet_tpu.io import stream
+from mxnet_tpu.observability import numerics as num
+from mxnet_tpu.parallel import ShardedTrainer, SpecLayout, create_mesh
+from mxnet_tpu.parallel import mesh as pmesh
+from mxnet_tpu.resilience import CheckpointManager, faults
+
+pytestmark = pytest.mark.transformer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, UNITS, HEADS, SEQ = 16, 8, 2, 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    capture.reset_stats()
+    capture.clear_retrace_log()
+    num.reset()
+    faults.reset()
+    yield
+    capture.reset_stats()
+    capture.clear_retrace_log()
+    num.reset()
+    faults.reset()
+
+
+def _devices(n):
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+def _build_lm(prefix, seed=7, num_layers=1, max_len=16, **net_kw):
+    mx.random.seed(seed)
+    net = tzoo.transformer_lm(vocab=VOCAB, units=UNITS, num_heads=HEADS,
+                              num_layers=num_layers, max_len=max_len,
+                              prefix=prefix, **net_kw)
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 4)))  # materialize params
+    return net
+
+
+def _trainer_for(net, mesh, dtype=None, optimizer_params=None):
+    layout = SpecLayout.for_mesh(mesh)
+    return ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params or {"learning_rate": 0.1}, mesh=mesh,
+        param_rules=layout.param_rules(),
+        batch_axis_name=layout.batch_axes() or "dp", dtype=dtype)
+
+
+def _ids(shape, seed=0, vocab=VOCAB):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(*shape) * vocab).astype(np.int32)
+
+
+def _params_np(net):
+    return {k: v.asnumpy().copy()
+            for k, v in net._collect_params_with_prefix().items()}
+
+
+def _params_sha(net):
+    h = hashlib.sha256()
+    for k, v in sorted(_params_np(net).items()):
+        h.update(k.encode())
+        h.update(v.tobytes())
+    return h.hexdigest()
+
+
+def _ce_loss(out, y):
+    return gluon.loss.SoftmaxCrossEntropyLoss()(out, y).mean()
+
+
+def _bisect_tool():
+    spec = importlib.util.spec_from_file_location(
+        "numerics_bisect_for_tlm_test",
+        os.path.join(ROOT, "tools", "numerics_bisect.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    return tool
+
+
+# --------------------------------------------------------- named mesh + spec
+
+def test_parse_mesh_spec_contract():
+    assert pmesh.parse_mesh_spec("dp=2,fsdp=2,tp=2") == {
+        "dp": 2, "fsdp": 2, "tp": 2}
+    assert list(pmesh.parse_mesh_spec("tp=2, dp=4")) == ["tp", "dp"]
+    assert pmesh.parse_mesh_spec("dp=2,tp=-1")["tp"] == -1
+    with pytest.raises(ValueError, match="want name=size"):
+        pmesh.parse_mesh_spec("dp:2")
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        pmesh.parse_mesh_spec("zz=2")
+    with pytest.raises(ValueError, match="duplicate"):
+        pmesh.parse_mesh_spec("dp=2,dp=4")
+    with pytest.raises(ValueError, match="empty"):
+        pmesh.parse_mesh_spec(" , ")
+
+
+def test_named_mesh_env_knob_and_absorb(monkeypatch):
+    devs = _devices(8)
+    monkeypatch.setenv("MXNET_TPU_MESH_SHAPE", "dp=2,fsdp=2,tp=2")
+    m = pmesh.named_mesh(devices=devs)
+    assert m.axis_names == ("dp", "fsdp", "tp")
+    assert m.devices.shape == (2, 2, 2)
+    # -1 absorbs the remaining devices; size-1 axes keep their name
+    m2 = pmesh.named_mesh("dp=2,fsdp=1,tp=-1", devices=devs)
+    assert m2.axis_names == ("dp", "fsdp", "tp")
+    assert m2.devices.shape == (2, 1, 4)
+    # unset knob degrades to the pure data-parallel default
+    monkeypatch.delenv("MXNET_TPU_MESH_SHAPE")
+    m3 = pmesh.named_mesh(devices=devs)
+    assert m3.axis_names == ("dp",) and m3.devices.size == 8
+
+
+def test_spec_layout_specs_and_degradation():
+    from jax.sharding import PartitionSpec as P
+
+    lay = SpecLayout()
+    assert lay.qkv_projection() == P("tp", "fsdp")
+    assert lay.attn_output() == P("fsdp", "tp")
+    assert lay.ffn_up() == P("tp", "fsdp")
+    assert lay.ffn_down() == P("fsdp", "tp")
+    assert lay.embedding() == P(("fsdp", "tp"))
+    assert lay.column_bias() == P("tp")
+    assert lay.replicated() == P()
+    assert lay.batch_axes() == ("dp", "fsdp")
+    assert lay.batch_spec() == P(("dp", "fsdp"))
+    # dp-only mesh: every param spec degrades to replicated
+    m = create_mesh({"dp": 2}, _devices(2))
+    solo = SpecLayout.for_mesh(m)
+    assert solo.qkv_projection() == P()
+    assert solo.embedding() == P()
+    assert solo.batch_axes() == ("dp",)
+    # dp×tp keeps the tensor split but drops the fsdp dim
+    m2 = create_mesh({"dp": 2, "tp": 2}, _devices(4))
+    dt = SpecLayout.for_mesh(m2)
+    assert dt.qkv_projection() == P("tp")
+    assert dt.ffn_down() == P(None, "tp")
+    assert dt.embedding() == P(("tp",))
+
+
+def test_sharded_trainer_applies_spec_layout():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2}, _devices(8))
+    net = _build_lm("laytlm_")
+    trainer = _trainer_for(net, mesh)
+    x = _ids((8, 4), seed=1)
+    trainer.step(x, x)  # binds the mesh + param shardings
+    specs = {k: s.spec for k, s in trainer._param_sharding.items()}
+    by_suffix = {}
+    for k, s in specs.items():
+        for suf in ("attn_qkv_weight", "attn_qkv_bias", "attn_out_weight",
+                    "ff1_weight", "ff2_weight", "embed_weight",
+                    "head_weight", "ln1_gamma", "pos_weight"):
+            if k.endswith(suf):
+                by_suffix[suf] = s
+    assert by_suffix["attn_qkv_weight"] == P("tp", "fsdp")
+    assert by_suffix["attn_qkv_bias"] == P("tp")
+    assert by_suffix["attn_out_weight"] == P("fsdp", "tp")
+    assert by_suffix["ff1_weight"] == P("tp", "fsdp")
+    assert by_suffix["ff2_weight"] == P("fsdp", "tp")
+    assert by_suffix["embed_weight"] == P(("fsdp", "tp"))
+    assert by_suffix["head_weight"] == P(("fsdp", "tp"))
+    # unmatched params (norm scales, positional table) replicate
+    assert by_suffix["ln1_gamma"] == P()
+    assert by_suffix["pos_weight"] == P()
+    # the batch shards over dp AND fsdp (flat data axes)
+    assert trainer.batch_sharding.spec == P(("dp", "fsdp"))
+
+
+# ------------------------------------------------------- multi-axis shrink
+
+def test_shrink_mesh_one_axis_power_of_two():
+    m = create_mesh({"dp": 8}, _devices(8))
+    m2 = pmesh.shrink_mesh(m, [3])
+    assert m2.axis_names == ("dp",) and m2.devices.shape == (4,)
+    assert 3 not in {i for i, d in enumerate(_devices(8))
+                     if d in list(m2.devices.ravel())}
+
+
+def test_shrink_mesh_multi_axis_keeps_fsdp_tp_intact():
+    devs = _devices(8)
+    m = create_mesh({"dp": 2, "fsdp": 2, "tp": 2}, devs)
+    # rank 1 = flat ordinal -> (dp=0, fsdp=0, tp=1): dp slot 0 dies,
+    # the whole fsdp×tp slice it participated in is excised
+    m2 = pmesh.shrink_mesh(m, [1], batch_axis=("dp", "fsdp"))
+    assert m2.axis_names == ("dp", "fsdp", "tp")
+    assert m2.devices.shape == (1, 2, 2)
+    np.testing.assert_array_equal(
+        np.vectorize(id)(m2.devices), np.vectorize(id)(m.devices[1:]))
+    # out-of-range ranks still cost a slot each, from the tail
+    m3 = pmesh.shrink_mesh(m, [99])
+    assert m3.devices.shape == (1, 2, 2)
+    np.testing.assert_array_equal(
+        np.vectorize(id)(m3.devices), np.vectorize(id)(m.devices[:1]))
+
+
+def test_shrink_mesh_errors_are_structured():
+    devs = _devices(8)
+    m = create_mesh({"dp": 2, "fsdp": 2, "tp": 2}, devs)
+    with pytest.raises(pmesh.MeshShrinkError, match="no dead ranks") as ei:
+        pmesh.shrink_mesh(m, [])
+    assert ei.value.axes == {"dp": 2, "fsdp": 2, "tp": 2}
+    assert ei.value.batch_axis == "dp"
+    with pytest.raises(pmesh.MeshShrinkError,
+                       match="no survivors") as ei:
+        pmesh.shrink_mesh(m, [0, 4])  # both dp slots lose a rank
+    assert ei.value.dead_ranks == (0, 4)
+    assert "fsdp" in str(ei.value)  # names the non-batch axes left untiled
+    with pytest.raises(pmesh.MeshShrinkError, match="no 'pp' axis"):
+        pmesh.shrink_mesh(m, [1], batch_axis="pp")
+
+
+# ---------------------------------------------- captured step: ONE executable
+
+def test_captured_step_bitwise_vs_uncaptured_on_dp_fsdp_tp():
+    import jax
+
+    devs = _devices(8)
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2}, devs)
+    x, y = _ids((8, SEQ), seed=2), _ids((8, SEQ), seed=3)
+
+    def run(captured):
+        net = _build_lm("bwtlm_", num_layers=2)
+        trainer = _trainer_for(net, mesh, dtype="bfloat16")
+        step = capture.capture(trainer) if captured else trainer.step
+        xd = jax.device_put(x, trainer.batch_sharding)
+        yd = jax.device_put(y, trainer.batch_sharding)
+        losses = [np.asarray(step(xd, yd)).tobytes() for _ in range(3)]
+        return net, trainer, losses
+
+    ref_net, _, ref_losses = run(captured=False)
+    net, trainer, losses = run(captured=True)
+    assert losses == ref_losses  # bitwise, not approx
+    a, b = _params_np(ref_net), _params_np(net)
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), k
+    # ONE donated executable per step: a single compiled signature
+    # served all three captured invocations
+    assert len(trainer._step.compiled_signatures) == 1
+    assert capture.stats()["capture_steps"] == 3
+
+
+def test_schedule_table_edit_retraces_captured_step(tmp_path, monkeypatch):
+    import jax
+
+    table = tmp_path / "schedules.json"
+    table.write_text(json.dumps(
+        {"schema_version": 1, "entries": {"k1": {"block": 8}}}))
+    monkeypatch.setenv("MXNET_TPU_SCHEDULE_TABLE", str(table))
+    monkeypatch.delenv("MXNET_TPU_AUTOTUNE", raising=False)
+
+    mesh = create_mesh({"dp": 1}, _devices(1))
+    net = _build_lm("schtlm_", max_len=8)
+    trainer = _trainer_for(net, mesh)
+    step = capture.capture(trainer)
+    x = _ids((2, 4), seed=4)
+    l1 = float(step(x, x))
+    capture.clear_retrace_log()
+    step(x, x)
+    assert capture.retrace_log() == []  # warm: same table, no retrace
+    # edit the table (different byte size -> new stamp -> new digest):
+    # the captured step's fingerprint folds the schedule token, so the
+    # next call must rebuild the executable
+    table.write_text(json.dumps(
+        {"schema_version": 1,
+         "entries": {"k1": {"block": 128}, "k2": {"arrangement": "nt"}}}))
+    l3 = float(step(x, x))
+    log = [e for e in capture.retrace_log() if e["label"] == "sharded_step"]
+    assert log and any("schedule" in e["reason"] for e in log)
+    assert np.isfinite(l1) and np.isfinite(l3)
+
+
+def test_remat_policies_match_and_are_deterministic():
+    """Remat recomputes the forward in the backward pass, which XLA may
+    fuse differently — so vs no-remat the match is close, not bitwise;
+    a remat run against itself IS bitwise (determinism)."""
+    mesh = create_mesh({"dp": 2}, _devices(2))
+    x, y = _ids((4, SEQ), seed=5), _ids((4, SEQ), seed=6)
+
+    def run(remat):
+        net = _build_lm("rmtlm_", num_layers=2, remat=remat)
+        trainer = _trainer_for(net, mesh)
+        losses = [float(trainer.step(x, y)) for _ in range(2)]
+        return net, losses
+
+    ref_net, ref_losses = run(remat=None)
+    ref_params = _params_np(ref_net)
+    prev = None
+    for policy in (True, True, "dots_with_no_batch_dims_saveable"):
+        net, losses = run(remat=policy)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+        for k, v in _params_np(net).items():
+            # the Remat wrapper adds a '.block' path segment
+            rk = k.replace(".block.", ".", 1)
+            np.testing.assert_allclose(v, ref_params[rk], rtol=1e-4,
+                                       atol=1e-6, err_msg=f"{policy} {k}")
+        if policy is True:
+            if prev is not None:
+                a = _params_np(net)
+                assert losses == prev[1]
+                for k in a:
+                    assert np.array_equal(a[k], prev[0][k]), k
+            prev = (_params_np(net), losses)
+
+
+def test_remat_rejects_unknown_policy():
+    from mxnet_tpu import remat
+
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        remat.resolve_policy("definitely_not_a_policy")
+
+
+def test_ring_attention_trains_on_sp_mesh():
+    mesh = create_mesh({"dp": 2, "sp": 4}, _devices(8))
+    mx.random.seed(9)
+    net = tzoo.transformer_lm(vocab=VOCAB, units=16, num_heads=HEADS,
+                              num_layers=1, max_len=SEQ, impl="ring",
+                              mesh=mesh, prefix="ringtlm_")
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, SEQ)))
+    trainer = _trainer_for(net, mesh)
+    x, y = _ids((4, SEQ), seed=7), _ids((4, SEQ), seed=8)
+    losses = [float(trainer.step(x, y)) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[1] != losses[0]  # it actually updates
+
+
+# ------------------------------------------------------ token-length buckets
+
+@pytest.fixture(scope="module")
+def token_shards(tmp_path_factory):
+    """64 variable-length text records (5..17 int32 tokens, ids < 16):
+    LM pairs span 4..16 tokens, exercising both of the (8, 16) edges.
+    The first 16 records are short, so the unshuffled first batch of 16
+    snaps to the 8 edge deterministically."""
+    root = tmp_path_factory.mktemp("tokrec")
+    rs = np.random.RandomState(3)
+    prefix = str(root / "tokens-00000")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(64):
+        n = int(rs.randint(5, 9)) if i < 16 else int(rs.randint(10, 18))
+        toks = rs.randint(0, VOCAB, size=n).astype(np.int32)
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), toks.tobytes()))
+    rec.close()
+    return [prefix + ".rec"]
+
+
+def _tok_iter(paths, bucket_edges=(8, 16), **kw):
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 5)
+    return stream.StreamBatchIter(
+        paths, batch_size=16, decode=stream.token_decoder(dtype=np.int32),
+        bucket_edges=bucket_edges, **kw)
+
+
+def test_resolve_bucket_edges_knob(monkeypatch):
+    assert stream.resolve_bucket_edges() is None
+    assert stream.resolve_bucket_edges((32, 16, 32)) == (16, 32)
+    monkeypatch.setenv("MXNET_TPU_DATA_BUCKET_EDGES", "64, 8,64")
+    assert stream.resolve_bucket_edges() == (8, 64)
+    monkeypatch.setenv("MXNET_TPU_DATA_BUCKET_EDGES", "")
+    assert stream.resolve_bucket_edges() is None
+    with pytest.raises(ValueError, match="must be integers"):
+        stream.resolve_bucket_edges(("eight",))
+    with pytest.raises(ValueError, match="must be positive"):
+        stream.resolve_bucket_edges((0, 8))
+
+
+def test_token_decoder_lm_shift():
+    toks = np.arange(5, dtype=np.int32)
+    hdr = recordio.IRHeader(0, 7.0, 0, 0)
+    x, y = stream.token_decoder()(hdr, toks.tobytes())
+    np.testing.assert_array_equal(x, [0, 1, 2, 3])
+    np.testing.assert_array_equal(y, [1, 2, 3, 4])
+    with pytest.raises(ValueError, match=">= 2 tokens"):
+        stream.token_decoder()(hdr, toks[:1].tobytes())
+    x2, lab = stream.token_decoder(lm_shift=False)(hdr, toks.tobytes())
+    assert x2.shape == (5,) and lab.ravel()[0] == 7.0
+
+
+def test_bucketed_batches_snap_to_fixed_shapes(token_shards):
+    stream.reset_stats()
+    it = _tok_iter(token_shards, epochs=1, shuffle=False)
+    seen_edges, n_batches = set(), 0
+    for b in it:
+        n_batches += 1
+        assert b.data.shape[1] in (8, 16)
+        assert b.data.shape == b.label.shape
+        seen_edges.add(b.data.shape[1])
+        assert b.length is not None and b.length.dtype == np.int32
+        assert b.length.shape == (16,)
+        for i, n in enumerate(b.length):
+            assert 0 < n <= b.data.shape[1]
+            # padded tail is zeros (both tokens and labels)
+            assert not b.data[i, n:].any()
+            assert not b.label[i, n:].any()
+    assert n_batches == 4 and seen_edges == {8, 16}
+    assert stream.stats()["io_bucket_batches"] == 4
+    assert stream.stats()["io_bucket_pad_rows"] > 0
+
+
+def test_bucket_overflow_is_a_structured_error(token_shards):
+    it = _tok_iter(token_shards, bucket_edges=(8,))
+    with pytest.raises(MXNetError,
+                       match="exceeds the largest bucket edge 8"):
+        for _ in it:
+            pass
+
+
+def test_bucketing_leaves_resume_tokens_unchanged(token_shards):
+    it = _tok_iter(token_shards)
+    tok = next(it).state
+    # bucket membership is derived from the cursor, never persisted
+    assert not any("bucket" in k for k in tok)
+    res = _tok_iter(token_shards)
+    res.restore(tok)
+    ref, got = next(it), next(res)
+    np.testing.assert_array_equal(got.data, ref.data)
+    np.testing.assert_array_equal(got.length, ref.length)
+    assert got.state == ref.state
+    # an iterator with DIFFERENT edges accepts the token verbatim and
+    # yields the same rows, just padded to its own edge
+    wide = _tok_iter(token_shards, bucket_edges=(16,))
+    wide.restore(tok)
+    w = next(wide)
+    assert w.data.shape[1] == 16
+    np.testing.assert_array_equal(w.data[:, :ref.data.shape[1]], ref.data)
+    np.testing.assert_array_equal(w.length, ref.length)
+
+
+def test_kill_resume_bitwise_bucketed_dp8(token_shards, tmp_path):
+    import jax
+
+    devs = _devices(8)
+    mesh = create_mesh({"dp": 8}, devs)
+
+    def run(steps, mgr=None, save_at=None, restore=False):
+        net = _build_lm("klm_", seed=13)
+        trainer = _trainer_for(
+            net, mesh,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        it = _tok_iter(token_shards)
+        if restore:
+            assert mgr.restore_latest(trainer=trainer, data_iter=it)
+        losses = []
+        for k in range(steps):
+            b = next(it)
+            xd = jax.device_put(b.data, trainer.batch_sharding)
+            yd = jax.device_put(b.label, trainer.batch_sharding)
+            losses.append(np.asarray(trainer.step(xd, yd)).tobytes())
+            if save_at is not None and k + 1 == save_at:
+                mgr.save(k + 1, trainer=trainer, data_iter=it)
+        return net, losses
+
+    oracle_net, oracle_losses = run(steps=6)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_n=2)
+    run(steps=3, mgr=mgr, save_at=3)  # the "killed" run
+    res_net, res_losses = run(steps=3, mgr=mgr, restore=True)
+    assert res_losses == oracle_losses[3:]  # bitwise loss bytes
+    assert _params_sha(res_net) == _params_sha(oracle_net)
+
+
+# ------------------------------------------------- numerics: drive to blowup
+
+def test_overflow_prone_config_fires_explosion_and_bisects(tmp_path,
+                                                           monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_NUMERICS_SNAPSHOT_DIR",
+                       str(tmp_path / "snaps"))
+    tap = num.NumericsTap(interval=1, policy="record", mad_k=8,
+                          explosion_min_n=8)
+    mx.random.seed(19)
+    net = tzoo.transformer_lm(vocab=VOCAB, units=UNITS, num_heads=HEADS,
+                              num_layers=2, max_len=SEQ, final_norm=False,
+                              prefix="numtlm_")
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.zeros((2, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1e-2})
+    step = capture.capture(trainer, net=net, loss_fn=_ce_loss,
+                           numerics=tap)
+    rs = np.random.RandomState(23)
+    x = mx.nd.array((rs.rand(4, SEQ) * VOCAB).astype(np.float32))
+    y = mx.nd.array((rs.rand(4, SEQ) * VOCAB).astype(np.float32))
+    for _ in range(10):  # clean baseline for the median/MAD detector
+        step(x, y, batch_size=4)
+    assert not num.condition("grad_explosion")["active"]
+
+    # crank the lr on the final-norm-free config: lr is a dynamic
+    # operand of the captured step, so no recompile happens here
+    fired = None
+    for lr in (2.0, 20.0, 200.0, 2000.0):
+        trainer.set_learning_rate(lr)
+        for _ in range(4):
+            step(x, y, batch_size=4)
+            cond = num.condition("grad_explosion")
+            if cond["active"]:
+                fired = dict(cond)
+                break
+        if fired:
+            break
+    assert fired is not None, "grad explosion never fired"
+    assert fired["evidence"]["grad_norm"] > 0
+    assert fired["snapshot"] and os.path.isdir(fired["snapshot"])
+
+    # keep training until the blowup goes non-finite (policy=record lets
+    # it propagate) so the snapshot carries divergent activation rows
+    for _ in range(8):
+        nf = num.condition("nonfinite")
+        if nf is not None and nf["active"]:
+            break
+        step(x, y, batch_size=4)
+    snap = num.last_snapshot()
+    assert snap is not None
+
+    tool = _bisect_tool()
+    mx.random.seed(31)
+    replay = tzoo.transformer_lm(vocab=VOCAB, units=UNITS,
+                                 num_heads=HEADS, num_layers=2,
+                                 max_len=SEQ, final_norm=False,
+                                 prefix="numtlmr_")
+    replay.initialize(mx.initializer.Xavier())
+    replay(mx.nd.zeros((2, 4)))
+    report = tool.run_bisect(snap, replay, _ce_loss)
+    assert report["first_bad_layer"] is not None
+    assert report["diverged"] >= 1
+    # the replay-free inspect mode reads the snapshot's own recorded
+    # rows; it only NAMES a layer when those went non-finite, but its
+    # report shape holds either way
+    inspect = tool.inspect_snapshot(snap)
+    assert inspect["mode"] == "inspect" and inspect["layers"]

@@ -71,6 +71,14 @@ def build_workloads(quick):
                                   label="ring_hop"),
         search.flash_bwd_workload(b=2, h=1, t=256, d=32, causal=True,
                                   quick=quick, label="flash_bwd"),
+        # the model-zoo transformer's attention shape (gluon/model_zoo/
+        # transformer.py head_dim=64): fwd+bwd, so bench.py
+        # --model=transformer and the transformer_step@tuned gate key
+        # resolve tuned blocks instead of falling back to defaults
+        search.flash_fwd_workload(b=2, h=1, t=128, d=64, causal=True,
+                                  quick=quick, label="transformer_fwd"),
+        search.flash_bwd_workload(b=2, h=1, t=128, d=64, causal=True,
+                                  quick=quick, label="transformer_bwd"),
         search.int8_fc_workload(m=8, k=64, n=32),
         search.int8_conv_workload(n=2, c=8, hw=8, o=16),
         search.int8_requant_workload(rows=8, cols=32),

@@ -54,7 +54,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "ckpt_partial_write", "ckpt_shard_corrupt",
               "ckpt_crash_before_manifest", "ckpt_async_crash",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
-              "peer_death_recover", "oom_step", "dist_connect_timeout",
+              "peer_death_recover", "peer_death_multiaxis", "oom_step",
+              "dist_connect_timeout",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
               "perf_regression", "slo_burn", "step_time_anomaly",
@@ -69,6 +70,7 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
 # trail is the barrier's `ckpt: async_failed` event.
 EXPECTED_FLIGHT_EVENTS = {
     "peer_death_recover": (("fault", "fault", "peer_death"),),
+    "peer_death_multiaxis": (("fault", "fault", "peer_death"),),
     "capture_step": (("fault", "fault", "nan_grad"),
                      ("fault", "fault", "hang_step")),
     "ckpt_async_crash": (("ckpt", "op", "async_failed"),),
@@ -247,6 +249,87 @@ def _drill_peer_death_recover(mx, workdir):
           and trainer.last_recovery is not None
           and trainer.last_recovery["step"] == 1)
     return ok, (f"dp {dp}->{new_dp} recoveries="
+                f"{s['watchdog_peer_recoveries']}")
+
+
+def _drill_peer_death_multiaxis(mx, workdir):
+    """A dp peer dies during a CAPTURED dp×fsdp×tp transformer step and
+    the run survives with the model-parallel topology intact: the shrink
+    excises one whole dp slice (every fsdp×tp position of the dead
+    slot), the checkpoint reloads onto the {dp:1, fsdp:2, tp:2}
+    survivor mesh, and the continued run is bitwise-equal to a
+    hand-seeded oracle trainer built directly on the shrunk topology
+    (docs/parallel.md)."""
+    import warnings
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu import capture
+    from mxnet_tpu.gluon.model_zoo import transformer as tzoo
+    from mxnet_tpu.parallel import SpecLayout
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.resilience import (CheckpointManager, elastic, faults,
+                                      watchdog)
+
+    # recovery recompiles the transformer step on the shrunk mesh inside
+    # the guarded scope — the deadline must cover compile time
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "180"
+    if len(jax.devices()) < 8:
+        return False, "needs >= 8 devices (xla_force_host_platform_device_count)"
+
+    def build(axes, devs, mgr=None):
+        mx.random.seed(29)
+        net = tzoo.transformer_lm(vocab=16, units=8, num_heads=2,
+                                  num_layers=1, max_len=16,
+                                  prefix="chaos_tlm_")
+        net.initialize()
+        net(mx.nd.zeros((2, 4)))
+        mesh = create_mesh(axes, devs)
+        layout = SpecLayout.for_mesh(mesh)
+        return ShardedTrainer(
+            net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            mesh=mesh, param_rules=layout.param_rules(),
+            batch_axis_name=layout.batch_axes(), checkpoint_manager=mgr)
+
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+    trainer = build({"dp": 2, "fsdp": 2, "tp": 2}, jax.devices()[:8],
+                    mgr)
+    step = capture.capture(trainer)
+    rs = np.random.RandomState(29)
+    x = (rs.rand(8, 8) * 16).astype(np.int32)
+    y = (rs.rand(8, 8) * 16).astype(np.int32)
+    step(x, y)
+    mgr.save(1, trainer=trainer)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("peer_death"):
+            loss1 = step(x, y)            # dies -> shrinks -> re-runs
+    new_axes = {str(a): int(s) for a, s in
+                zip(trainer.mesh.axis_names, trainer.mesh.devices.shape)}
+    loss2 = step(x, y)                    # training continues
+
+    # hand-seeded oracle: same net, built DIRECTLY on the shrunk
+    # topology, restored from the same checkpoint — the recovered run
+    # must match it bitwise, step for step
+    oracle = build({"dp": 1, "fsdp": 2, "tp": 2}, jax.devices()[:4])
+    mgr.restore_latest(trainer=oracle)
+    o1, o2 = oracle.step(x, y), oracle.step(x, y)
+    bitwise = (
+        np.float32(loss1).tobytes() == np.float32(o1).tobytes()
+        and np.float32(loss2).tobytes() == np.float32(o2).tobytes()
+        and all(np.array_equal(np.asarray(trainer.params[k]),
+                               np.asarray(oracle.params[k]))
+                for k in trainer.params))
+    s = {**watchdog.stats(), **elastic.stats()}
+    ok = (new_axes == {"dp": 1, "fsdp": 2, "tp": 2} and bitwise
+          and s["watchdog_peer_recoveries"] >= 1
+          and s["elastic_mesh_shrinks"] >= 1
+          and trainer.last_recovery is not None
+          and trainer.last_recovery["step"] == 1)
+    return ok, (f"axes {new_axes} bitwise={bitwise} recoveries="
                 f"{s['watchdog_peer_recoveries']}")
 
 
@@ -903,6 +986,12 @@ def _drill_record_corrupt(mx, workdir):
         except recordio.RecordCorruptError as e:
             structured = (e.path is not None and e.key is not None
                           and e.offset is not None)
+        finally:
+            # drain the decode pool INSIDE the inject scope: pool.map
+            # re-raises on the first errored row while a sibling worker
+            # may still be mid-read, and that straggler must not live
+            # long enough to swallow the next phase's single-shot fault
+            it.close()
 
     # policy=skip: counted substitute row, stream completes the epoch
     before = dstream.stats()["io_records_corrupt"]
@@ -946,6 +1035,8 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_ckpt_async_crash(mx, tmp)
     if kind == "peer_death_recover":
         return _drill_peer_death_recover(mx, tmp)
+    if kind == "peer_death_multiaxis":
+        return _drill_peer_death_multiaxis(mx, tmp)
     if kind == "hang_step":
         return _drill_hang_step(mx, tmp)
     if kind == "hang_collective":
