@@ -1585,9 +1585,10 @@ class CapturedShardedStep:
         # grads/apply program already compiles through _capture_exec, so
         # a pre-built (possibly minutes-of-XLA) executable is kept
 
-    def __call__(self, x, y, microbatches=None):
+    def __call__(self, x, y, microbatches=None, length=None):
         _STATS["capture_steps"] += 1
-        return self.trainer.step(x, y, microbatches=microbatches)
+        return self.trainer.step(x, y, microbatches=microbatches,
+                                 length=length)
 
     @property
     def mesh(self):

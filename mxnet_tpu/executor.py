@@ -11,6 +11,7 @@ executes the fused fwd+bwd program (outputs + gradients + aux updates).
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import inspect as _inspect
 
 import numpy as _np
@@ -102,6 +103,11 @@ def _alloc_for_name(name, shape, ctx, dtype=_np.float32):
 
 
 class Executor:
+    # When set (serving Predictor), a live-rollout param swap flips every
+    # shared arg/aux cell under this lock; forward_batch gathers under it
+    # too, so one forward sees all-old or all-new params, never a torn mix.
+    _param_read_lock = None
+
     def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
                  group2ctx=None):
         import jax
@@ -268,15 +274,19 @@ class Executor:
         read, not written (is_train=False inference: moving stats are
         consumed, never updated). Returns raw jax arrays when ``raw``,
         else NDArrays."""
-        arg_vals = []
-        for n in self._arg_names:
-            v = feeds.get(n)
-            if v is None:
-                v = self.arg_dict[n]._data
-            elif isinstance(v, NDArray):
-                v = v._data
-            arg_vals.append(v)
-        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        lock = self._param_read_lock
+        if lock is None:
+            lock = _contextlib.nullcontext()
+        with lock:
+            arg_vals = []
+            for n in self._arg_names:
+                v = feeds.get(n)
+                if v is None:
+                    v = self.arg_dict[n]._data
+                elif isinstance(v, NDArray):
+                    v = v._data
+                arg_vals.append(v)
+            aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
         cap = self._infer_capture
         if cap is not None:
             outs = cap(arg_vals, aux_vals)

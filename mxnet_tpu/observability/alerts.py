@@ -500,6 +500,8 @@ def _probe_breakers(ctx):
     saw_fleet = False
     for fleet in ctx.fleets():
         try:
+            if getattr(fleet, "_closed", False):
+                continue
             for model in fleet.models():
                 for r in fleet._sup.replicas(model):
                     saw_fleet = True
@@ -517,8 +519,17 @@ def _probe_healthy_floor(ctx):
     detail = {}
     for fleet in ctx.fleets():
         try:
+            # a close()d fleet lingers in the weakref registry until GC;
+            # its replicas are all DEAD by operator intent (shutdown, not
+            # sickness) and must never open a healthy-floor incident
+            if getattr(fleet, "_closed", False):
+                continue
             for model in fleet.models():
-                replicas = fleet._sup.replicas(model)
+                # a replica draining for SCALE left by operator intent,
+                # not sickness: it is no longer a fleet member for floor
+                # purposes and must never open a healthy-floor incident
+                replicas = [r for r in fleet._sup.replicas(model)
+                            if not getattr(r, "scale_drain", False)]
                 if not replicas:
                     continue
                 healthy = sum(1 for r in replicas if r.state == "HEALTHY")
@@ -706,7 +717,8 @@ def _fleet_states():
                 for model in fleet.models():
                     for r in fleet._sup.replicas(model):
                         out.append({"model": model, "replica": r.rid,
-                                    "state": r.state,
+                                    "state": getattr(r, "display_state",
+                                                     r.state),
                                     "breaker_open": bool(r.breaker.is_open)})
             except Exception:
                 continue

@@ -130,6 +130,7 @@ class ShardedTrainer:
         self._batch_sharding = NamedSharding(mesh, P(self._batch_axis))
         self._multiproc = self._is_multiprocess()
         self._step = None
+        self._step_masked = None
         self._grads_fn = None
         self._apply_fn = None
 
@@ -232,7 +233,7 @@ class ShardedTrainer:
                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for k, v in tree.items()}
 
-        def compute_loss(params, aux, x, y):
+        def compute_loss(params, aux, x, y, w=None):
             # AMP policy: bf16 params/activations in fwd+bwd; the cast sits
             # inside the grad so gradients land back in fp32 master dtype.
             # aux (BN moving stats, rng key) stays uncast: stats only feed
@@ -255,7 +256,17 @@ class ShardedTrainer:
                 out_nd, y_nd = NDArray(out), NDArray(y)
                 sess.note_created(out_nd)
                 sess.note_created(y_nd)
-                loss = loss_fn(out_nd, y_nd)
+                if w is None:
+                    loss = loss_fn(out_nd, y_nd)
+                else:
+                    # per-token sample weight (pad masking): gluon losses
+                    # broadcast_mul it into the per-element loss before
+                    # their mean, so a weight normalized to sum to the
+                    # element count turns the final .mean() into
+                    # sum(l*mask)/sum(mask)
+                    w_nd = NDArray(w)
+                    sess.note_created(w_nd)
+                    loss = loss_fn(out_nd, y_nd, w_nd)
             return loss.data_.mean(), new_aux
 
         return compute_loss
@@ -342,6 +353,48 @@ class ShardedTrainer:
             out_shardings=out_shardings,
             donate_argnums=(0, 1, 2), sig_argnums=(3, 4))
 
+    def _build_masked_step(self):
+        """The pad-masked variant of the fused step: one extra (B,) int32
+        ``length`` operand (StreamBatch.length — per-row valid token
+        counts), mask built in-graph from an iota compare so the program
+        stays ONE executable across calls (length values are runtime
+        data, never folded into the signature). The mask enters as a
+        normalized per-token sample weight, making the step's scalar
+        loss exactly sum(loss*mask)/sum(mask) — bitwise-equal to
+        weighting with an explicitly precomputed host-side mask."""
+        import jax
+        import jax.numpy as jnp
+
+        update = self._update
+        compute_loss = self._make_compute_loss()
+
+        def masked_loss(params, aux, x, y, length):
+            t = int(x.shape[1])
+            mask = (jnp.arange(t, dtype=jnp.int32)[None, :]
+                    < length.astype(jnp.int32)[:, None]
+                    ).astype(jnp.float32)
+            # normalize so the loss's final mean over B*T elements
+            # becomes the mean over the sum(mask) REAL tokens
+            w = (mask * (float(mask.size) / jnp.sum(mask)))[..., None]
+            return compute_loss(params, aux, x, y, w)
+
+        def step(params, aux, opt_state, x, y, length):
+            (loss, new_aux), grads = jax.value_and_grad(
+                masked_loss, has_aux=True)(params, aux, x, y, length)
+            new_params, new_opt = update(params, grads, opt_state)
+            return new_params, new_aux, new_opt, loss
+
+        opt_sharding = self._opt_sharding()
+        out_shardings = (self._param_sharding, self._aux_sharding,
+                         opt_sharding, None)
+        self._step_masked = self._capture_exec(
+            step, "sharded_step_masked",
+            in_shardings=(self._param_sharding, self._aux_sharding,
+                          opt_sharding, self._batch_sharding,
+                          self._batch_sharding, self._batch_sharding),
+            out_shardings=out_shardings,
+            donate_argnums=(0, 1, 2), sig_argnums=(3, 4, 5))
+
     @classmethod
     def for_multihost(cls, net, loss_fn, optimizer="sgd",
                       optimizer_params=None, axes=None, coordinator=None,
@@ -378,6 +431,7 @@ class ShardedTrainer:
                                    dict(self._optimizer_params))
         self._update = update
         self._step = None  # rebuild (and recompile) with the new rate
+        self._step_masked = None
         self._grads_fn = self._apply_fn = None  # elastic path too
 
     @property
@@ -401,8 +455,16 @@ class ShardedTrainer:
         return any(d.process_index != jax.process_index()
                    for d in self.mesh.devices.flat)
 
-    def step(self, x, y, microbatches=None):
+    def step(self, x, y, microbatches=None, length=None):
         """Run one sharded training step; returns the scalar loss.
+
+        ``length`` (optional, (B,) int32 — ``StreamBatch.length``'s
+        per-row valid token counts) masks pad tokens out of the loss:
+        the step computes sum(loss*mask)/sum(mask) over the real tokens
+        via a separate masked executable whose mask is built in-graph
+        from an iota compare, so repeated masked calls stay ONE
+        executable (length values are runtime data). The masked path is
+        fused-only: combine it with ``microbatches`` > 1 and it raises.
 
         On a multi-process mesh, `x`/`y` are this process's LOCAL shard of
         the global batch (assembled with
@@ -429,9 +491,9 @@ class ShardedTrainer:
         """
         with _obs_trace.span("train.sharded_step",
                              step=self._step_count + 1):
-            return self._step_impl(x, y, microbatches)
+            return self._step_impl(x, y, microbatches, length)
 
-    def _step_impl(self, x, y, microbatches):
+    def _step_impl(self, x, y, microbatches, length=None):
         import warnings
 
         import jax
@@ -446,19 +508,32 @@ class ShardedTrainer:
         # stale executables so the next build re-traces under the new
         # table — the retrace lands in the capture forensics, and the
         # AOT key (which folds the same token) can never false-hit
-        if self._step is not None or self._grads_fn is not None:
+        if self._step is not None or self._step_masked is not None \
+                or self._grads_fn is not None:
             from .. import capture as _capture
 
             if _capture._schedule_token() != getattr(self, "_sched_token",
                                                      None):
                 self._step = None
+                self._step_masked = None
                 self._grads_fn = self._apply_fn = None
-        if self._step is None:
+        if length is not None and microbatches is not None \
+                and int(microbatches) != 1:
+            raise ValueError(
+                "length= (pad masking) runs the fused step only; "
+                "accumulated microbatches would re-normalize the mask "
+                "per slice — request microbatches=None")
+        if length is not None:
+            if self._step_masked is None:
+                self._build_masked_step()
+        elif self._step is None:
             self._build_step()
         if isinstance(x, NDArray):
             x = x.data_
         if isinstance(y, NDArray):
             y = y.data_
+        if isinstance(length, NDArray):
+            length = length.data_
         with _obs_trace.span("sharded.h2d"):
             if self._multiproc:
                 import numpy as np
@@ -475,6 +550,8 @@ class ShardedTrainer:
 
                 x = assemble(x)
                 y = assemble(y)
+                if length is not None:
+                    length = assemble(length)
             else:
                 # skip the put when the batch already sits on the mesh
                 # with the right sharding (the steady-state training
@@ -487,6 +564,10 @@ class ShardedTrainer:
                 if not (isinstance(y, jax.Array) and
                         y.sharding.is_equivalent_to(bs, y.ndim)):
                     y = jax.device_put(y, bs)
+                if length is not None and not (
+                        isinstance(length, jax.Array) and
+                        length.sharding.is_equivalent_to(bs, length.ndim)):
+                    length = jax.device_put(length, bs)
         self._step_count += 1
         _watchdog.note_step(self._step_count)
         rows = int(x.shape[0])
@@ -512,6 +593,9 @@ class ShardedTrainer:
         else:
             # sticky n was validated against the batch size that OOMed
             n = fit_count(self._elastic_n)
+        if length is not None:
+            n = 1  # masked path is fused-only (no mask re-normalization
+            # per microbatch slice); an OOM here surfaces, never shrinks
         while True:
             try:
                 # one guard per ATTEMPT: a legitimate elastic retry
@@ -527,7 +611,14 @@ class ShardedTrainer:
                     _faults.maybe_oom_step()
                     with _obs_trace.span("sharded.execute",
                                          microbatches=n):
-                        if n <= 1:
+                        if length is not None:
+                            if self._step_masked is None:  # mesh rebound
+                                self._build_masked_step()
+                            self.params, self.aux, self.opt_state, loss = \
+                                self._step_masked(self.params, self.aux,
+                                                  self.opt_state, x, y,
+                                                  length)
+                        elif n <= 1:
                             if self._step is None:  # mesh rebound mid-retry
                                 self._build_step()
                             self.params, self.aux, self.opt_state, loss = \
@@ -545,6 +636,8 @@ class ShardedTrainer:
                         or not _elastic.mesh_shrink_enabled():
                     raise
                 x, y = self._recover_peer_loss(e, x, y)
+                if length is not None:
+                    length = jax.device_put(length, self._batch_sharding)
                 shards = self._batch_shards()
                 if microbatches is not None:
                     if rows % n or (rows // n) % max(1, shards):
@@ -557,7 +650,7 @@ class ShardedTrainer:
                     n = fit_count(max(n, self._elastic_n))
                 continue
             except Exception as e:
-                if microbatches is not None \
+                if microbatches is not None or length is not None \
                         or not (_elastic.enabled()
                                 and _elastic.is_oom_error(e)):
                     # explicit schedules are the caller's contract —

@@ -117,6 +117,24 @@ Supported fault kinds (the hook that honours each is noted):
                                   the drill proves a step-time anomaly
                                   opens one incident naming the
                                   implicated perf-ledger key
+- ``rollout_bad_weights``       — poison a canaried weight rollout's
+                                  candidate params with NaN
+                                  (``serving.operator.RolloutManager``),
+                                  so the drill proves the canary health
+                                  gate rejects the artifact and rolls
+                                  back instantly with zero
+                                  client-visible errors
+- ``canary_slo_regression``     — inflate the measured canary request
+                                  latencies a rollout's SLO regression
+                                  window ingests (same manager), so the
+                                  drill proves a slow candidate is
+                                  rolled back by the latency gate
+- ``autoscale_flap``            — oscillate the autoscaler's queue
+                                  signal between extremes every
+                                  evaluation (``serving.operator
+                                  .Autoscaler``), so the drill proves
+                                  hysteresis/cooldown bound the scale
+                                  events instead of thrashing
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -147,7 +165,9 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_replica_crash", "maybe_replica_hang",
            "maybe_replica_nan_storm", "maybe_calib_table_drift",
            "maybe_perf_regression", "maybe_slo_burn",
-           "maybe_step_time_anomaly", "maybe_corrupt_record"]
+           "maybe_step_time_anomaly", "maybe_corrupt_record",
+           "maybe_rollout_bad_weights", "maybe_canary_slo_regression",
+           "maybe_autoscale_flap"]
 
 
 class SimulatedCrash(BaseException):
@@ -631,6 +651,81 @@ def maybe_peer_death():
     if fault is not None and fault.should_fire():
         return int(os.environ.get("MXNET_TPU_FAULT_PEER_RANK", "1"))
     return None
+
+
+def maybe_rollout_bad_weights(params):
+    """When ``rollout_bad_weights`` fires, return the candidate rollout
+    ``params`` (dict name -> array/NDArray) with the first
+    floating-point entry replaced by all-NaN — same name, shape and
+    dtype, so the candidate sails through ``swap_params`` validation and
+    must be caught by the RolloutManager's canary health gate (nonfinite
+    canary outputs → instant rollback, zero client-visible errors).
+    Hooked at the top of ``RolloutManager.rollout_weights``."""
+    if not _ACTIVE:
+        return params
+    fault = _ACTIVE.get("rollout_bad_weights")
+    if fault is None:
+        return params
+    import numpy as np
+
+    target = None
+    for name, v in params.items():
+        a = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            target = (name, a)
+            break
+    if target is None:
+        raise FaultInjected(
+            "rollout_bad_weights armed but the candidate has no "
+            f"floating-point parameter to poison (params: {list(params)})")
+    if not fault.should_fire():
+        return params
+    out = dict(params)
+    out[target[0]] = np.full_like(target[1], np.nan)
+    return out
+
+
+def maybe_canary_slo_regression(seconds):
+    """When ``canary_slo_regression`` fires, return one measured canary
+    request latency inflated by ``MXNET_TPU_FAULT_CANARY_SLO_X``
+    (default 10) — far outside the ``p50 <= baseline x
+    MXNET_TPU_ROLLOUT_MAX_LATENCY_X`` regression window. Hooked exactly
+    where ``RolloutManager`` ingests each canary latency sample, so the
+    drill proves a slow-but-numerically-fine candidate is rolled back by
+    the latency gate, not promoted."""
+    if not _ACTIVE:
+        return seconds
+    fault = _ACTIVE.get("canary_slo_regression")
+    if fault is None or not fault.should_fire():
+        return seconds
+    try:
+        factor = float(os.environ.get(
+            "MXNET_TPU_FAULT_CANARY_SLO_X", "10"))
+    except ValueError:
+        factor = 10.0
+    return seconds * factor
+
+
+def maybe_autoscale_flap(queue_depth):
+    """When ``autoscale_flap`` fires, return an oscillating
+    queue-per-replica signal in place of the measured one: alternate
+    fires read ``MXNET_TPU_FAULT_FLAP_QUEUE`` (default 1e6 — above any
+    sane scale-up threshold) and 0.0 (below any scale-down threshold).
+    Hooked where ``Autoscaler.evaluate`` reads its load signal, so the
+    drill proves hysteresis + per-direction cooldowns bound the scale
+    events a flapping signal can cause instead of thrashing the fleet."""
+    if not _ACTIVE:
+        return queue_depth
+    fault = _ACTIVE.get("autoscale_flap")
+    if fault is None or not fault.should_fire():
+        return queue_depth
+    try:
+        high = float(os.environ.get("MXNET_TPU_FAULT_FLAP_QUEUE", "1e6"))
+    except ValueError:
+        high = 1e6
+    # fired was incremented by should_fire(): odd fire -> spike, even
+    # fire -> trough, a maximally adversarial square wave
+    return high if fault.fired % 2 == 1 else 0.0
 
 
 _install_from_env()

@@ -67,6 +67,13 @@ _STATS = {
     "fleet_drains": 0,             # replicas drained out of rotation
     "fleet_shed_overloaded": 0,    # requests shed with FleetOverloaded
     "fleet_deadline_exceeded": 0,  # router-side deadline expiries
+    # Operator (serving/operator.py: Autoscaler + RolloutManager)
+    "fleet_scale_up": 0,           # replicas admitted by scale-up
+    "fleet_scale_down": 0,         # replicas drained out by scale-down
+    "fleet_scale_hold": 0,         # autoscaler evaluations that held steady
+    "rollout_promotions": 0,       # canaried artifacts promoted fleet-wide
+    "rollout_rollbacks": 0,        # artifacts rolled back on a gate failure
+    "rollout_holds": 0,            # rollouts held (no-op: same artifact)
 }
 
 _LAT_LOCK = _threading.Lock()
@@ -138,8 +145,9 @@ from .batcher import (BatchServer, DeadlineExceeded, ServerClosed,  # noqa: E402
                       ServerOverloaded)
 from .fleet import (Fleet, FleetClosed, FleetOverloaded,  # noqa: E402
                     ReplicaSupervisor, Router)
+from .operator import Autoscaler, RolloutManager  # noqa: E402
 
 __all__ = ["Predictor", "BatchServer", "DeadlineExceeded", "ServerClosed",
            "ServerOverloaded", "Fleet", "FleetClosed", "FleetOverloaded",
-           "ReplicaSupervisor", "Router", "stats", "reset_stats",
-           "record_latency"]
+           "ReplicaSupervisor", "Router", "Autoscaler", "RolloutManager",
+           "stats", "reset_stats", "record_latency"]

@@ -252,6 +252,7 @@ class _ThreadReplica:
         self._server_kw = dict(server_kw or {})
         self._lock = threading.Lock()     # guards server/predictor swap
         self.state = "RESTARTING"
+        self.scale_drain = False          # draining for SCALE, not health
         self.outstanding = 0              # mutated under the Router lock
         self.generation = 0
         self.transitions = deque(maxlen=64)
@@ -340,6 +341,17 @@ class _ThreadReplica:
         with self._lock:
             server = self.server
         return server is not None and server._worker.is_alive()
+
+    @property
+    def display_state(self):
+        """``state`` with scale-driven drains distinguished: a replica
+        draining because the autoscaler removed it (not because it is
+        sick) reports ``DRAINING(scale)`` — and is excluded from
+        health-floor accounting (observability.alerts/metrics), so a
+        scale-down on a healthy fleet can never read as degradation."""
+        if self.scale_drain and self.state == "DRAINING":
+            return "DRAINING(scale)"
+        return self.state
 
     def record_latency(self, seconds):
         with self._lat_lock:
@@ -861,6 +873,105 @@ class ReplicaSupervisor:
                     self._strikes[replica.rid] = 0
                     self.fail_replica(replica, reason="probe_failure")
 
+    # ------------------------------------------------------------------ scaling
+    def add_replica(self, model, replica):
+        """Scale-up admission: build the replica (warm from the AOT
+        compile cache — load-bound, not compile-bound, when
+        ``MXNET_TPU_COMPILE_CACHE`` is populated), then pass one
+        half-open breaker probe through the full serving path BEFORE the
+        router can ever see it. Joins the group only on a passing probe;
+        a build or probe failure tears the newcomer down and raises —
+        the existing members are never touched."""
+        group = self.group(model)
+        self._set(replica, "RESTARTING", "scale_up")
+        try:
+            replica.build()
+        except Exception as e:
+            self._set(replica, "DEAD", f"scale_up build failed: {e}")
+            raise
+        self._set(replica, "WARMING", "scale_up")
+        # predictive AOT pre-warm: every declared bucket executable is
+        # built BEFORE the router can see this replica — from the
+        # persisted compile cache when MXNET_TPU_COMPILE_CACHE is set
+        # (warmup_cache_hits counts the loads), traced+compiled once
+        # here when not. Scale-up cost is load-bound, never a
+        # first-request compile stall on the serving path.
+        pred = getattr(replica, "predictor", None)
+        if pred is not None and getattr(pred, "_input_tails", None):
+            try:
+                pred.warmup()
+            except Exception as e:
+                replica.drain_close(timeout=self._drain_timeout())
+                self._set(replica, "DEAD", f"scale_up warmup failed: {e}")
+                raise MXNetError(
+                    f"scale-up replica {model}/{replica.rid} failed its "
+                    f"pre-admission bucket warmup: {e}")
+        replica.breaker.begin_probe()
+        if not replica.probe(self._probe_timeout()):
+            replica.drain_close(timeout=self._drain_timeout())
+            self._set(replica, "DEAD", "scale_up (warm probe failed)")
+            raise MXNetError(
+                f"scale-up replica {model}/{replica.rid} failed its "
+                "admission probe; not admitted")
+        replica.breaker.note_success()
+        with self._lock:
+            group.replicas.append(replica)
+        self._set(replica, "HEALTHY", "scale_up")
+        _STATS["fleet_scale_up"] += 1
+        return replica
+
+    def remove_replica(self, model, replica=None):
+        """Scale-down: drain one HEALTHY replica for *scale* (not
+        health) and remove it from the group. In-flight requests finish
+        under the drain deadline; while draining the replica reports
+        ``DRAINING(scale)`` and never counts against the health floor.
+        Picks the least-loaded member when ``replica`` is None. Returns
+        the removed replica, or None when nothing was eligible."""
+        group = self.group(model)
+        with self._lock:
+            if self._stop.is_set():
+                return None
+            cands = [r for r in group.replicas if r.state == "HEALTHY"]
+            if replica is not None:
+                cands = [r for r in cands if r is replica]
+            if not cands or len([r for r in group.replicas
+                                 if not r.scale_drain]) <= 1:
+                return None           # never drain the last member
+            victim = min(cands, key=lambda r: (r.outstanding, -r.rid))
+            prev = victim.state
+            victim.state = "DRAINING"
+            victim.scale_drain = True
+            victim.transitions.append(
+                (time.monotonic(), prev, "DRAINING(scale)", "scale_down"))
+            worker = threading.Thread(
+                target=self._scale_drain, args=(group, victim),
+                name=(f"mxnet-tpu-fleet-scaledown-{victim.model}"
+                      f"-{victim.rid}"),
+                daemon=True)
+            self._workers = [t for t in self._workers if t.is_alive()]
+            self._workers.append(worker)
+        _STATS["fleet_scale_down"] += 1
+        _obs_flight.record("fleet", model=victim.model, replica=victim.rid,
+                           prev=prev, state="DRAINING(scale)",
+                           reason="scale_down")
+        worker.start()
+        return victim
+
+    def _scale_drain(self, group, replica):
+        replica.drain_close(timeout=self._drain_timeout())
+        with self._lock:
+            try:
+                group.replicas.remove(replica)
+            except ValueError:
+                pass
+            prev = replica.display_state
+            replica.state = "DEAD"
+            replica.transitions.append(
+                (time.monotonic(), prev, "DEAD", "scale_down complete"))
+        _obs_flight.record("fleet", model=replica.model,
+                           replica=replica.rid, prev=prev, state="DEAD",
+                           reason="scale_down complete")
+
     # ------------------------------------------------------- failure + restart
     def on_breaker_open(self, replica):
         """Router escalation: K consecutive request failures tripped the
@@ -1093,9 +1204,12 @@ class Router:
 
     def _overloaded(self, group):
         now = time.monotonic()
-        open_breakers = unhealthy = 0
+        open_breakers = unhealthy = total = 0
         retry_after = None
         for r in group.replicas:
+            if r.scale_drain:
+                continue   # leaving by scale decision: not degradation
+            total += 1
             if r.state != "HEALTHY":
                 unhealthy += 1
             if r.breaker.is_open:
@@ -1104,7 +1218,7 @@ class Router:
                 if wait > 0 and (retry_after is None or wait < retry_after):
                     retry_after = wait
         _STATS["fleet_shed_overloaded"] += 1
-        return FleetOverloaded(group.model, len(group.replicas),
+        return FleetOverloaded(group.model, total,
                                open_breakers, unhealthy, retry_after)
 
     # ------------------------------------------------------------------- submit
@@ -1410,6 +1524,15 @@ class Fleet:
                       else _env_float("MXNET_TPU_FLEET_PROBE_INTERVAL_MS",
                                       200.0)) / 1e3
         self.mode = mode
+        # retained so scale_to can mint new replicas identical to the
+        # founders (same factory, breaker policy, server config, and a
+        # continuing rid sequence)
+        self._factories = factories
+        self._server_kw = server_kw
+        self._replica_cls = cls
+        self._rid = rid
+        self._breaker_k = k
+        self._breaker_cooldown_s = cooldown_s
         self._sup = ReplicaSupervisor(
             groups, kvstore=kvstore, probe_interval_s=interval_s,
             probe_timeout_s=probe_timeout, drain_timeout_s=drain_timeout,
@@ -1455,8 +1578,44 @@ class Fleet:
         return self._sup.replicas(_variant_key(model, variant))
 
     def replica_states(self, model="default", variant=None):
-        return [r.state
+        """Per-replica states; a replica draining for SCALE (autoscaler
+        removal, not sickness) reports the distinct ``DRAINING(scale)``."""
+        return [r.display_state
                 for r in self._sup.replicas(_variant_key(model, variant))]
+
+    def replica_count(self, model="default", variant=None):
+        """Members of the group that are IN the fleet (scale-draining
+        leavers excluded) — the autoscaler's notion of current size."""
+        return len([r for r in self._sup.replicas(_variant_key(model,
+                                                               variant))
+                    if not r.scale_drain])
+
+    def scale_to(self, target, model="default", variant=None):
+        """Scale one replica group to ``target`` members (the actuator
+        under serving.operator.Autoscaler, also an operator hook).
+
+        Scale-up mints replicas identical to the founders, builds each
+        warm from the AOT compile cache, and admits it only after a
+        passing half-open probe — the router never sees a cold or sick
+        newcomer. Scale-down drains the least-loaded member
+        (``DRAINING(scale)``): in-flight requests complete under the
+        drain deadline, and the leaver never counts against the health
+        floor. Returns the resulting member count."""
+        key = _variant_key(model, variant)
+        target = int(target)
+        if target < 1:
+            raise MXNetError(
+                f"scale_to needs target >= 1 replica, got {target}")
+        while self.replica_count(model, variant) < target:
+            replica = self._replica_cls(
+                key, next(self._rid), self._factories[key],
+                self._server_kw,
+                _Breaker(self._breaker_k, self._breaker_cooldown_s))
+            self._sup.add_replica(key, replica)
+        while self.replica_count(model, variant) > target:
+            if self._sup.remove_replica(key) is None:
+                break
+        return self.replica_count(model, variant)
 
     def fail_replica(self, rid=0, model="default", reason="operator",
                      variant=None):
@@ -1475,7 +1634,8 @@ class Fleet:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if all(r.state == "HEALTHY"
-                   for m in models for r in self._sup.replicas(m)):
+                   for m in models for r in self._sup.replicas(m)
+                   if not r.scale_drain):
                 return True
             time.sleep(0.02)
         return False

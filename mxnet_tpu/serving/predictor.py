@@ -414,6 +414,56 @@ class Predictor:
             pass  # best-effort forensics: a full disk never fails
                   # the quantize itself
 
+    # ------------------------------------------------------------ live swap
+    def swap_params(self, params):
+        """Atomically replace bound parameter/aux VALUES in-place — the
+        zero-downtime weight-rollout primitive (serving.operator).
+
+        Param values are runtime operands, not part of the AOT
+        fingerprint, so flipping them keeps every compiled bucket
+        executable live: no retrace, no recompile, no dropped request.
+        All target cells must already exist with matching shape+dtype
+        (a changed architecture is a new Predictor, not a swap); the
+        whole validation runs BEFORE the first flip so a rejected swap
+        leaves the predictor untouched. The flip itself happens under
+        the predictor lock, which ``forward_batch`` shares for its
+        operand gather: a concurrent request sees all-old or all-new,
+        never a torn mix.
+
+        Returns the prior values as a ``{"arg:NAME"/"aux:NAME": NDArray}``
+        snapshot — feed it back to ``swap_params`` to roll back.
+        """
+        from ..ndarray.ndarray import NDArray
+
+        new_args, new_aux = self._split_params(params)
+        with self._lock:
+            for src, dst, kind in ((new_args, self._arg_params, "arg"),
+                                   (new_aux, self._aux_params, "aux")):
+                for name, v in src.items():
+                    cell = dst.get(name)
+                    if cell is None:
+                        raise MXNetError(
+                            f"swap_params: '{name}' is not a bound "
+                            f"{kind} parameter of this predictor (data "
+                            "inputs and unbound names cannot be "
+                            "swapped)")
+                    if tuple(cell.shape) != tuple(v.shape) or \
+                            cell.dtype != v.dtype:
+                        raise MXNetError(
+                            f"swap_params: {kind} '{name}' is "
+                            f"{tuple(v.shape)}/{v.dtype} but the bound "
+                            f"cell is {tuple(cell.shape)}/{cell.dtype}; "
+                            "a changed architecture needs a new "
+                            "Predictor, not a live swap")
+            prev = {}
+            for src, dst, kind in ((new_args, self._arg_params, "arg"),
+                                   (new_aux, self._aux_params, "aux")):
+                for name, v in src.items():
+                    cell = dst[name]
+                    prev[f"{kind}:{name}"] = NDArray(cell._data, self._ctx)
+                    cell._data = v._data
+        return prev
+
     # ----------------------------------------------------------------- buckets
     def bucket_for(self, n):
         """Smallest declared bucket that fits ``n`` rows (``n`` itself —
@@ -529,8 +579,13 @@ class Predictor:
         # path: with MXNET_TPU_COMPILE_CACHE set, a serving cold-start
         # (warmup or first batch) loads the persisted program instead of
         # tracing + XLA-compiling every bucket (docs/capture.md)
-        return ex.enable_capture(f"serving_bucket{bucket}",
-                                 self._program_fingerprint(bucket, sig))
+        ex = ex.enable_capture(f"serving_bucket{bucket}",
+                               self._program_fingerprint(bucket, sig))
+        # swap_params flips the shared cells under self._lock; the
+        # executor gathers its operands under the same lock so a
+        # concurrent forward sees a consistent generation (never torn)
+        ex._param_read_lock = self._lock
+        return ex
 
     def _program_fingerprint(self, bucket, sig):
         """Structural identity of one bucket executable for the AOT

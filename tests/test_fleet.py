@@ -13,6 +13,7 @@ kill-hammer, the kvstore excise_dead_peers hook is wired into
 membership transitions, restarts warm-start from the AOT compile cache,
 and subprocess replicas survive a real process kill.
 """
+import gc
 import os
 import threading
 import time
@@ -585,3 +586,166 @@ def test_fleet_counters_reach_profiler():
     s = profiler.dispatch_stats()
     assert s["fleet_requests"] == 0
     assert s["fleet_p99_latency_us"] == 0
+
+
+# ------------------------------------------------------------ autoscaling
+
+
+def test_scale_down_drains_with_distinct_state_and_zero_alerts(monkeypatch):
+    """Satellite: a replica draining for SCALE reports DRAINING(scale)
+    and never counts against fleet_healthy_floor — a scale-down on a
+    healthy fleet opens ZERO alerts even with the floor set right at
+    the post-scale size."""
+    from mxnet_tpu.observability import alerts
+
+    monkeypatch.setenv("MXNET_TPU_ALERT_HEALTHY_FLOOR", "2")
+    gc.collect()         # drop lingering closed fleets from the weakset
+    alerts.reset()       # rebuild the rule set with the floor above
+    prev = alerts.set_enabled(False)   # synthetic clock, no auto-ticks
+    try:
+        with _fleet(replicas=3) as fleet:
+            assert fleet.wait_healthy(timeout=15)
+            t = 1000.0
+            alerts.evaluate(now=t, force=True)
+            assert not alerts.incidents()
+            victim = fleet.supervisor.remove_replica("default")
+            assert victim is not None
+            # the transition log pins the distinct display state even
+            # when the drain itself wins the race with this assert
+            assert any(new == "DRAINING(scale)"
+                       for _t, _prev, new, _why in victim.transitions)
+            for _ in range(3):
+                t += 30.0
+                alerts.evaluate(now=t, force=True)
+            assert alerts.incidents() == []
+            deadline = time.monotonic() + 10
+            while (len(fleet.replicas()) > 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert fleet.replica_states() == ["HEALTHY", "HEALTHY"]
+            t += 30.0
+            alerts.evaluate(now=t, force=True)
+            assert alerts.incidents() == []
+            assert serving.stats()["fleet_scale_down"] == 1
+    finally:
+        alerts.set_enabled(prev)
+        alerts.reset()
+
+
+def test_closed_fleet_never_trips_the_healthy_floor(monkeypatch):
+    """A close()d fleet lingers in the weakref registry until GC; its
+    all-DEAD replicas are operator intent (shutdown), and the floor
+    probe must skip it — otherwise every fleet teardown poisons the
+    next evaluation window of the process."""
+    from mxnet_tpu.observability import alerts
+
+    monkeypatch.setenv("MXNET_TPU_ALERT_HEALTHY_FLOOR", "1")
+    alerts.reset()
+    prev = alerts.set_enabled(False)
+    try:
+        fleet = _fleet(replicas=1)
+        assert fleet.wait_healthy(timeout=15)
+        fleet.close()
+        t = 1000.0
+        for _ in range(4):           # hold the reference: no GC rescue
+            t += 30.0
+            alerts.evaluate(now=t, force=True)
+        assert not [i for i in alerts.incidents()
+                    if i["rule"] == "fleet_healthy_floor"]
+    finally:
+        alerts.set_enabled(prev)
+        alerts.reset()
+
+
+def test_scale_down_never_drains_the_last_replica():
+    with _fleet(replicas=1) as fleet:
+        assert fleet.wait_healthy(timeout=15)
+        assert fleet.supervisor.remove_replica("default") is None
+        assert fleet.scale_to(1) == 1
+        assert fleet.replica_states() == ["HEALTHY"]
+        with pytest.raises(mx.base.MXNetError, match="target >= 1"):
+            fleet.scale_to(0)
+
+
+def test_scale_down_under_load_zero_lost():
+    """Satellite: 8 client threads hammer the fleet while the
+    autoscaler removes 2 of 4 replicas — zero lost/errored requests,
+    all futures terminate, survivors keep serving bit-identical
+    answers."""
+    ref = _reference()
+    results = {"ok": 0, "err": 0, "lost": 0, "bad": 0}
+    lock = threading.Lock()
+    with _fleet(replicas=4, retries=3) as fleet:
+        assert fleet.wait_healthy(timeout=15)
+        for _ in range(8):
+            fleet.submit(X1, deadline_ms=20000).result(timeout=20)  # warm
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                fut = fleet.submit(X1, deadline_ms=5000)
+                try:
+                    out = fut.result(timeout=10)
+                    with lock:
+                        if np.array_equal(out[0], ref):
+                            results["ok"] += 1
+                        else:
+                            results["bad"] += 1
+                except _futures.TimeoutError:
+                    with lock:
+                        results["lost"] += 1
+                except Exception:
+                    with lock:
+                        results["err"] += 1
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        assert fleet.scale_to(2) == 2       # drains the 2 least-loaded
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert not any(t.is_alive() for t in threads)
+        # the drains complete: leavers leave, survivors stay healthy
+        deadline = time.monotonic() + 10
+        while len(fleet.replicas()) > 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fleet.replica_states() == ["HEALTHY", "HEALTHY"]
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=10)
+        assert np.array_equal(out[0], ref)
+    assert results["lost"] == 0, results
+    assert results["err"] == 0, results
+    assert results["bad"] == 0, results
+    assert results["ok"] > 0, results
+    assert serving.stats()["fleet_scale_down"] == 2
+
+
+def test_scale_up_admits_probed_warm_replicas(tmp_path, monkeypatch):
+    """Scale-up mints replicas identical to the founders, pre-warms
+    every bucket from the AOT cache BEFORE admission (scale-up is
+    load-bound, not compile-bound), and walks them through the
+    admission probe."""
+    monkeypatch.setenv("MXNET_TPU_COMPILE_CACHE", str(tmp_path / "aot"))
+
+    def factory():
+        mx.random.seed(7)
+        net = mx.gluon.nn.Dense(4, in_units=IN_UNITS, prefix="fleet_up_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (IN_UNITS,)}, batch_sizes=(4,))
+
+    with _fleet(replicas=2, factories=factory) as fleet:
+        assert fleet.wait_healthy(timeout=15)
+        ref = fleet.submit(X1, deadline_ms=10000).result(timeout=15)
+        assert fleet.scale_to(4) == 4
+        assert fleet.replica_states() == ["HEALTHY"] * 4
+        newcomers = fleet.replicas()[2:]
+        assert [r.rid for r in newcomers] == [2, 3]
+        for r in newcomers:
+            # every declared bucket loaded from the persisted cache
+            assert r.predictor.warmup_cache_hits >= 1
+        out = fleet.submit(X1, deadline_ms=10000).result(timeout=10)
+        assert np.array_equal(out[0], ref[0])
+        assert serving.stats()["fleet_scale_up"] == 2

@@ -496,6 +496,82 @@ def test_kill_resume_bitwise_bucketed_dp8(token_shards, tmp_path):
     assert _params_sha(res_net) == _params_sha(oracle_net)
 
 
+# ---------------------------------------------------- pad-token loss masking
+
+def test_pad_masked_step_bitwise_vs_explicit_mask_oracle():
+    """StreamBatch.length-driven pad masking (ISSUE 17 satellite): the
+    masked captured step builds its mask in-graph from the (B,) length
+    vector, stays ONE executable across calls, and is bitwise-equal to
+    an oracle that weights the same loss with an explicitly precomputed
+    host-side mask."""
+    import jax
+
+    mesh = create_mesh({"dp": 2}, _devices(2))
+    B, T = 8, SEQ
+    x, y = _ids((B, T), seed=11), _ids((B, T), seed=12)
+    rs = np.random.RandomState(17)
+    length = rs.randint(1, T + 1, size=B).astype(np.int32)
+    for i, n in enumerate(length):  # StreamBatch zeroes padded tails
+        x[i, n:] = 0
+        y[i, n:] = 0
+    assert length.min() < T  # some rows really are padded
+
+    # the oracle's explicit mask, normalized exactly like the in-graph
+    # one: mean over B*T elements becomes mean over the real tokens
+    mask = (np.arange(T, dtype=np.int32)[None, :]
+            < length[:, None]).astype(np.float32)
+    w = (mask * (np.float32(mask.size) / mask.sum(dtype=np.float32))
+         )[..., None]
+
+    def run(masked):
+        net = _build_lm("padtlm_", num_layers=2)
+        if masked:
+            trainer = _trainer_for(net, mesh)
+            step = capture.capture(trainer)
+            losses = [np.asarray(step(x, y, length=length)).tobytes()
+                      for _ in range(3)]
+        else:
+            base = gluon.loss.SoftmaxCrossEntropyLoss()
+            w_nd = mx.nd.array(w)
+            layout = SpecLayout.for_mesh(mesh)
+            trainer = ShardedTrainer(
+                net, lambda out, yl: base(out, yl, w_nd), "sgd",
+                {"learning_rate": 0.1}, mesh=mesh,
+                param_rules=layout.param_rules(),
+                batch_axis_name=layout.batch_axes() or "dp")
+            losses = [np.asarray(trainer.step(x, y)).tobytes()
+                      for _ in range(3)]
+        return net, trainer, losses
+
+    ref_net, _, ref_losses = run(masked=False)
+    net, trainer, losses = run(masked=True)
+    assert losses == ref_losses  # bitwise, not approx
+    a, b = _params_np(ref_net), _params_np(net)
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), k
+    # ONE masked executable served all three captured invocations
+    assert len(trainer._step_masked.compiled_signatures) == 1
+    assert capture.stats()["capture_steps"] == 3
+    # and masking changed the numbers vs the unmasked loss
+    plain_net = _build_lm("padtlm_", num_layers=2)
+    plain = _trainer_for(plain_net, mesh)
+    assert np.asarray(plain.step(x, y)).tobytes() != ref_losses[0]
+
+
+def test_pad_masked_step_rejects_microbatches():
+    mesh = create_mesh({"dp": 1}, _devices(1))
+    net = _build_lm("padmbtlm_")
+    trainer = _trainer_for(net, mesh)
+    x = _ids((4, SEQ), seed=21)
+    length = np.full((4,), SEQ, np.int32)
+    with pytest.raises(ValueError, match="fused step only"):
+        trainer.step(x, x, microbatches=2, length=length)
+    # microbatches=1 is the fused path: allowed
+    loss = trainer.step(x, x, microbatches=1, length=length)
+    assert np.isfinite(float(loss))
+
+
 # ------------------------------------------------- numerics: drive to blowup
 
 def test_overflow_prone_config_fires_explosion_and_bisects(tmp_path,

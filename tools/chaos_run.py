@@ -59,7 +59,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
               "perf_regression", "slo_burn", "step_time_anomaly",
-              "record_corrupt", "nonfinite_grad")
+              "record_corrupt", "nonfinite_grad", "rollout_bad_weights",
+              "canary_slo_regression", "autoscale_flap")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -1025,6 +1026,134 @@ def _drill_dist_connect_timeout(mx, workdir):
     return elapsed < 5.0, f"elapsed={elapsed:.2f}s"
 
 
+def _operator_fleet(mx, serving):
+    """Shared 2-replica fleet + candidate-params builder for the
+    operator drills (stable prefix so rollout candidates name the same
+    arguments the serving symbol binds)."""
+    import numpy as np
+
+    def factory():
+        mx.random.seed(5)
+        net = mx.gluon.nn.Dense(4, in_units=3, prefix="op_net_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,),
+            warmup=False)
+
+    def candidate():
+        mx.random.seed(5)
+        net = mx.gluon.nn.Dense(4, in_units=3, prefix="op_net_")
+        net.initialize()
+        return {f"arg:{name}": p.data()
+                for name, p in net.collect_params().items()}
+
+    fleet = serving.Fleet(factory, replicas=2, probe_interval_ms=50,
+                          breaker_k=2, retries=2, backoff_ms=1,
+                          breaker_cooldown_ms=100,
+                          server_kw={"batch_timeout_ms": 1.0})
+    return fleet, candidate, np.ones((1, 3), np.float32)
+
+
+def _drill_rollout_gate(mx, workdir, kind):
+    """A canaried weight rollout meets a bad artifact: the injected
+    fault poisons the candidate params with NaN (``rollout_bad_weights``
+    — caught by the canary health gate) or inflates the measured canary
+    latencies (``canary_slo_regression`` — caught by the SLO regression
+    window). Either way the rollout must return ``rollback``, the prior
+    artifact must keep serving bit-identical answers, and a client
+    hammer riding through the whole window must see ZERO errors."""
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+
+    serving.reset_stats()
+    fleet, candidate, x = _operator_fleet(mx, serving)
+    gate = "health" if kind == "rollout_bad_weights" else "latency"
+    try:
+        if not fleet.wait_healthy(timeout=20):
+            return False, "fleet never became healthy"
+        baseline = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+        rm = serving.RolloutManager(fleet, eval_batch=x, canary_calls=4)
+        results = {"ok": 0, "err": 0}
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    r = fleet.submit(x, deadline_ms=10000).result(
+                        timeout=10)
+                    results["ok"] += int(
+                        np.array_equal(r[0], baseline[0]))
+                except Exception:
+                    results["err"] += 1
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            with faults.inject(kind, times=None) as f:
+                res = rm.rollout_weights(candidate())
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        after = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+        s = serving.stats()
+        ok = (res["action"] == "rollback" and res.get("gate") == gate
+              and f.fired >= 1 and results["err"] == 0
+              and results["ok"] >= 1
+              and s["rollout_rollbacks"] >= 1
+              and s["rollout_promotions"] == 0
+              and np.array_equal(after[0], baseline[0]))
+        return ok, (f"action={res['action']} gate={res.get('gate')} "
+                    f"fired={f.fired} client_ok={results['ok']} "
+                    f"client_err={results['err']} "
+                    f"rollbacks={s['rollout_rollbacks']}")
+    finally:
+        fleet.close()
+
+
+def _drill_autoscale_flap(mx, workdir):
+    """A maximally adversarial square-wave load signal hits the
+    autoscaler every evaluation: hysteresis (distinct up/down
+    thresholds) + per-direction cooldowns must bound the damage to AT
+    MOST ONE scale event across the flap window — every other
+    evaluation is a recorded HOLD — and the fleet keeps serving
+    throughout."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+
+    serving.reset_stats()
+    fleet, _candidate, x = _operator_fleet(mx, serving)
+    try:
+        if not fleet.wait_healthy(timeout=20):
+            return False, "fleet never became healthy"
+        baseline = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+        asc = serving.Autoscaler(fleet, min_replicas=1, max_replicas=8,
+                                 up_queue=4.0, down_queue=1.0,
+                                 cooldown_s=3600.0)
+        with faults.inject("autoscale_flap", times=None) as f:
+            actions = [d["action"] for _ in range(8)
+                       for d in asc.evaluate()]
+        scale_events = sum(1 for a in actions if a != "hold")
+        after = fleet.submit(x, deadline_ms=10000).result(timeout=10)
+        s = serving.stats()
+        ok = (f.fired == 8 and scale_events <= 1
+              and actions.count("scale_down") == 0
+              and s["fleet_scale_hold"] >= 6
+              and fleet.replica_count() <= 3
+              and np.array_equal(after[0], baseline[0]))
+        return ok, (f"fired={f.fired} actions={actions} "
+                    f"scale_events={scale_events} "
+                    f"holds={s['fleet_scale_hold']} "
+                    f"replicas={fleet.replica_count()}")
+    finally:
+        fleet.close()
+
+
 def _dispatch_drill(mx, kind, tmp):
     if kind == "nan_grad":
         return _drill_nan_grad(mx, tmp)
@@ -1067,6 +1196,10 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_record_corrupt(mx, tmp)
     if kind == "nonfinite_grad":
         return _drill_nonfinite_grad(mx, tmp)
+    if kind in ("rollout_bad_weights", "canary_slo_regression"):
+        return _drill_rollout_gate(mx, tmp, kind)
+    if kind == "autoscale_flap":
+        return _drill_autoscale_flap(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

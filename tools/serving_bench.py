@@ -27,8 +27,15 @@ Sections (details on stderr):
            the ROADMAP item-1 serving gate, measured 1.45x on ResNet-18
            by tools/bench_int8.py.
 
+- operate (``--operate``): the operator sweep — under continuous load
+           the fleet scales 2 -> 4 (gates: scale-up-phase p99 <= 3x
+           steady-state, every newcomer AOT-warm with
+           ``warmup_cache_hits >= 1``) and a forced-bad-weights rollout
+           is rejected by the canary health gate with zero
+           client-visible errors and zero lost requests.
+
 Run: JAX_PLATFORMS=cpu python tools/serving_bench.py [--iters N]
-     [--skip-fleet] [--skip-int8]
+     [--skip-fleet] [--skip-int8] [--operate]
 """
 from __future__ import annotations
 
@@ -43,22 +50,25 @@ from concurrent import futures
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_predictor(mx, serving, buckets):
+def _mlp_params(seed=0):
     import numpy as np
 
-    data = mx.sym.var("data")
-    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
-    h = mx.sym.Activation(h, act_type="relu", name="relu1")
-    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
-    out = mx.sym.softmax(h, name="prob")
-    rng = np.random.RandomState(0)
-    params = {
+    rng = np.random.RandomState(seed)
+    return {
         "fc1_weight": (rng.randn(64, 20) * 0.1).astype(np.float32),
         "fc1_bias": np.zeros(64, np.float32),
         "fc2_weight": (rng.randn(10, 64) * 0.1).astype(np.float32),
         "fc2_bias": np.zeros(10, np.float32),
     }
-    return serving.Predictor(out, params, input_shapes={"data": (20,)},
+
+
+def _build_predictor(mx, serving, buckets):
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    out = mx.sym.softmax(h, name="prob")
+    return serving.Predictor(out, _mlp_params(), input_shapes={"data": (20,)},
                              batch_sizes=buckets, warmup=True)
 
 
@@ -220,6 +230,119 @@ def bench_fleet(mx, serving, replicas=4, clients=8, per_client=40):
     }
 
 
+def bench_operate(mx, serving, clients=8, phase_s=2.0):
+    """The operator sweep (docs/serving.md "Fleet operations"): under a
+    continuous closed-loop load, scale the fleet 2 -> 4 and require the
+    scale-up-phase p99 to stay <= 3x steady-state with every newcomer
+    admitted AOT-warm (``warmup_cache_hits >= 1``); then push a
+    NaN-poisoned weight artifact through the canaried rollout and
+    require the gate to reject it with ZERO client-visible errors and
+    zero lost requests end to end."""
+    import numpy as np
+
+    from mxnet_tpu.resilience import faults
+
+    serving.reset_stats()
+    faults.reset()
+    tmp = None
+    if not os.environ.get("MXNET_TPU_COMPILE_CACHE"):
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="mxnet_tpu_operate_")
+        os.environ["MXNET_TPU_COMPILE_CACHE"] = tmp.name
+    fleet = serving.Fleet(_fleet_factory, replicas=2,
+                          probe_interval_ms=100, breaker_k=3, retries=3,
+                          backoff_ms=2, breaker_cooldown_ms=200,
+                          server_kw={"batch_timeout_ms": 1.0})
+    xs = np.random.RandomState(4).rand(clients, 1, 20).astype(np.float32)
+    state = {"phase": "steady", "stop": False}
+    lats = {"steady": [], "scale_up": []}
+    counts = {"ok": 0, "err": 0, "lost": 0}
+    lock = threading.Lock()
+
+    def client(tid):
+        while not state["stop"]:
+            phase = state["phase"]
+            t0 = time.perf_counter()
+            fut = fleet.submit(xs[tid], deadline_ms=5000.0)
+            try:
+                fut.result(timeout=10)
+                dt = time.perf_counter() - t0
+                with lock:
+                    counts["ok"] += 1
+                    if phase in lats:
+                        lats[phase].append(dt)
+            except futures.TimeoutError:
+                with lock:
+                    counts["lost"] += 1
+            except Exception:
+                with lock:
+                    counts["err"] += 1
+
+    def p99(lat):
+        lat = sorted(lat)
+        return int(lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1) + 0.5))]
+                   * 1e6) if lat else 0
+
+    try:
+        # warm every starting replica's bucket executors off the clock
+        # (and seed the AOT cache the newcomers will hit)
+        for _ in range(4):
+            fleet.submit(xs[0], deadline_ms=10000.0).result(timeout=30)
+        threads = [threading.Thread(target=client, args=(t,), daemon=True)
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(phase_s)
+            state["phase"] = "scale_up"
+            fleet.scale_to(4)
+            time.sleep(phase_s)
+            state["phase"] = "rollout"
+            newcomers = [r for r in fleet.replicas() if r.rid >= 2]
+            warm_hits = [r.predictor.warmup_cache_hits for r in newcomers]
+            rm = serving.RolloutManager(
+                fleet, eval_batch=xs[0], canary_calls=4)
+            cand = {f"arg:{k}": mx.nd.array(v)
+                    for k, v in _mlp_params().items()}
+            with faults.inject("rollout_bad_weights"):
+                rollout = rm.rollout_weights(cand)
+            fleet.scale_to(2)
+        finally:
+            state["stop"] = True
+            for t in threads:
+                t.join(timeout=30)
+        recovered = fleet.wait_healthy(timeout=30)
+        stats = serving.stats()
+    finally:
+        fleet.close()
+        if tmp is not None:
+            os.environ.pop("MXNET_TPU_COMPILE_CACHE", None)
+            tmp.cleanup()
+    steady_p99, scale_p99 = p99(lats["steady"]), p99(lats["scale_up"])
+    ratio = scale_p99 / max(1, steady_p99)
+    ok = (counts["err"] == 0 and counts["lost"] == 0
+          and ratio <= 3.0
+          and len(warm_hits) == 2 and all(h >= 1 for h in warm_hits)
+          and rollout["action"] == "rollback"
+          and rollout["gate"] == "health"
+          and recovered)
+    return {
+        "clients": clients,
+        "steady_p99_us": steady_p99,
+        "scale_up_p99_us": scale_p99,
+        "scale_up_vs_steady": round(ratio, 2),
+        "newcomer_warm_hits": warm_hits,
+        "rollout": {"action": rollout["action"],
+                    "gate": rollout.get("gate")},
+        "counts": counts,
+        "scale_ups": stats["fleet_scale_up"],
+        "scale_downs": stats["fleet_scale_down"],
+        "recovered": recovered,
+        "gate_ok": ok,
+    }
+
+
 # the int8-vs-bf16 release gate lives in ONE place (bench_int8.py owns
 # the model-level measurement; this sweep enforces the same bar on the
 # Predictor path) so a retune can never fork the threshold
@@ -339,6 +462,9 @@ def main(argv=None):
     ap.add_argument("--iters", type=int, default=1000)
     ap.add_argument("--skip-fleet", action="store_true")
     ap.add_argument("--skip-int8", action="store_true")
+    ap.add_argument("--operate", action="store_true",
+                    help="run the operator sweep (autoscale under load + "
+                         "canaried rollout) and gate the exit code on it")
     args = ap.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -401,6 +527,22 @@ def main(argv=None):
               f"restarts {fleet['restarts']}, retries {fleet['retries']}, "
               f"recovered {fleet['recovered']}", file=sys.stderr)
 
+    operate = None
+    operate_ok = True
+    if args.operate:
+        operate = bench_operate(mx, serving)
+        operate_ok = operate["gate_ok"]
+        print(f"operate ({operate['clients']} clients): scale-up p99 "
+              f"{operate['scale_up_p99_us']} us vs steady "
+              f"{operate['steady_p99_us']} us "
+              f"({operate['scale_up_vs_steady']}x, gate 3x), newcomer "
+              f"warm hits {operate['newcomer_warm_hits']}, bad-weights "
+              f"rollout -> {operate['rollout']['action']} "
+              f"(gate={operate['rollout']['gate']}), "
+              f"err {operate['counts']['err']}, lost "
+              f"{operate['counts']['lost']} -> "
+              f"{'ok' if operate_ok else 'FAIL'}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "serving_samples_per_s_b16",
         "value": round(batched, 1),
@@ -420,9 +562,11 @@ def main(argv=None):
             "fleet_gate_ok": fleet_ok,
             "int8": int8,
             "int8_gate_ok": int8_ok,
+            "operate": operate,
+            "operate_gate_ok": operate_ok,
         },
     }))
-    return 0 if (fleet_ok and int8_ok) else 1
+    return 0 if (fleet_ok and int8_ok and operate_ok) else 1
 
 
 if __name__ == "__main__":
