@@ -22,7 +22,9 @@ from .. import nn
 from ..block import HybridBlock
 from ..contrib import nn as contrib_nn
 
-__all__ = ["TransformerBlock", "TransformerLM", "transformer_lm"]
+__all__ = ["TransformerBlock", "TransformerLM", "transformer_lm",
+           "decode_spec", "decode_param_names", "paged_prefill",
+           "paged_step", "flat_forward"]
 
 
 class TransformerBlock(HybridBlock):
@@ -97,3 +99,273 @@ def transformer_lm(vocab=64, units=64, num_heads=2, num_layers=2,
                          max_len=max_len, impl=impl, mesh=mesh,
                          sp_axis=sp_axis, remat=remat,
                          final_norm=final_norm, **kwargs)
+
+
+# --------------------------------------------------- paged decode forward
+#
+# The step-wise forward of the generative serving runtime
+# (serving/decode.py): pure functions over a flat parameter tuple that
+# read and write the paged KV cache, mirroring hybrid_forward's math
+# op-for-op (LayerNorm eps=1e-5, jax.nn.gelu, 1/sqrt(head_dim) scaled
+# causal attention) so greedy decode matches the full-context forward
+# argmax token-for-token. Parameter VALUES stay runtime operands — the
+# functions compile once per shape under capture and a weight swap
+# never retraces.
+
+# canonical per-block parameter suffix order (matches name_scope output)
+_BLOCK_PARAM_SUFFIXES = (
+    "ln1_gamma", "ln1_beta", "attn_qkv_weight", "attn_qkv_bias",
+    "attn_out_weight", "attn_out_bias", "ln2_gamma", "ln2_beta",
+    "ff1_weight", "ff1_bias", "ff2_weight", "ff2_bias")
+
+
+def decode_spec(net):
+    """Static decode identity of an initialized :class:`TransformerLM`:
+    the shape facts the compiled prefill/step programs specialize on
+    (``remat``-wrapped blocks are a training construct and rejected —
+    decode reads the plain block stack)."""
+    blocks = list(net.blocks)
+    for blk in blocks:
+        if not isinstance(blk, TransformerBlock):
+            raise ValueError(
+                "decode_spec: TransformerLM blocks must be plain "
+                f"TransformerBlock (got {type(blk).__name__}; build the "
+                "serving model with remat=None)")
+    vocab, units = net.embed.weight.shape
+    return {
+        "vocab": int(vocab), "units": int(units),
+        "num_heads": int(blocks[0].attn._heads),
+        "num_layers": len(blocks), "max_len": int(net._max_len),
+        "final_norm": net.norm is not None,
+    }
+
+
+def decode_param_names(spec, names):
+    """Order a collected parameter-name iterable (``collect_params()``
+    keys, or a Predictor's bound arg names) into the canonical flat
+    tuple layout ``paged_prefill``/``paged_step`` consume: embed, pos,
+    per-block suffixes, [final norm,] head. Matching is by unambiguous
+    name suffix, so the gensym block prefix never matters."""
+    names = list(names)
+
+    def find(suffix):
+        hits = [n for n in names if n.endswith(suffix)]
+        if len(hits) != 1:
+            raise ValueError(
+                f"decode_param_names: expected exactly one param ending "
+                f"'{suffix}', found {hits or 'none'}")
+        return hits[0]
+
+    ordered = [find("embed_weight"), find("pos_weight")]
+    for i in range(spec["num_layers"]):
+        blk = f"block{i}_"
+        for suffix in _BLOCK_PARAM_SUFFIXES:
+            ordered.append(find(blk + suffix))
+    if spec["final_norm"]:
+        ordered += [find("norm_gamma"), find("norm_beta")]
+    ordered += [find("head_weight"), find("head_bias")]
+    return ordered
+
+
+def _ln(x, gamma, beta):
+    import jax
+    import jax.numpy as jnp
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-5) * gamma + beta
+
+
+def _dense(x, w, b):
+    import jax
+
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (1,)), ((), ()))) + b
+
+
+def _split_qkv(qkv, num_heads):
+    """(..., 3U) fused projection -> q, k, v of (..., H, D) — the same
+    channel layout contrib.nn.MultiHeadAttention's reshape/slice
+    produces, so paged KV state is interchangeable with the dense
+    path's."""
+    u = qkv.shape[-1] // 3
+    d = u // num_heads
+    q, k, v = qkv[..., :u], qkv[..., u:2 * u], qkv[..., 2 * u:]
+    shape = qkv.shape[:-1] + (num_heads, d)
+    return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+
+def _page_scatter(pages, scales, vals, page_idx, slot_idx, quantize):
+    """Write per-token K or V rows into the page pool; with an int8
+    pool, quantize on write and update the per-slot scales."""
+    if quantize:
+        from ...ops.decode_attention import kv_quantize
+
+        qv, sc = kv_quantize(vals)
+        return (pages.at[page_idx, slot_idx].set(qv),
+                scales.at[page_idx, slot_idx].set(sc))
+    return pages.at[page_idx, slot_idx].set(vals.astype(pages.dtype)), \
+        scales
+
+
+def _block_params(params, i):
+    base = 2 + i * len(_BLOCK_PARAM_SUFFIXES)
+    return params[base:base + len(_BLOCK_PARAM_SUFFIXES)]
+
+
+def _head_logits(params, spec, h):
+    if spec["final_norm"]:
+        h = _ln(h, params[-4], params[-3])
+    return _dense(h, params[-2], params[-1])
+
+
+def flat_forward(params, spec, tokens):
+    """Full-context forward over the flat parameter tuple: (B, T) int32
+    -> (B, T, vocab) logits, the same math ``hybrid_forward`` runs —
+    the decode predictor's fixed-shape probe/eval surface, compiled
+    from the SAME swappable cells the paged path reads."""
+    import jax
+    import jax.numpy as jnp
+
+    b, t = tokens.shape
+    heads = spec["num_heads"]
+    d = spec["units"] // heads
+    pos = jnp.minimum(jnp.arange(t, dtype=jnp.int32),
+                      spec["max_len"] - 1)
+    h = params[0][tokens] + params[1][pos]
+    causal = pos[:, None] >= pos[None, :]
+    for i in range(spec["num_layers"]):
+        (ln1_g, ln1_b, qkv_w, qkv_b, out_w, out_b, ln2_g, ln2_b,
+         ff1_w, ff1_b, ff2_w, ff2_b) = _block_params(params, i)
+        q, k, v = _split_qkv(_dense(_ln(h, ln1_g, ln1_b), qkv_w, qkv_b),
+                             heads)                   # (B, T, H, D)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, h.dtype))
+        s = jnp.where(causal[None, None], s, -1e30)
+        attn = jnp.einsum("bhqk,bkhd->bqhd",
+                          jax.nn.softmax(s, axis=-1), v)
+        h = h + _dense(attn.reshape(b, t, -1), out_w, out_b)
+        ff = jax.nn.gelu(_dense(_ln(h, ln2_g, ln2_b), ff1_w, ff1_b))
+        h = h + _dense(ff, ff2_w, ff2_b)
+    return _head_logits(params, spec, h)
+
+
+def paged_prefill(params, spec, tokens, true_len, kv, page_row,
+                  interpret=False):
+    """Run one prompt through the full stack, writing per-layer K/V into
+    the pages ``page_row`` maps, and return the last true token's
+    logits.
+
+    ``tokens`` (1, T) int32 padded to its bucket; ``true_len`` (1,)
+    int32; ``kv`` the flat cache tuple (k_pages, v_pages, k_scales,
+    v_scales) with layer axis 0 on each; ``page_row`` (max_pages,)
+    int32 with unused slots pointing at scratch page 0. Attention
+    inside the window is the ordinary causal dense form — the paged
+    kernel is for the one-token steady state.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k_pages, v_pages, k_scales, v_scales = kv
+    quantize = k_pages.dtype == jnp.int8
+    page_size = k_pages.shape[2]
+    t = tokens.shape[1]
+    heads = spec["num_heads"]
+    d = spec["units"] // heads
+    pos = jnp.arange(t, dtype=jnp.int32)
+    live = pos < true_len[0]
+    # padded tail positions clamp into range; their writes land on the
+    # scratch page and their keys are causally invisible to true rows
+    pos_ids = jnp.minimum(pos, spec["max_len"] - 1)
+    h = params[0][tokens[0]] + params[1][pos_ids]     # (T, U)
+    page_idx = jnp.where(live, page_row[pos // page_size], 0)
+    slot_idx = pos % page_size
+    causal = pos[:, None] >= pos[None, :]             # (T, T) q >= k
+    new_k, new_v = [], []
+    for i in range(spec["num_layers"]):
+        (ln1_g, ln1_b, qkv_w, qkv_b, out_w, out_b, ln2_g, ln2_b,
+         ff1_w, ff1_b, ff2_w, ff2_b) = _block_params(params, i)
+        q, k, v = _split_qkv(_dense(_ln(h, ln1_g, ln1_b), qkv_w, qkv_b),
+                             heads)                   # (T, H, D)
+        kp, ks = _page_scatter(k_pages[i], k_scales[i], k, page_idx,
+                               slot_idx, quantize)
+        vp, vs = _page_scatter(v_pages[i], v_scales[i], v, page_idx,
+                               slot_idx, quantize)
+        new_k.append((kp, ks))
+        new_v.append((vp, vs))
+        s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, h.dtype))
+        s = jnp.where(causal[None], s, -1e30)
+        attn = jnp.einsum("hqk,khd->qhd", jax.nn.softmax(s, axis=-1), v)
+        h = h + _dense(attn.reshape(t, -1), out_w, out_b)
+        ff = jax.nn.gelu(_dense(_ln(h, ln2_g, ln2_b), ff1_w, ff1_b))
+        h = h + _dense(ff, ff2_w, ff2_b)
+    logits = _head_logits(params, spec,
+                          jnp.take(h, true_len[0] - 1, axis=0))
+    kv_out = (jnp.stack([k for k, _ in new_k]),
+              jnp.stack([v for v, _ in new_v]),
+              jnp.stack([s for _, s in new_k]),
+              jnp.stack([s for _, s in new_v]))
+    return logits, kv_out
+
+
+def paged_step(params, spec, tokens, positions, active, kv, page_table,
+               interpret=False):
+    """ONE fixed-shape decode step for every live sequence slot: embed
+    the last sampled token per row, append its K/V to the paged cache,
+    attend over each row's pages through the tuned paged kernel, and
+    return the next greedy token per row.
+
+    ``tokens``/``positions``/``active`` (B,) int32; ``kv`` the flat
+    cache tuple; ``page_table`` (B, max_pages) int32. Row membership,
+    lengths and the table are all runtime operands — admitting or
+    evicting sequences never changes the compiled program.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.decode_attention import paged_decode_attention
+
+    k_pages, v_pages, k_scales, v_scales = kv
+    quantize = k_pages.dtype == jnp.int8
+    page_size = k_pages.shape[2]
+    heads = spec["num_heads"]
+    b = tokens.shape[0]
+    pos_ids = jnp.minimum(positions, spec["max_len"] - 1)
+    h = params[0][tokens] + params[1][pos_ids]        # (B, U)
+    # inactive rows write the scratch page; their gathers are masked by
+    # length so the garbage never reaches a live row
+    page_idx = jnp.where(
+        active > 0,
+        jnp.take_along_axis(page_table,
+                            (pos_ids // page_size)[:, None],
+                            axis=1)[:, 0],
+        0)
+    slot_idx = pos_ids % page_size
+    lengths = jnp.where(active > 0, positions + 1, 1)
+    new_k, new_v = [], []
+    for i in range(spec["num_layers"]):
+        (ln1_g, ln1_b, qkv_w, qkv_b, out_w, out_b, ln2_g, ln2_b,
+         ff1_w, ff1_b, ff2_w, ff2_b) = _block_params(params, i)
+        q, k, v = _split_qkv(_dense(_ln(h, ln1_g, ln1_b), qkv_w, qkv_b),
+                             heads)                   # (B, H, D)
+        kp, ks = _page_scatter(k_pages[i], k_scales[i], k, page_idx,
+                               slot_idx, quantize)
+        vp, vs = _page_scatter(v_pages[i], v_scales[i], v, page_idx,
+                               slot_idx, quantize)
+        new_k.append((kp, ks))
+        new_v.append((vp, vs))
+        attn = paged_decode_attention(
+            q, kp, vp, page_table, lengths,
+            k_scales=ks if quantize else None,
+            v_scales=vs if quantize else None, interpret=interpret)
+        h = h + _dense(attn.reshape(b, -1), out_w, out_b)
+        ff = jax.nn.gelu(_dense(_ln(h, ln2_g, ln2_b), ff1_w, ff1_b))
+        h = h + _dense(ff, ff2_w, ff2_b)
+    logits = _head_logits(params, spec, h)            # (B, vocab)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    kv_out = (jnp.stack([k for k, _ in new_k]),
+              jnp.stack([v for v, _ in new_v]),
+              jnp.stack([s for _, s in new_k]),
+              jnp.stack([s for _, s in new_v]))
+    return next_tokens, logits, kv_out

@@ -108,6 +108,7 @@ ALERT_RULE_IDS = (
     "numerics_nonfinite",     # in-graph tap: non-finite gradient onset
     "numerics_grad_explosion",# in-graph tap: grad norm off median+k*MAD
     "numerics_dead_layer",    # in-graph tap: a layer stopped training
+    "decode_ttft_burn",       # decode TTFT SLO-miss burn rate, 2 windows
 )
 
 
@@ -204,6 +205,16 @@ def _slo_counters():
         return {}
 
 
+def _decode_counters():
+    """The decode SLO counter pair (admitted sequences, TTFT misses)
+    the decode burn-rate rule windows — ``metrics.decode_counters``,
+    which is itself empty until the serving layer is imported."""
+    try:
+        return _metrics.decode_counters()
+    except Exception:
+        return {}
+
+
 def _health_counters():
     try:
         import sys
@@ -267,9 +278,10 @@ class BurnRateRule(AlertRule):
     keeps a one-sample blip from paging."""
 
     def __init__(self, id, num_key, den_key, objective=None, fast_s=None,
-                 slow_s=None, factor=None, **kw):
+                 slow_s=None, factor=None, group="slo", **kw):
         self.num_key = num_key
         self.den_key = den_key
+        self.group = group  # observation group the windows read
         self.objective = _env_float("MXNET_TPU_ALERT_SLO_TARGET", 0.99) \
             if objective is None else float(objective)
         self.fast_s = _env_float("MXNET_TPU_ALERT_BURN_FAST_S", 60.0) \
@@ -283,8 +295,8 @@ class BurnRateRule(AlertRule):
         super().__init__(id, **kw)
 
     def _burn(self, ctx, window_s):
-        num = ctx.windowed("slo", self.num_key, window_s)
-        den = ctx.windowed("slo", self.den_key, window_s)
+        num = ctx.windowed(self.group, self.num_key, window_s)
+        den = ctx.windowed(self.group, self.den_key, window_s)
         if den <= 0:
             return 0.0, num, den
         budget = max(1e-9, 1.0 - self.objective)
@@ -645,6 +657,13 @@ def _default_rules():
             description="a layer's gradients stayed ~0 or fully "
                         "fp16-underflowed for N consecutive samples "
                         "while the rest of the net kept training"),
+        BurnRateRule(
+            "decode_ttft_burn", "decode_ttft_misses", "decode_sequences",
+            group="decode", span_names=("decode.prefill", "decode.admit"),
+            description="decode time-to-first-token SLO misses burning "
+                        "the error budget in both the fast and slow "
+                        "window (TTFT over MXNET_TPU_DECODE_TTFT_SLO_MS "
+                        "at admission)"),
     )
 
 
@@ -851,6 +870,7 @@ def evaluate(now=None, force=False, slo=None, input_stall=None):
     with _EVAL_LOCK:
         obs = {"now": now, "seq": _flight.last_seq(),
                "slo": _slo_counters() if slo is None else slo,
+               "decode": _decode_counters(),
                "health": _health_counters()}
         with _LOCK:
             # a clock that moved backwards (a synthetic test clock after

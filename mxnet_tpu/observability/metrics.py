@@ -48,9 +48,9 @@ from . import _STATS
 __all__ = ["counter", "gauge", "histogram", "get", "registry",
            "snapshot", "sample", "series", "render_prometheus",
            "flush_json", "start_flusher", "stop_flusher", "serve_http",
-           "update_slo", "update_input_stall", "update_derived",
-           "slo_counters", "note_span", "reset", "Counter", "Gauge",
-           "Histogram"]
+           "update_slo", "update_decode_slo", "update_input_stall",
+           "update_derived", "slo_counters", "decode_counters",
+           "note_span", "reset", "Counter", "Gauge", "Histogram"]
 
 _LOCK = threading.Lock()
 _REGISTRY: dict = {}
@@ -266,6 +266,24 @@ _SLO_HEALTHY = gauge(
     "mxnet_tpu_fleet_healthy_replicas",
     "replicas currently in HEALTHY rotation", labels=("model",))
 
+# Decode-streaming SLO gauges, derived from the serving layer's
+# TTFT/ITL sliding windows by update_decode_slo() on the same exporter
+# cadence as the fleet family (docs/decode.md: TTFT and inter-token
+# latency are decode's two first-class latencies).
+_DECODE_TTFT_P50 = gauge(
+    "mxnet_tpu_decode_ttft_p50_us",
+    "decode time-to-first-token p50 (us), sliding window")
+_DECODE_TTFT_P99 = gauge(
+    "mxnet_tpu_decode_ttft_p99_us",
+    "decode time-to-first-token p99 (us), sliding window")
+_DECODE_ITL_P99 = gauge(
+    "mxnet_tpu_decode_itl_p99_us",
+    "decode inter-token latency p99 (us), sliding window")
+_DECODE_TTFT_HIT = gauge(
+    "mxnet_tpu_decode_ttft_hit_rate",
+    "fraction of admitted decode sequences whose first token met the "
+    "TTFT SLO (MXNET_TPU_DECODE_TTFT_SLO_MS)")
+
 
 def _ratio(num, den):
     """num/den with the zero-denominator edge pinned to 0.0 — a derived
@@ -362,6 +380,48 @@ def update_slo(counters=None):
                 g.remove(model=model)
 
 
+def decode_counters():
+    """The decode SLO counter pair (admitted sequences, TTFT SLO
+    misses) the ``decode_ttft_burn`` alert rule windows — read from the
+    same ``serving._STATS`` the gauges derive from, and empty until the
+    serving layer has been imported (same light-process discipline as
+    the fleet counters)."""
+    import sys
+
+    serving = sys.modules.get("mxnet_tpu.serving")
+    if serving is None:
+        return {}
+    return {
+        "decode_sequences": serving._STATS["decode_sequences"],
+        "decode_ttft_misses": serving._STATS["decode_ttft_misses"],
+    }
+
+
+def update_decode_slo():
+    """Refresh the ``mxnet_tpu_decode_*`` gauges from the serving
+    layer's TTFT/ITL sliding windows. Cheap and safe with no decode
+    traffic: empty windows leave the percentile gauges absent (no data
+    is not a 0 us TTFT) and a zero-sequence run leaves the hit-rate
+    gauge absent rather than claiming a perfect SLO."""
+    import sys
+
+    serving = sys.modules.get("mxnet_tpu.serving")
+    if serving is None:
+        return
+    with serving._LAT_LOCK:
+        ttft = sorted(serving._TTFT)
+        itl = sorted(serving._ITL)
+    if ttft:
+        _DECODE_TTFT_P50.set(serving._percentile_us(ttft, 0.50))
+        _DECODE_TTFT_P99.set(serving._percentile_us(ttft, 0.99))
+    if itl:
+        _DECODE_ITL_P99.set(serving._percentile_us(itl, 0.99))
+    seqs = serving._STATS["decode_sequences"]
+    if seqs > 0:
+        _DECODE_TTFT_HIT.set(1.0 - _ratio(
+            serving._STATS["decode_ttft_misses"], seqs))
+
+
 # ------------------------------------------- derived training-input gauge
 
 # ROADMAP item 3's gate signal: the fraction of training-loop wall time
@@ -418,6 +478,7 @@ def update_derived():
     inflated view on both sides)."""
     counters = slo_counters()
     update_slo(counters)
+    update_decode_slo()
     stall = update_input_stall()
     from . import perf as _perf
 

@@ -1,0 +1,119 @@
+"""Paged decode attention — the generative-serving hot path.
+
+One query token per sequence attends over that sequence's KV history,
+which lives scattered across a preallocated page pool in HBM
+(serving/decode.py): ``k_pages``/``v_pages`` are (pool_pages, page_size,
+heads, head_dim) arrays and each sequence owns an int32 page-table row.
+The kernel gathers KV one *page block* at a time and folds it into
+running online-softmax statistics, so the gathered (B, kv_len) score
+matrix never materializes at full width — the decode analogue of the
+flash forward's streaming K loop, with the page table as a runtime
+operand so sequence membership changes never retrace.
+
+The page-block width is a SCHEDULE, not a constant: it resolves per
+(batch, pages) shape through ``tune.schedule`` ("decode_attn") —
+explicit override > measured table entry > legalized default (graftlint
+TS004). An INT8 KV variant dequantizes pages on gather against
+per-slot-per-head scales (quantized on write by :func:`kv_quantize`),
+riding the PR-9 int8 + AOT machinery.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["paged_decode_attention", "kv_quantize", "kv_dequantize"]
+
+_NEG = -1e30
+
+
+def _schedule():
+    from ..tune import schedule
+
+    return schedule
+
+
+def kv_quantize(x):
+    """Symmetric per-(slot, head) INT8 quantization of one K or V slab:
+    ``x`` (..., head_dim) fp -> (int8 values, fp32 scales (...,)).
+    The head_dim axis shares one scale — the dequantized gather is a
+    single fused multiply per page block."""
+    import jax.numpy as jnp
+
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def kv_dequantize(q, scale):
+    """Inverse of :func:`kv_quantize` (fp32 out)."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, block_pages=None, k_scales=None,
+                           v_scales=None, interpret=False):
+    """Single-token attention over paged KV state.
+
+    Parameters
+    ----------
+    q : (B, H, D) — one query token per sequence slot
+    k_pages, v_pages : (P, page_size, H, D) — the shared page pool
+        (fp, or int8 with ``k_scales``/``v_scales`` (P, page_size, H))
+    page_table : (B, max_pages) int32 — each row maps that sequence's
+        logical page index to a pool page (page 0 is the scratch page;
+        rows are runtime operands, never part of the compiled shape)
+    lengths : (B,) int32 — valid KV tokens per sequence; positions at or
+        beyond the length are masked (which also silences the scratch
+        page any unused table slot points at)
+
+    Returns (B, H, D) attention output in the query dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, h, d = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bp = _schedule().decode_attn_block_pages(
+        b, max_pages, str(q.dtype), interpret=interpret,
+        block_pages=block_pages)
+    n_blocks = max_pages // bp
+    quantized = k_pages.dtype == jnp.int8
+
+    qf = q.astype(jnp.float32)
+    lengths = lengths.astype(jnp.int32)
+
+    def gather(pages, scales, tbl):
+        slab = pages[tbl]                     # (B, bp, page_size, H, D)
+        if quantized:
+            slab = slab.astype(jnp.float32) * scales[tbl][..., None]
+        return slab.astype(jnp.float32).reshape(
+            b, bp * page_size, h, d)
+
+    def body(i, carry):
+        m, l, acc = carry
+        tbl = jax.lax.dynamic_slice(page_table, (0, i * bp), (b, bp))
+        k = gather(k_pages, k_scales, tbl)
+        v = gather(v_pages, v_scales, tbl)
+        s = jnp.einsum("bhd,bkhd->bhk", qf, k) * scale
+        pos = i * (bp * page_size) + jnp.arange(bp * page_size)
+        dead = pos[None, :] >= lengths[:, None]          # (B, K)
+        s = jnp.where(dead[:, None, :], _NEG, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("bhk,bkhd->bhd", p, v)
+        return m_new, l, acc
+
+    m0 = jnp.full((b, h), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h), jnp.float32)
+    a0 = jnp.zeros((b, h, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)

@@ -135,6 +135,21 @@ Supported fault kinds (the hook that honours each is noted):
                                   .Autoscaler``), so the drill proves
                                   hysteresis/cooldown bound the scale
                                   events instead of thrashing
+- ``decode_replica_death``      — kill a decode engine mid-stream
+                                  (``serving.batcher.DecodeBatcher``
+                                  raises ``DecodeReplicaDead`` between
+                                  token steps), so the drill proves
+                                  in-flight sequences are rescheduled
+                                  on another replica (fleet streaming)
+                                  or cleanly errored, and every KV page
+                                  returns to the pool — no leaked state
+- ``kv_pool_exhaustion``        — report the decode KV page pool as
+                                  empty to allocation
+                                  (``serving.decode.PagePool.alloc``),
+                                  so the drill proves admission
+                                  backpressures instead of OOMing and
+                                  no sequence wedges: queued prompts
+                                  admit as soon as pages free
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -167,7 +182,8 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_perf_regression", "maybe_slo_burn",
            "maybe_step_time_anomaly", "maybe_corrupt_record",
            "maybe_rollout_bad_weights", "maybe_canary_slo_regression",
-           "maybe_autoscale_flap"]
+           "maybe_autoscale_flap", "DecodeReplicaDead",
+           "maybe_decode_replica_death", "maybe_kv_pool_exhaustion"]
 
 
 class SimulatedCrash(BaseException):
@@ -191,6 +207,13 @@ class ReplicaCrash(FaultInjected):
     batch fails with this error (the router treats it as a replica fault
     and retries elsewhere); a subprocess replica's worker converts it
     into ``os._exit`` — the process-isolation analogue of a SIGKILL."""
+
+
+class DecodeReplicaDead(FaultInjected):
+    """Injected death of a decode engine mid-stream: the continuous
+    batcher's loop dies between token steps, every in-flight sequence's
+    stream sees this error (or is rescheduled by the fleet streaming
+    layer), and the engine's KV pages are reclaimed."""
 
 
 _LOCK = threading.Lock()
@@ -726,6 +749,35 @@ def maybe_autoscale_flap(queue_depth):
     # fired was incremented by should_fire(): odd fire -> spike, even
     # fire -> trough, a maximally adversarial square wave
     return high if fault.fired % 2 == 1 else 0.0
+
+
+def maybe_decode_replica_death():
+    """Raise :class:`DecodeReplicaDead` between decode token steps (kind
+    ``decode_replica_death``). Hooked at the top of the continuous
+    batcher's engine iteration — the only place the whole in-flight
+    sequence set is visible — so the drill proves death reclaims every
+    KV page and either reschedules the streams (fleet) or fails each
+    with this structured error, never a silent wedge."""
+    if not _ACTIVE:
+        return
+    fault = _ACTIVE.get("decode_replica_death")
+    if fault is None or not fault.should_fire():
+        return
+    raise DecodeReplicaDead("injected decode engine death mid-stream")
+
+
+def maybe_kv_pool_exhaustion(available):
+    """Report the decode KV page pool as empty (kind
+    ``kv_pool_exhaustion``): the allocation path sees 0 free pages for
+    the fired calls regardless of the measured count, so the drill
+    proves admission backpressures (queued, not OOM) and drains cleanly
+    once the injected exhaustion lifts."""
+    if not _ACTIVE:
+        return available
+    fault = _ACTIVE.get("kv_pool_exhaustion")
+    if fault is None or not fault.should_fire():
+        return available
+    return 0
 
 
 _install_from_env()

@@ -74,6 +74,17 @@ _STATS = {
     "rollout_promotions": 0,       # canaried artifacts promoted fleet-wide
     "rollout_rollbacks": 0,        # artifacts rolled back on a gate failure
     "rollout_holds": 0,            # rollouts held (no-op: same artifact)
+    # Decode (serving/decode.py + DecodeBatcher in serving/batcher.py)
+    "decode_sequences": 0,         # sequences admitted to the decode engine
+    "decode_tokens": 0,            # tokens emitted across all sequences
+    "decode_prefills": 0,          # bucketed prefill executions
+    "decode_steps": 0,             # fixed-shape decode step executions
+    "decode_evictions": 0,         # sequences retired (finished/cancelled)
+    "decode_preemptions": 0,       # sequences bounced back to admission
+    "decode_backpressure": 0,      # page allocations refused (pool empty)
+    "decode_pages_inuse_peak": 0,  # high-water mark of allocated KV pages
+    "decode_ttft_misses": 0,       # first tokens slower than the TTFT SLO
+    "decode_reroutes": 0,          # streams resumed on another replica
 }
 
 _LAT_LOCK = _threading.Lock()
@@ -83,6 +94,24 @@ _LATENCIES = _deque(maxlen=8192)  # seconds, submit -> result
 def record_latency(seconds):
     with _LAT_LOCK:
         _LATENCIES.append(seconds)
+
+
+# Decode streaming has two first-class latencies of its own
+# (docs/decode.md): time-to-first-token (admission -> first streamed
+# token, prefill cost included) and inter-token latency (gap between
+# consecutive tokens of one sequence, the cadence users perceive).
+_TTFT = _deque(maxlen=4096)   # seconds, submit -> first token
+_ITL = _deque(maxlen=8192)    # seconds, token[i] -> token[i+1]
+
+
+def record_ttft(seconds):
+    with _LAT_LOCK:
+        _TTFT.append(seconds)
+
+
+def record_itl(seconds):
+    with _LAT_LOCK:
+        _ITL.append(seconds)
 
 
 def _percentile_us(sorted_lat, q):
@@ -128,6 +157,13 @@ def stats():
     out["fleet_p50_latency_us"] = _percentile_us(fleet_lat, 0.50)
     out["fleet_p99_latency_us"] = _percentile_us(fleet_lat, 0.99)
     out["fleet_replica_latency_us"] = "; ".join(summaries)
+    with _LAT_LOCK:
+        ttft = sorted(_TTFT)
+        itl = sorted(_ITL)
+    out["decode_p50_ttft_us"] = _percentile_us(ttft, 0.50)
+    out["decode_p99_ttft_us"] = _percentile_us(ttft, 0.99)
+    out["decode_p50_itl_us"] = _percentile_us(itl, 0.50)
+    out["decode_p99_itl_us"] = _percentile_us(itl, 0.99)
     return out
 
 
@@ -136,18 +172,24 @@ def reset_stats():
         _STATS[k] = 0
     with _LAT_LOCK:
         _LATENCIES.clear()
+        _TTFT.clear()
+        _ITL.clear()
     for f in _live_fleets():
         f._reset_latencies()
 
 
 from .predictor import Predictor  # noqa: E402
 from .batcher import (BatchServer, DeadlineExceeded, ServerClosed,  # noqa: E402
-                      ServerOverloaded)
+                      ServerOverloaded, DecodeBatcher, TokenStream)
 from .fleet import (Fleet, FleetClosed, FleetOverloaded,  # noqa: E402
-                    ReplicaSupervisor, Router)
+                    ReplicaSupervisor, Router, StreamRouter)
 from .operator import Autoscaler, RolloutManager  # noqa: E402
+from .decode import DecodePredictor, PagePool  # noqa: E402
 
 __all__ = ["Predictor", "BatchServer", "DeadlineExceeded", "ServerClosed",
            "ServerOverloaded", "Fleet", "FleetClosed", "FleetOverloaded",
            "ReplicaSupervisor", "Router", "Autoscaler", "RolloutManager",
-           "stats", "reset_stats", "record_latency"]
+           "DecodePredictor", "PagePool", "DecodeBatcher", "TokenStream",
+           "StreamRouter",
+           "stats", "reset_stats", "record_latency", "record_ttft",
+           "record_itl"]

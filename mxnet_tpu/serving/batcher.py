@@ -45,10 +45,10 @@ from ..observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 from ..resilience.sentinel import HealthSentinel, NumericHealthError
-from . import _STATS, record_latency
+from . import _STATS, record_itl, record_latency, record_ttft
 
 __all__ = ["BatchServer", "DeadlineExceeded", "ServerOverloaded",
-           "ServerClosed"]
+           "ServerClosed", "DecodeBatcher", "TokenStream"]
 
 
 class DeadlineExceeded(RuntimeError):
@@ -519,3 +519,416 @@ class BatchServer:
 
     def __exit__(self, *exc):
         self.close(drain=exc[0] is None)
+
+
+# --------------------------------------------- continuous token batching
+
+class TokenStream:
+    """Consumer handle for one streamed generation: the decode engine
+    pushes tokens as they are produced; :meth:`tokens` iterates them as
+    they arrive and :meth:`result` collects the full completion.
+
+    ``ttft_s`` (time-to-first-token) is stamped when the first token
+    lands; ``generated`` accumulates every token so a fleet reroute can
+    resume the stream on another replica mid-completion."""
+
+    def __init__(self):
+        import queue
+
+        self.created = time.perf_counter()
+        self.generated = []     # every token pushed, across reroutes
+        self.ttft_s = None
+        self.finished = False
+        self.reason = None
+        self.cancelled = False
+        self._q = queue.Queue()
+
+    def _push(self, tok):
+        self.generated.append(int(tok))
+        self._q.put(("token", int(tok)))
+
+    def _finish(self, reason):
+        self.finished = True
+        self.reason = reason
+        self._q.put(("done", reason))
+
+    def _fail(self, exc):
+        self.finished = True
+        self.reason = "error"
+        self._q.put(("error", exc))
+
+    def cancel(self):
+        """Ask the engine to evict this sequence at its next step; its
+        pages free immediately on eviction (mid-stream cancellation is
+        first-class, not a drain)."""
+        self.cancelled = True
+
+    def tokens(self, timeout=None):
+        """Generator over the stream's tokens in order; returns when the
+        sequence finishes, raises the engine's error if it failed."""
+        while True:
+            kind, val = self._q.get(timeout=timeout)
+            if kind == "token":
+                yield val
+            elif kind == "done":
+                return
+            else:
+                raise val
+
+    def __iter__(self):
+        return self.tokens()
+
+    def result(self, timeout=None):
+        """Block until the stream finishes; returns the full token list."""
+        for _ in self.tokens(timeout=timeout):
+            pass
+        return list(self.generated)
+
+
+class _DecodeSeq:
+    __slots__ = ("prompt", "max_new", "eos_id", "stream", "pages", "row",
+                 "pos", "generated", "t_last", "preempts")
+
+    def __init__(self, prompt, max_new, eos_id, stream):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.stream = stream
+        self.pages = []
+        self.row = None
+        self.pos = 0            # next KV write position
+        self.generated = []     # tokens THIS engine produced (the stream
+        self.t_last = 0.0       # may carry more, from before a reroute)
+        self.preempts = 0
+
+
+class DecodeBatcher:
+    """Continuous token-level batching over a :class:`DecodePredictor`.
+
+    One engine thread runs the fixed-shape decode step in a loop over
+    ``max_seqs`` sequence slots; sequences are admitted into free slots
+    **mid-stream** (a bucketed prefill writes their prompt KV, then they
+    join the very next step) and evicted the moment they finish — no
+    sequence ever waits for a "batch" to drain, which is what keeps the
+    step full and tokens/s flat under churn. Admission is where page
+    backpressure lands: a prompt whose pages the pool can't supply waits
+    in the pending queue (``decode_backpressure`` counts refusals), and
+    a LIVE sequence that outgrows its pages is preempted — pages freed,
+    sequence re-queued for re-prefill of prompt+generated — rather than
+    wedging the engine (``decode_preemptions``). Repeated preemption
+    (the pool genuinely cannot hold the context) fails the stream
+    cleanly instead of livelocking.
+
+    Per-token latency is first-class: TTFT (submit -> first token,
+    prefill included) checks against ``MXNET_TPU_DECODE_TTFT_SLO_MS``
+    (``decode_ttft_misses``) and every inter-token gap records into the
+    ITL window, both surfaced as SLO gauges for the alert engine.
+
+    ``decode_replica_death`` chaos raises inside the engine loop: every
+    live and pending stream either reroutes through ``death_sink`` (the
+    fleet's StreamRouter installs one) or fails cleanly, and every page
+    returns to the pool — state never leaks with the replica.
+    """
+
+    def __init__(self, predictor, ttft_slo_ms=None):
+        self.predictor = predictor
+        self.ttft_slo_s = (
+            ttft_slo_ms if ttft_slo_ms is not None
+            else _env_float("MXNET_TPU_DECODE_TTFT_SLO_MS", 500.0)) / 1e3
+        self.death_sink = None   # callable(list of (stream, prompt,
+        self.dead = False        #   remaining_max_new, eos_id)) on death
+        self._pending = deque()
+        self._live = {}          # row -> _DecodeSeq (engine thread only)
+        self._free_rows = list(range(predictor.max_seqs))
+        self._table = _np.zeros((predictor.max_seqs, predictor.max_pages),
+                                _np.int32)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, name="mxnet-tpu-decode", daemon=True)
+        self._engine_thread.start()
+
+    # ------------------------------------------------------------ intake
+    def submit(self, prompt, max_new_tokens, eos_id=None, stream=None):
+        """Queue one generation request. Returns a :class:`TokenStream`
+        (or continues the one passed in — the fleet reroute path)."""
+        prompt = [int(t) for t in prompt]
+        max_len = self.predictor._spec["max_len"]
+        if not prompt or len(prompt) >= max_len:
+            raise MXNetError(f"decode prompt length must be 1.."
+                             f"{max_len - 1}, got {len(prompt)}")
+        if int(max_new_tokens) < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        stream = stream if stream is not None else TokenStream()
+        seq = _DecodeSeq(prompt, max_new_tokens, eos_id, stream)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("DecodeBatcher is closed")
+            self._pending.append(seq)
+            self._cond.notify_all()
+        return stream
+
+    @property
+    def outstanding(self):
+        with self._cond:
+            return len(self._pending) + len(self._live)
+
+    @property
+    def live_count(self):
+        with self._cond:
+            return len(self._live)
+
+    # ------------------------------------------------------------ engine
+    def _engine_loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while (not self._pending and not self._live
+                           and not self._closed):
+                        self._cond.wait()
+                    if self._closed and not self._live:
+                        leftovers = list(self._pending)
+                        self._pending.clear()
+                        self._cond.notify_all()
+                        for s in leftovers:
+                            _try_resolve_stream(s.stream, ServerClosed(
+                                "DecodeBatcher closed before admission"))
+                        return
+                _faults.maybe_decode_replica_death()
+                self._admit()
+                if not self._step_once():
+                    # nothing live: pending blocked on pages (or closing)
+                    with self._cond:
+                        if self._pending and not self._closed:
+                            self._cond.wait(0.005)
+        except _faults.DecodeReplicaDead as e:
+            self._die(e, reroute=True)
+        except BaseException as e:
+            self._die(ServerClosed(
+                f"decode engine died: {type(e).__name__}: {e}"),
+                reroute=False)
+            raise
+
+    def _admit(self):
+        ps = self.predictor.page_size
+        while True:
+            with self._cond:
+                if self._closed or not self._pending or \
+                        not self._free_rows:
+                    return
+                seq = self._pending[0]
+                if seq.stream.cancelled:
+                    self._pending.popleft()
+                    _STATS["decode_evictions"] += 1
+                    seq.stream._finish("cancelled")
+                    continue
+                ctx = seq.prompt + seq.generated
+                # pages for the full context plus the next written token
+                need = -(-(len(ctx) + 1) // ps)
+                pages = self.predictor.pool.alloc(need)
+                if pages is None:
+                    return  # backpressure: wait for evictions
+                self._pending.popleft()
+                row = self._free_rows.pop()
+            try:
+                with _obs_trace.span("decode.admit", row=row,
+                                     ctx=len(ctx), pages=need):
+                    seq.pages = list(pages)
+                    seq.row = row
+                    self._table[row, :] = 0
+                    self._table[row, :len(pages)] = pages
+                    first, _ = self.predictor.prefill(
+                        ctx, self._table[row])
+                    seq.pos = len(ctx)
+                _STATS["decode_sequences"] += 1
+                self._emit(seq, first, time.perf_counter())
+                if not seq.stream.finished:
+                    with self._cond:
+                        self._live[row] = seq
+                        self._cond.notify_all()
+            except Exception as e:
+                self._release(seq)
+                seq.stream._fail(e)
+                _STATS["decode_evictions"] += 1
+
+    def _emit(self, seq, tok, now):
+        """Deliver one token: stream push, TTFT/ITL accounting, the
+        per-token trace record, and the finish checks."""
+        t0 = seq.t_last or seq.stream.created
+        seq.generated.append(int(tok))
+        seq.stream._push(tok)
+        _STATS["decode_tokens"] += 1
+        if seq.stream.ttft_s is None:
+            ttft = now - seq.stream.created
+            seq.stream.ttft_s = ttft
+            record_ttft(ttft)
+            if ttft > self.ttft_slo_s:
+                _STATS["decode_ttft_misses"] += 1
+        else:
+            record_itl(now - t0)
+        _obs_trace.record("decode.token", int(t0 * 1e9),
+                          max(0, int((now - t0) * 1e9)), row=seq.row,
+                          position=seq.pos)
+        seq.t_last = now
+        hit_eos = seq.eos_id is not None and int(tok) == seq.eos_id
+        if (len(seq.generated) >= seq.max_new or hit_eos
+                or seq.pos >= self.predictor._spec["max_len"]):
+            self._evict(seq, "eos" if hit_eos else "length")
+
+    def _evict(self, seq, reason):
+        self._release(seq)
+        _STATS["decode_evictions"] += 1
+        seq.stream._finish(reason)
+
+    def _release(self, seq):
+        """Return a sequence's pages and slot to the free sets."""
+        if seq.pages:
+            self.predictor.pool.free(seq.pages)
+            seq.pages = []
+        if seq.row is not None:
+            self._table[seq.row, :] = 0
+            with self._cond:
+                self._live.pop(seq.row, None)
+                self._free_rows.append(seq.row)
+                self._cond.notify_all()
+            seq.row = None
+
+    def _preempt(self, seq):
+        """A live sequence outgrew its pages and the pool is dry: free
+        everything it holds and re-queue it for a re-prefill of
+        prompt+generated — tokens already streamed stay streamed, the
+        consumer just sees a gap. A context the pool fundamentally
+        cannot hold fails after a few rounds instead of livelocking."""
+        self._release(seq)
+        seq.preempts += 1
+        if seq.preempts > 3:
+            _STATS["decode_evictions"] += 1
+            seq.stream._fail(MXNetError(
+                "decode KV page pool cannot hold this context "
+                f"(preempted {seq.preempts - 1} times; "
+                f"{self.predictor.pool.num_pages} pages of "
+                f"{self.predictor.page_size} tokens)"))
+            return
+        seq.prompt = seq.prompt + seq.generated
+        seq.max_new -= len(seq.generated)
+        seq.generated = []
+        _STATS["decode_preemptions"] += 1
+        with self._cond:
+            self._pending.appendleft(seq)
+
+    def _step_once(self):
+        with self._cond:
+            live = dict(self._live)
+        if not live:
+            return False
+        ps = self.predictor.page_size
+        max_len = self.predictor._spec["max_len"]
+        for row, seq in list(live.items()):
+            if seq.stream.cancelled:
+                self._evict(seq, "cancelled")
+                live.pop(row)
+                continue
+            if seq.pos >= max_len:
+                self._evict(seq, "length")
+                live.pop(row)
+                continue
+            if seq.pos >= len(seq.pages) * ps:
+                extra = self.predictor.pool.alloc(1)
+                if extra is None:
+                    self._preempt(seq)
+                    live.pop(row)
+                    continue
+                self._table[row, len(seq.pages)] = extra[0]
+                seq.pages.extend(extra)
+        if not live:
+            return True  # did work (evictions/preemptions)
+        n = self.predictor.max_seqs
+        toks = _np.zeros((n,), _np.int32)
+        positions = _np.zeros((n,), _np.int32)
+        active = _np.zeros((n,), _np.int32)
+        for row, seq in live.items():
+            toks[row] = seq.generated[-1] if seq.generated else \
+                seq.prompt[-1]
+            positions[row] = seq.pos
+            active[row] = 1
+        nxt, _ = self.predictor.step(toks, positions, active, self._table)
+        now = time.perf_counter()
+        for row, seq in live.items():
+            seq.pos += 1
+            self._emit(seq, int(nxt[row]), now)
+        return True
+
+    def _die(self, exc, reroute):
+        """The engine is gone: reclaim every page, then hand each
+        incomplete stream to the fleet's death sink (reroute) or fail it
+        cleanly. Either way no page leaks and no consumer blocks
+        forever."""
+        with self._cond:
+            self.dead = True
+            self._closed = True
+            victims = list(self._live.values()) + list(self._pending)
+            self._live.clear()
+            self._pending.clear()
+            self._cond.notify_all()
+        for seq in victims:
+            if seq.pages:
+                self.predictor.pool.free(seq.pages)
+                seq.pages = []
+        sink = self.death_sink if reroute else None
+        if sink is not None:
+            items = [(s.stream, s.prompt + s.generated,
+                      s.max_new - len(s.generated), s.eos_id)
+                     for s in victims
+                     if not s.stream.finished and
+                     s.max_new - len(s.generated) > 0]
+            done = [s for s in victims
+                    if not s.stream.finished and
+                    s.max_new - len(s.generated) <= 0]
+            for s in done:
+                s.stream._finish("length")
+            try:
+                sink(items, exc)
+                return
+            except Exception:
+                pass  # fall through: fail what the sink didn't take
+        for seq in victims:
+            _try_resolve_stream(seq.stream, exc)
+
+    # ------------------------------------------------------------- close
+    def close(self, drain=True, timeout=30.0):
+        """Stop intake; with ``drain`` let LIVE sequences finish their
+        completions (pending ones fail — generation is open-ended, a
+        drain that admitted new work would never bound), else evict
+        everything immediately."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            if not drain:
+                for seq in self._live.values():
+                    seq.stream.cancel()
+                for seq in self._pending:
+                    seq.stream.cancel()
+            self._cond.notify_all()
+        self._engine_thread.join(timeout)
+        with self._cond:
+            leftovers = (list(self._live.values()) + list(self._pending))
+            self._live.clear()
+            self._pending.clear()
+        for seq in leftovers:
+            if seq.pages:
+                self.predictor.pool.free(seq.pages)
+                seq.pages = []
+            _try_resolve_stream(seq.stream, ServerClosed(
+                "DecodeBatcher closed before the stream finished"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)
+
+
+def _try_resolve_stream(stream, exc):
+    if not stream.finished:
+        stream._fail(exc)

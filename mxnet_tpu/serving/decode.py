@@ -1,0 +1,575 @@
+"""Generative decode serving — paged KV-cache runtime (docs/decode.md).
+
+Autoregressive generation is a different serving regime from the
+fixed-shape stateless Predictor: every sequence carries growing KV
+state, lives across many requests' worth of wall-clock, and emits
+tokens one at a time. The reference had no answer here; this module is
+the compile-once/replay answer:
+
+- :class:`PagePool` — a preallocated HBM page pool. KV state for every
+  live sequence lives in fixed-size pages (``MXNET_TPU_DECODE_PAGE_SIZE``
+  tokens each) drawn from ``MXNET_TPU_DECODE_PAGES`` shared pages, so
+  admission/eviction is integer bookkeeping, never an allocation. Page 0
+  is the scratch page: masked lanes write there and length-masking keeps
+  it invisible. ``alloc`` returning None IS the backpressure signal
+  (``decode_backpressure``) — the batcher queues, nothing OOMs.
+- :class:`DecodePredictor` — the prefill/decode split over ONE model:
+  bucketed prefill executables (``MXNET_TPU_DECODE_PREFILL_BUCKETS``)
+  write a prompt's KV into its pages and return first-token logits; ONE
+  fixed-shape decode step (``MXNET_TPU_DECODE_MAX_SEQS`` sequence slots)
+  advances every live sequence a token through the tuned paged-attention
+  kernel (ops/decode_attention.py, schedule key "decode_attn"). The page
+  table, slot membership, positions and parameter values are all runtime
+  operands: admitting, evicting or weight-swapping sequences NEVER
+  retraces — the zero-retrace steady state serving_bench gates.
+- INT8 KV (``MXNET_TPU_DECODE_KV_DTYPE=int8``): pages store symmetric
+  per-slot-per-head int8 (ops/decode_attention.kv_quantize), halving
+  (vs bf16; 4x vs fp32) the HBM a context occupies, riding the PR-9
+  quantization + AOT machinery.
+
+The continuous token-level batcher lives in serving/batcher.py
+(:class:`DecodeBatcher`); fleet streaming + rollout gates in fleet.py /
+operator.py. This module is the single-replica engine they all drive.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..observability import trace as _obs_trace
+from ..resilience import faults as _faults
+from . import _STATS
+
+__all__ = ["PagePool", "DecodePredictor", "DEFAULT_PREFILL_BUCKETS"]
+
+DEFAULT_PREFILL_BUCKETS = (8, 16, 32)
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else int(default)
+
+
+def _env_ints(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return tuple(default)
+    return tuple(int(x) for x in raw.split(",") if x.strip())
+
+
+def _raw(a):
+    return a._data if hasattr(a, "_data") else a
+
+
+class PagePool:
+    """Fixed-capacity KV page allocator. Pages are small integers into
+    the predictor's preallocated page arrays; page 0 is reserved as the
+    scratch page every masked write lands on, so ``num_pages - 1`` pages
+    are allocatable. Thread-safe: the batcher's engine thread and
+    gate/test-time ``greedy_decode`` calls share one pool, and the
+    in-use high-water mark lands in ``decode_pages_inuse_peak``.
+
+    ``alloc`` is where ``kv_pool_exhaustion`` chaos injects: the fault
+    reports zero available pages, and correct callers must backpressure
+    (queue/retry), never crash or wedge.
+    """
+
+    def __init__(self, num_pages):
+        if int(num_pages) < 2:
+            raise MXNetError("PagePool needs >= 2 pages (page 0 is the "
+                             f"reserved scratch page), got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free = list(range(1, self.num_pages))
+        self._allocated = set()
+        self._lock = threading.Lock()
+
+    def alloc(self, n):
+        """Take ``n`` pages, or None when the pool can't supply them —
+        the admission-backpressure signal, counted per refusal."""
+        n = int(n)
+        if n <= 0:
+            raise MXNetError(f"PagePool.alloc: need a positive count, "
+                             f"got {n}")
+        with self._lock:
+            avail = _faults.maybe_kv_pool_exhaustion(len(self._free))
+            if n > avail or n > len(self._free):
+                _STATS["decode_backpressure"] += 1
+                return None
+            pages = self._free[:n]
+            del self._free[:n]
+            self._allocated.update(pages)
+            peak = max(_STATS["decode_pages_inuse_peak"],
+                       len(self._allocated))
+            _STATS["decode_pages_inuse_peak"] = peak
+            return pages
+
+    def free(self, pages):
+        """Return pages to the pool. Double-free is a hard error — page
+        accounting bugs must never silently alias two sequences' KV."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p not in self._allocated:
+                    raise MXNetError(
+                        f"PagePool.free: page {p} is not allocated "
+                        "(double free, or a page the pool never issued)")
+                self._allocated.discard(p)
+                self._free.append(p)
+
+    @property
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self):
+        with self._lock:
+            return len(self._allocated)
+
+
+class DecodePredictor:
+    """Stateful decode engine over an initialized :class:`TransformerLM`.
+
+    Duck-types the Predictor surface the fleet/operator stack relies on
+    (``predict_raw``, ``swap_params``, ``warmup``, ``_execs``/``_lock``
+    for RolloutManager's schedule rebuild) while owning the paged decode
+    state: the page pool, the per-layer K/V page arrays, and three
+    executable families —
+
+    - ``("prefill", bucket)`` — (1, bucket) prompt -> last-token logits,
+      KV written into the pages its ``page_row`` maps;
+    - ``("step",)`` — THE fixed-shape decode step: (max_seqs,) token/
+      position/active rows + (max_seqs, max_pages) page table advance
+      every live slot one token;
+    - ``("full", B, T)`` — the flat full-context forward, the stateless
+      probe/canary surface rollout gates and health probes batch on.
+
+    All three read parameter values as runtime operands gathered from
+    the SAME swappable cells under one lock, so a weights rollout flips
+    decode and probe paths together with zero retraces. Fingerprints
+    fold the tuned-schedule token: a schedule rollout recompiles through
+    the AOT cache instead of silently serving stale block shapes.
+
+    Parameters default from the environment (docs/decode.md):
+    ``MXNET_TPU_DECODE_PAGE_SIZE`` (8), ``MXNET_TPU_DECODE_PAGES`` (32,
+    scratch page included), ``MXNET_TPU_DECODE_MAX_SEQS`` (4),
+    ``MXNET_TPU_DECODE_PREFILL_BUCKETS`` ("8,16,32"),
+    ``MXNET_TPU_DECODE_KV_DTYPE`` ("float32" | "int8").
+    """
+
+    def __init__(self, net, ctx=None, page_size=None, num_pages=None,
+                 max_seqs=None, prefill_buckets=None, kv_dtype=None,
+                 warmup=True, interpret=False):
+        from ..context import current_context
+        from ..gluon.model_zoo import transformer as _tf
+
+        self._tf = _tf
+        self._spec = _tf.decode_spec(net)
+        self._ctx = ctx or current_context()
+        self._interpret = bool(interpret)
+        self.page_size = int(page_size if page_size is not None else
+                             _env_int("MXNET_TPU_DECODE_PAGE_SIZE", 8))
+        self.num_pages = int(num_pages if num_pages is not None else
+                             _env_int("MXNET_TPU_DECODE_PAGES", 32))
+        self.max_seqs = int(max_seqs if max_seqs is not None else
+                            _env_int("MXNET_TPU_DECODE_MAX_SEQS", 4))
+        if self.page_size < 1 or self.max_seqs < 1:
+            raise MXNetError("DecodePredictor: page_size and max_seqs "
+                             "must be positive")
+        # a sequence's table row must address its whole max-length
+        # context, and the page arrays hold at least scratch + one page
+        self.max_pages = -(-self._spec["max_len"] // self.page_size)
+        if self.num_pages < 2:
+            raise MXNetError("DecodePredictor: num_pages must be >= 2 "
+                             "(page 0 is the scratch page)")
+        kv_dtype = (kv_dtype or os.environ.get(
+            "MXNET_TPU_DECODE_KV_DTYPE", "").strip() or "float32")
+        if kv_dtype not in ("float32", "int8"):
+            raise MXNetError("DecodePredictor: kv_dtype must be "
+                             f"'float32' or 'int8', got {kv_dtype!r}")
+        self._kv_dtype = kv_dtype
+        buckets = prefill_buckets if prefill_buckets is not None else \
+            _env_ints("MXNET_TPU_DECODE_PREFILL_BUCKETS",
+                      DEFAULT_PREFILL_BUCKETS)
+        buckets = tuple(sorted({min(int(b), self._spec["max_len"])
+                                for b in buckets}))
+        if not buckets or buckets[0] < 1:
+            raise MXNetError("DecodePredictor: prefill_buckets must be "
+                             f"positive ints, got {buckets}")
+        self.prefill_buckets = buckets
+        self._names = _tf.decode_param_names(
+            self._spec, list(net.collect_params()))
+        params = net.collect_params()
+        self._cells = [self._place(params[n].data()) for n in self._names]
+        self._idx = {n: i for i, n in enumerate(self._names)}
+        self._execs = {}          # ("prefill", b) / ("step",) / ("full", B, T)
+        self._lock = threading.Lock()       # cells + exec cache
+        self._run_lock = threading.Lock()   # serializes KV mutation
+        self.pool = PagePool(self.num_pages)
+        self.warmup_ms = 0.0
+        self.warmup_cache_hits = 0
+        self.reset_cache()
+        if warmup:
+            t0 = time.perf_counter()
+            self.warmup()
+            self.warmup_ms = (time.perf_counter() - t0) * 1e3
+
+    # ------------------------------------------------------------ state
+    def _place(self, v):
+        import jax
+
+        tgt = self._ctx.jax_device()
+        try:
+            dev = v._data.device
+            on_ctx = dev is tgt or dev == tgt
+        except Exception:
+            return v
+        if on_ctx:
+            return v
+        from ..ndarray.ndarray import NDArray
+
+        return NDArray(jax.device_put(v._data, tgt), self._ctx)
+
+    def reset_cache(self):
+        """(Re)allocate the paged KV arrays: per-layer K and V pages of
+        (L, num_pages, page_size, H, D) in the KV dtype, plus per-slot
+        scales for the int8 pool (a broadcast-shaped dummy for fp32, so
+        the executable signatures stay uniform). Live sequences must be
+        drained first — pages allocated against the old arrays keep
+        their pool accounting but their contents are gone."""
+        import jax.numpy as jnp
+
+        spec = self._spec
+        heads = spec["num_heads"]
+        d = spec["units"] // heads
+        shape = (spec["num_layers"], self.num_pages, self.page_size,
+                 heads, d)
+        page_dtype = jnp.int8 if self._kv_dtype == "int8" else jnp.float32
+        scale_shape = (shape[:-1] if self._kv_dtype == "int8"
+                       else (spec["num_layers"], 1, 1, 1))
+        # four DISTINCT buffers: the step donates all of them, and XLA
+        # rejects donating one buffer twice
+        self._kv = (jnp.zeros(shape, page_dtype),
+                    jnp.zeros(shape, page_dtype),
+                    jnp.ones(scale_shape, jnp.float32),
+                    jnp.ones(scale_shape, jnp.float32))
+
+    def _param_vals(self):
+        with self._lock:
+            return tuple(c._data for c in self._cells)
+
+    @property
+    def kv_hbm_bytes(self):
+        """Bytes the KV page arrays occupy (pool sizing forensics)."""
+        return sum(int(_np.prod(a.shape)) * a.dtype.itemsize
+                   for a in self._kv)
+
+    @property
+    def free_pages(self):
+        return self.pool.free_count
+
+    @property
+    def compiled_keys(self):
+        return sorted(self._execs)
+
+    # ------------------------------------------------------- executables
+    def _fingerprint(self):
+        from .. import capture as _capture
+        from ..tune import schedule as _schedule
+
+        return _capture.fingerprint({
+            "spec": sorted(self._spec.items()),
+            "geometry": (self.num_pages, self.page_size, self.max_pages,
+                         self.max_seqs),
+            "kv_dtype": self._kv_dtype,
+            "params": [(n, tuple(c.shape), str(c.dtype))
+                       for n, c in zip(self._names, self._cells)],
+            # the tuned decode_attn block size shapes the step program:
+            # a schedule rollout (operator._rebuild clears _execs) must
+            # recompile, never warm-hit a stale-blocked artifact
+            "schedule": _schedule.fingerprint_token(),
+        })
+
+    def _exec_for(self, key):
+        ex = self._execs.get(key)
+        if ex is not None:
+            return ex
+        with self._lock:
+            ex = self._execs.get(key)
+            if ex is None:
+                ex = self._build_exec(key)
+                self._execs[key] = ex
+            return ex
+
+    def _build_exec(self, key):
+        from .. import capture as _capture
+
+        tf, spec, interp = self._tf, self._spec, self._interpret
+        fp = self._fingerprint()
+        if key[0] == "prefill":
+            def fn(tokens, true_len, page_row, kp, vp, ks, vs, *params):
+                logits, kv = tf.paged_prefill(
+                    params, spec, tokens, true_len, (kp, vp, ks, vs),
+                    page_row, interpret=interp)
+                return (logits,) + tuple(kv)
+
+            return _capture.CapturedExec(
+                fn, label=f"decode_prefill{key[1]}", fingerprint=fp,
+                donate_argnums=(3, 4, 5, 6))
+        if key[0] == "step":
+            def fn(tokens, positions, active, page_table, kp, vp, ks, vs,
+                   *params):
+                nxt, logits, kv = tf.paged_step(
+                    params, spec, tokens, positions, active,
+                    (kp, vp, ks, vs), page_table, interpret=interp)
+                return (nxt, logits) + tuple(kv)
+
+            return _capture.CapturedExec(
+                fn, label="decode_step", fingerprint=fp,
+                donate_argnums=(4, 5, 6, 7))
+        if key[0] == "full":
+            def fn(tokens, *params):
+                return tf.flat_forward(params, spec, tokens)
+
+            return _capture.CapturedExec(
+                fn, label=f"decode_full_b{key[1]}x{key[2]}",
+                fingerprint=fp)
+        raise MXNetError(f"DecodePredictor: unknown executable {key}")
+
+    def prefill_bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        return n  # exact-size executable beyond the declared ladder
+
+    # ------------------------------------------------------------ engine
+    def prefill(self, tokens, page_row):
+        """Run one prompt (1-D int sequence) through its bucketed
+        prefill executable, writing KV into the pages ``page_row``
+        (max_pages,) maps. Returns ``(first_token, logits)`` — the
+        greedy next token and the raw last-position logits."""
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        n = int(toks.shape[0])
+        if n < 1 or n > self._spec["max_len"]:
+            raise MXNetError(
+                f"prefill: prompt length {n} outside [1, "
+                f"{self._spec['max_len']}]")
+        bucket = self.prefill_bucket_for(n)
+        padded = _np.zeros((1, bucket), _np.int32)
+        padded[0, :n] = toks
+        true_len = _np.asarray([n], _np.int32)
+        row = _np.asarray(page_row, _np.int32).reshape(self.max_pages)
+        ex = self._exec_for(("prefill", bucket))
+        with self._run_lock:
+            with _obs_trace.span("decode.prefill", tokens=n,
+                                 bucket=bucket):
+                out = ex(padded, true_len, row, *self._kv,
+                         *self._param_vals())
+            logits = out[0]
+            self._kv = tuple(out[1:])
+        _STATS["decode_prefills"] += 1
+        return int(_np.asarray(logits).argmax()), logits
+
+    def step(self, tokens, positions, active, page_table):
+        """ONE fixed-shape decode step over every sequence slot.
+        ``tokens``/``positions``/``active``: (max_seqs,) int32 — the
+        last sampled token, its position, and a 0/1 liveness flag per
+        row; ``page_table``: (max_seqs, max_pages) int32. Returns
+        ``(next_tokens (max_seqs,) numpy, logits raw)`` — inactive rows
+        return garbage the caller must ignore."""
+        toks = _np.asarray(tokens, _np.int32).reshape(self.max_seqs)
+        pos = _np.asarray(positions, _np.int32).reshape(self.max_seqs)
+        act = _np.asarray(active, _np.int32).reshape(self.max_seqs)
+        table = _np.asarray(page_table, _np.int32).reshape(
+            self.max_seqs, self.max_pages)
+        ex = self._exec_for(("step",))
+        with self._run_lock:
+            with _obs_trace.span("decode.step",
+                                 live=int(act.sum())):
+                out = ex(toks, pos, act, table, *self._kv,
+                         *self._param_vals())
+            nxt, logits = out[0], out[1]
+            self._kv = tuple(out[2:])
+        _STATS["decode_steps"] += 1
+        return _np.asarray(nxt), logits
+
+    def greedy_decode(self, prompt, max_new_tokens, eos_id=None):
+        """Single-sequence greedy generation through the paged path —
+        the parity/gate/warm-bench entry (production streams go through
+        serving.DecodeBatcher). Allocates this sequence's pages from the
+        shared pool, prefills, then steps on slot 0 until
+        ``max_new_tokens``, ``eos_id``, or the context window. Returns
+        the generated token list; pages are freed on every exit path."""
+        toks = [int(t) for t in prompt]
+        if not toks:
+            raise MXNetError("greedy_decode: empty prompt")
+        total = min(len(toks) + int(max_new_tokens),
+                    self._spec["max_len"])
+        pages = self.pool.alloc(-(-total // self.page_size))
+        if pages is None:
+            raise MXNetError(
+                "greedy_decode: KV page pool exhausted "
+                f"({self.pool.free_count} free) — backpressure")
+        out = []
+        try:
+            row = _np.zeros((self.max_pages,), _np.int32)
+            row[:len(pages)] = pages
+            first, _ = self.prefill(toks, row)
+            _STATS["decode_sequences"] += 1
+            _STATS["decode_tokens"] += 1
+            out.append(first)
+            pos = len(toks)
+            table = _np.zeros((self.max_seqs, self.max_pages), _np.int32)
+            table[0] = row
+            step_toks = _np.zeros((self.max_seqs,), _np.int32)
+            positions = _np.zeros((self.max_seqs,), _np.int32)
+            active = _np.zeros((self.max_seqs,), _np.int32)
+            active[0] = 1
+            while (len(out) < int(max_new_tokens) and pos < total
+                   and (eos_id is None or out[-1] != eos_id)):
+                step_toks[0] = out[-1]
+                positions[0] = pos
+                nxt, _ = self.step(step_toks, positions, active, table)
+                out.append(int(nxt[0]))
+                _STATS["decode_tokens"] += 1
+                pos += 1
+        finally:
+            self.pool.free(pages)
+        return out
+
+    # ------------------------------------------------------ probe surface
+    def predict_raw(self, data):
+        """Stateless full-context forward for health probes and rollout
+        canary gates: ``data`` (B, T) int token ids (dict with one entry
+        accepted) -> ``([logits (B, T, vocab)], B)`` — the Predictor
+        ``predict_raw`` contract, so Router/Supervisor/RolloutManager
+        drive a decode replica exactly like a fixed-shape one."""
+        if isinstance(data, dict):
+            if len(data) != 1:
+                raise MXNetError("DecodePredictor takes one token input, "
+                                 f"got {sorted(data)}")
+            data = next(iter(data.values()))
+        a = _np.asarray(_raw(data))
+        if a.ndim == 1:
+            a = a[None]
+        if a.ndim != 2:
+            raise MXNetError("DecodePredictor.predict_raw wants (B, T) "
+                             f"token ids, got shape {tuple(a.shape)}")
+        a = a.astype(_np.int32)
+        ex = self._exec_for(("full", int(a.shape[0]), int(a.shape[1])))
+        with _obs_trace.span("decode.predict", rows=int(a.shape[0])):
+            logits = ex(a, *self._param_vals())
+        return [logits], int(a.shape[0])
+
+    def predict(self, data):
+        from ..ndarray.ndarray import NDArray
+
+        outs, _ = self.predict_raw(data)
+        return [NDArray(o, self._ctx) for o in outs]
+
+    # Fleet/BatchServer compatibility surface: a thread Fleet wraps a
+    # replica's predictor in a BatchServer (coercion + batching rules
+    # come from the predictor itself) and health probes synthesize a
+    # 1-row zero batch from ``_input_tails``/``_dtype``. The probe
+    # input is one row of ``prefill_buckets[0]`` token ids — a shape
+    # ``warmup()`` already compiled, so probes are always replay.
+    input_names = ("data",)
+    _dtype = _np.dtype(_np.int32)
+
+    @property
+    def buckets(self):
+        # decode replicas serve probes/canary forwards one row at a
+        # time through BatchServer; streaming goes via DecodeBatcher
+        return (1,)
+
+    @property
+    def _input_tails(self):
+        return {"data": (self.prefill_buckets[0],)}
+
+    def _coerce_feeds(self, data):
+        if not isinstance(data, dict):
+            data = {"data": data}
+        if set(data) != {"data"}:
+            raise MXNetError("DecodePredictor takes one 'data' input, "
+                             f"got {sorted(data)}")
+        a = _np.asarray(_raw(data["data"]))
+        if a.ndim != 2:
+            raise MXNetError("DecodePredictor wants (B, T) token ids, "
+                             f"got shape {tuple(a.shape)}")
+        return {"data": a.astype(_np.int32)}, int(a.shape[0])
+
+    def _sig_of(self, feeds):
+        return tuple(sorted((name, tuple(a.shape[1:]), str(a.dtype))
+                            for name, a in feeds.items()))
+
+    # ------------------------------------------------------------ rollout
+    def swap_params(self, params):
+        """Atomically flip parameter VALUES in-place — same contract as
+        ``Predictor.swap_params`` (validate-everything-then-flip, prior
+        values returned as an ``{"arg:NAME": NDArray}`` rollback
+        snapshot). Values are runtime operands for prefill, step AND the
+        probe forward, so a weights rollout never retraces any of them
+        and in-flight sequences continue on the new weights from their
+        next token."""
+        from ..ndarray import ndarray as nd
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(params, str):
+            params = nd.load(params)
+        updates = {}
+        for key, v in params.items():
+            kind, _, name = key.partition(":")
+            if kind not in ("arg", "aux"):
+                name = key
+            if name not in self._idx:
+                raise MXNetError(f"swap_params: '{name}' is not a "
+                                 "parameter of this decode predictor")
+            if not isinstance(v, NDArray):
+                v = nd.array(v, ctx=self._ctx)
+            updates[name] = self._place(v)
+        with self._lock:
+            for name, v in updates.items():
+                cell = self._cells[self._idx[name]]
+                if tuple(cell.shape) != tuple(v.shape) or \
+                        cell.dtype != v.dtype:
+                    raise MXNetError(
+                        f"swap_params: '{name}' is {tuple(v.shape)}/"
+                        f"{v.dtype} but the bound cell is "
+                        f"{tuple(cell.shape)}/{cell.dtype}; a changed "
+                        "architecture needs a new DecodePredictor")
+            prev = {}
+            for name, v in updates.items():
+                cell = self._cells[self._idx[name]]
+                prev[f"arg:{name}"] = NDArray(cell._data, self._ctx)
+                cell._data = v._data
+        return prev
+
+    def warmup(self):
+        """Compile every executable the steady state needs — all prefill
+        buckets, THE step, and the smallest probe shape — against the
+        scratch page only, so the first real sequence never pays
+        compile latency and everything after is replay (the
+        zero-retrace contract). Counts persistent-AOT warm starts like
+        ``Predictor.warmup``."""
+        import jax
+
+        from .. import capture as _capture
+
+        before = _capture.stats().get("aot_cache_hits", 0)
+        row = _np.zeros((self.max_pages,), _np.int32)
+        for b in self.prefill_buckets:
+            self.prefill(_np.zeros((b,), _np.int32), row)
+        z = _np.zeros((self.max_seqs,), _np.int32)
+        self.step(z, z, z, _np.zeros((self.max_seqs, self.max_pages),
+                                     _np.int32))
+        outs, _ = self.predict_raw(
+            _np.zeros((1, self.prefill_buckets[0]), _np.int32))
+        jax.block_until_ready(outs)
+        jax.block_until_ready(self._kv)
+        self.warmup_cache_hits = (
+            _capture.stats().get("aot_cache_hits", 0) - before)
+        return self

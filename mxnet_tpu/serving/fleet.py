@@ -70,7 +70,7 @@ from .batcher import (BatchServer, DeadlineExceeded, ServerClosed,
                       ServerOverloaded, _env_float, _env_int, _try_resolve)
 
 __all__ = ["Fleet", "FleetClosed", "FleetOverloaded", "ReplicaSupervisor",
-           "Router", "STATES"]
+           "Router", "STATES", "StreamRouter"]
 
 STATES = ("HEALTHY", "DRAINING", "DEAD", "RESTARTING", "WARMING")
 
@@ -1670,3 +1670,115 @@ class Fleet:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# --------------------------------------------------- streaming decode front
+
+class StreamRouter:
+    """Multi-replica front for streamed generation (docs/decode.md).
+
+    Owns N :class:`serving.DecodeBatcher` replicas built from one
+    zero-arg factory returning a ready ``DecodePredictor`` (run again by
+    :meth:`revive` after a death — set ``MXNET_TPU_COMPILE_CACHE`` so
+    rebuilds warm-start). ``submit_stream`` routes each new sequence to
+    the live replica with the least outstanding work, and every replica
+    gets this router installed as its death sink: when a decode engine
+    dies mid-stream (``decode_replica_death`` chaos, or any engine
+    crash), each incomplete stream is RESUBMITTED to another live
+    replica — prompt plus tokens-already-streamed re-prefill there, the
+    consumer's :class:`TokenStream` keeps yielding with only a latency
+    blip, and ``decode_reroutes`` counts the saves. With no live replica
+    left, streams fail with the original error instead of hanging.
+    """
+
+    def __init__(self, factory, replicas=2, ttft_slo_ms=None):
+        from .batcher import DecodeBatcher
+
+        n = int(replicas)
+        if n < 1:
+            raise MXNetError(f"StreamRouter needs >= 1 replica, got {n}")
+        self._factory = factory
+        self._ttft_slo_ms = ttft_slo_ms
+        self._decode_cls = DecodeBatcher
+        self._lock = threading.Lock()
+        self._closed = False
+        self._batchers = [self._build() for _ in range(n)]
+
+    def _build(self):
+        bat = self._decode_cls(self._factory(),
+                               ttft_slo_ms=self._ttft_slo_ms)
+        bat.death_sink = lambda items, exc, _bat=bat: \
+            self._reroute(_bat, items, exc)
+        return bat
+
+    def _pick(self, exclude=()):
+        with self._lock:
+            live = [b for b in self._batchers
+                    if not b.dead and b not in exclude]
+        if not live:
+            return None
+        return min(live, key=lambda b: b.outstanding)
+
+    def submit_stream(self, prompt, max_new_tokens, eos_id=None):
+        """Route one generation request; returns its
+        :class:`serving.TokenStream`."""
+        if self._closed:
+            raise FleetClosed("StreamRouter is closed")
+        bat = self._pick()
+        if bat is None:
+            raise FleetOverloaded("decode", len(self._batchers),
+                                  0, len(self._batchers))
+        _STATS["fleet_requests"] += 1
+        return bat.submit(prompt, max_new_tokens, eos_id=eos_id)
+
+    def _reroute(self, dead_bat, items, exc):
+        for stream, prompt, remaining, eos_id in items:
+            target = None if self._closed else \
+                self._pick(exclude=(dead_bat,))
+            if target is None:
+                if not stream.finished:
+                    stream._fail(exc)
+                continue
+            try:
+                target.submit(prompt, remaining, eos_id=eos_id,
+                              stream=stream)
+                _STATS["decode_reroutes"] += 1
+            except Exception:
+                if not stream.finished:
+                    stream._fail(exc)
+
+    def revive(self):
+        """Rebuild every dead replica from the factory (the supervisor
+        restart analogue for decode engines). Returns how many were
+        rebuilt."""
+        rebuilt = 0
+        with self._lock:
+            for i, b in enumerate(self._batchers):
+                if b.dead and not self._closed:
+                    self._batchers[i] = self._build()
+                    rebuilt += 1
+        _STATS["fleet_restarts"] += rebuilt
+        return rebuilt
+
+    @property
+    def live_replicas(self):
+        with self._lock:
+            return sum(1 for b in self._batchers if not b.dead)
+
+    @property
+    def replicas(self):
+        with self._lock:
+            return list(self._batchers)
+
+    def close(self, drain=True):
+        self._closed = True
+        with self._lock:
+            batchers = list(self._batchers)
+        for b in batchers:
+            b.close(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=exc[0] is None)

@@ -373,6 +373,78 @@ class RolloutManager:
                         f"{base_p50 * 1e6:.0f}us", p50)
         return None, None, p50
 
+    # ------------------------------------------------- decode canary gates
+    @staticmethod
+    def _decode_capable(pred):
+        """Decode predictors duck-type ``greedy_decode``; fixed-shape
+        predictors get the classic three gates only."""
+        return hasattr(pred, "greedy_decode")
+
+    @staticmethod
+    def _decode_probe(pred):
+        """Deterministic canary prompt + decode length, sized to the
+        predictor's context window so the probe never trips the
+        max_len eviction path."""
+        spec = pred._spec
+        prompt = [(i * 7 + 3) % spec["vocab"] for i in range(6)]
+        return prompt, max(1, min(6, spec["max_len"] - len(prompt) - 1))
+
+    def _measure_ttft(self, pred, prompt):
+        """p50 time-to-first-token over a quarter canary window: each
+        sample is one bucketed prefill + first-token emit
+        (``greedy_decode`` of a single token) — the decode cost a real
+        admission pays before it can stream anything."""
+        lat = []
+        for _ in range(max(1, self.canary_calls // 4)):
+            t0 = time.perf_counter()
+            pred.greedy_decode(list(prompt), 1)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    def _token_parity(self, pred, prompt, n):
+        """Greedy-decode the candidate through the PAGED path and check
+        every token against the argmax of the predictor's own flat
+        full-context forward on the growing context. Internal
+        consistency of one artifact across its two executable families
+        — a candidate whose paged KV path diverges from its probe
+        forward must not serve streams. Returns None on parity, else
+        ``(index, total, got, want)`` for the first mismatch."""
+        import numpy as np
+
+        toks = pred.greedy_decode(list(prompt), n)
+        ctx = list(prompt)
+        for i, t in enumerate(toks):
+            outs, _ = pred.predict_raw(np.asarray([ctx], np.int32))
+            want = int(np.argmax(np.asarray(outs[0])[0, -1]))
+            if int(t) != want:
+                return i, len(toks), int(t), want
+            ctx.append(want)
+        return None
+
+    def _decode_gates(self, pred, base_ttft):
+        """The two extra gates a decode-capable canary must pass after
+        the classic three: token parity, then TTFT p50 within
+        ``max_latency_x`` of the pre-swap baseline. Returns
+        ``(gate, detail, ttft)`` with ``gate`` None on pass."""
+        prompt, n = self._decode_probe(pred)
+        with _trace.span("rollout.gate.decode_parity"):
+            mismatch = self._token_parity(pred, prompt, n)
+            if mismatch is not None:
+                i, total, got, want = mismatch
+                return ("decode_parity",
+                        f"paged token {i}/{total} = {got} but flat "
+                        f"argmax = {want}", None)
+        with _trace.span("rollout.gate.decode_ttft"):
+            ttft = self._measure_ttft(pred, prompt)
+            ceil = max(base_ttft, 1e-6) * self.max_latency_x
+            if ttft > ceil:
+                return ("decode_ttft",
+                        f"canary TTFT p50 {ttft * 1e6:.0f}us > "
+                        f"{self.max_latency_x}x baseline "
+                        f"{base_ttft * 1e6:.0f}us", ttft)
+        return None, None, ttft
+
     @staticmethod
     def _rollback_span(gate):
         return _trace.span("rollout.rollback", gate=gate)
@@ -426,6 +498,10 @@ class RolloutManager:
                 with self._canary_span(canary):
                     base_outs, _ = pred.predict_raw(batch)
                     base_p50 = self._measure_p50(pred, batch)
+                    base_ttft = None
+                    if self._decode_capable(pred):
+                        base_ttft = self._measure_ttft(
+                            pred, self._decode_probe(pred)[0])
                     try:
                         prev = pred.swap_params(params)
                     except MXNetError as e:
@@ -453,6 +529,10 @@ class RolloutManager:
                 if gate is None:
                     gate, detail, p50 = self._latency_gate(
                         pred, batch, base_p50)
+                ttft = None
+                if gate is None and base_ttft is not None:
+                    gate, detail, ttft = self._decode_gates(
+                        pred, base_ttft)
                 if gate is not None:
                     with self._rollback_span(gate):
                         pred.swap_params(prev)
@@ -468,11 +548,14 @@ class RolloutManager:
                             # the same candidate converges it
                             continue
                         rp.swap_params(params)
+                fields = {"agreement": round(agreement, 4),
+                          "canary_p50_us": int(p50 * 1e6),
+                          "baseline_p50_us": int(base_p50 * 1e6)}
+                if ttft is not None:
+                    fields["canary_ttft_us"] = int(ttft * 1e6)
+                    fields["baseline_ttft_us"] = int(base_ttft * 1e6)
                 return self._decide(
-                    root, "weights", rollout_id, "promote",
-                    agreement=round(agreement, 4),
-                    canary_p50_us=int(p50 * 1e6),
-                    baseline_p50_us=int(base_p50 * 1e6))
+                    root, "weights", rollout_id, "promote", **fields)
 
     # ------------------------------------------------------------- schedule
     def rollout_schedule(self, table_path, eval_batch=None, reason=None):
@@ -519,6 +602,10 @@ class RolloutManager:
                 # supervisor recycling a replica mid-rollout
                 canary_pred = canary.predictor
                 base_p50 = self._measure_p50(canary_pred, batch)
+                base_ttft = None
+                if self._decode_capable(canary_pred):
+                    base_ttft = self._measure_ttft(
+                        canary_pred, self._decode_probe(canary_pred)[0])
 
                 def _swap_env(value):
                     if value is None:
@@ -562,6 +649,10 @@ class RolloutManager:
                 if gate is None:
                     gate, detail, p50 = self._latency_gate(
                         canary_pred, batch, base_p50)
+                ttft = None
+                if gate is None and base_ttft is not None:
+                    gate, detail, ttft = self._decode_gates(
+                        canary_pred, base_ttft)
                 if gate is not None:
                     with self._rollback_span(gate):
                         _swap_env(old_env)
@@ -577,8 +668,12 @@ class RolloutManager:
                 with self._promote_span(len(rest) + 1):
                     for r in rest:
                         _rebuild(r.predictor)
+                fields = {"old_token": old_token,
+                          "new_token": new_token,
+                          "canary_p50_us": int(p50 * 1e6),
+                          "baseline_p50_us": int(base_p50 * 1e6)}
+                if ttft is not None:
+                    fields["canary_ttft_us"] = int(ttft * 1e6)
+                    fields["baseline_ttft_us"] = int(base_ttft * 1e6)
                 return self._decide(
-                    root, "schedule", rollout_id, "promote",
-                    old_token=old_token, new_token=new_token,
-                    canary_p50_us=int(p50 * 1e6),
-                    baseline_p50_us=int(base_p50 * 1e6))
+                    root, "schedule", rollout_id, "promote", **fields)

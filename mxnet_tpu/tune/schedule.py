@@ -52,6 +52,7 @@ MIN_SUBLANE = 8
 # and what validate_table() accepts. Block axes are legal-subset-filtered
 # per shape at candidate-generation time.
 FLASH_BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
+DECODE_PAGE_BLOCK_CANDIDATES = (16, 8, 4, 2, 1)
 SEARCH_SPACE = {
     # Pallas streaming flash-attention forward (ops/pallas_kernels.py);
     # also the ring-attention per-hop kernel, keyed at the hop's local
@@ -69,6 +70,11 @@ SEARCH_SPACE = {
     # reference two-multiply form, or one fused combined scale (may
     # differ in the last ULP — the numerics gate decides per shape)
     "int8_requant": {"path": ("via_fp32", "fused_scale")},
+    # paged decode attention (ops/decode_attention.py): how many KV
+    # pages the streaming-softmax loop gathers per block, keyed per
+    # (decode batch, pages-per-sequence) shape — the one-token-per-
+    # sequence serving hot path (serving/decode.py)
+    "decode_attn": {"block_pages": DECODE_PAGE_BLOCK_CANDIDATES},
 }
 
 # What a kernel runs when the table has no entry — the hand-written
@@ -79,6 +85,7 @@ DEFAULT_SCHEDULES = {
     "int8_fc": {"operand_width": "int8"},
     "int8_conv": {"operand_width": "int8"},
     "int8_requant": {"path": "via_fp32"},
+    "decode_attn": {"block_pages": 8},
 }
 
 _LOCK = threading.Lock()
@@ -292,6 +299,12 @@ def int8_requant_shape_key(rows, cols):
     return f"r{int(rows)}-c{int(cols)}"
 
 
+def decode_shape_key(batch, pages):
+    """Paged decode attention table key: the fixed decode-batch width
+    and the per-sequence page-table width (kv capacity in pages)."""
+    return f"b{int(batch)}-p{int(pages)}"
+
+
 # ----------------------------------------------- flash-kernel resolution
 
 
@@ -348,6 +361,26 @@ def flash_bwd_block(bh, t, d, dtype, interpret=False, block_k=None):
                                 str(dtype), resolve_backend(interpret))
         block_k = sched["block_k"]
     return max(1, min(int(block_k), t))
+
+
+def decode_attn_block_pages(batch, pages, dtype, interpret=False,
+                            block_pages=None):
+    """Resolved + legalized ``block_pages`` for the paged decode
+    attention loop: the largest divisor of the page-table width at or
+    under the scheduled value, so the streaming-softmax scan covers the
+    table exactly. Any width in [1, pages] is legal (page-granular
+    masking handles ragged sequence lengths), so unlike the flash
+    resolver this never raises."""
+    pages = max(1, int(pages))
+    if block_pages is None:
+        sched = kernel_schedule(
+            "decode_attn", decode_shape_key(batch, pages), str(dtype),
+            resolve_backend(interpret))
+        block_pages = sched["block_pages"]
+    bp = max(1, min(int(block_pages), pages))
+    while pages % bp != 0:
+        bp -= 1
+    return bp
 
 
 # -------------------------------------------------------------- persistence

@@ -217,6 +217,54 @@ def flash_bwd_workload(b=2, h=1, t=256, d=32, causal=True, interpret=None,
         reference={"block_k": min(default_bk, t)})
 
 
+def decode_attn_workload(b=4, pages=8, page_size=16, h=2, d=32, seed=9,
+                         quick=False, label=None):
+    """Paged decode attention sweep at one (batch, pages) shape — the
+    block_pages width of the streaming-softmax gather loop
+    (ops/decode_attention.py). Every width in [1, pages] is legal (the
+    resolver snaps to the largest dividing width), so candidates are
+    the declared space clipped to the table width."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    interpret = not _chip()
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(b, h, d).astype(np.float32) * 0.3)
+    k_pages, v_pages = [
+        jnp.asarray(rs.randn(pages + 1, page_size, h, d)
+                    .astype(np.float32) * 0.3) for _ in range(2)]
+    table = jnp.asarray(
+        rs.permutation(pages)[None].repeat(b, 0) + 1, jnp.int32)
+    lengths = jnp.asarray(
+        rs.randint(page_size, pages * page_size + 1, b), jnp.int32)
+
+    def build(sched):
+        import jax
+
+        from ..ops.decode_attention import paged_decode_attention
+
+        fn = jax.jit(lambda q, kp, vp, tbl, ln: paged_decode_attention(
+            q, kp, vp, tbl, ln, block_pages=sched["block_pages"],
+            interpret=interpret))
+        return fn, (q, k_pages, v_pages, table, lengths)
+
+    space = [bp for bp in
+             schedule.SEARCH_SPACE["decode_attn"]["block_pages"]
+             if bp <= pages]
+    if quick:
+        space = space[:3] or [1]
+    default = schedule.DEFAULT_SCHEDULES["decode_attn"]["block_pages"]
+    ref_bp = schedule.decode_attn_block_pages(
+        b, pages, "float32", interpret=interpret, block_pages=default)
+    return Workload(
+        "decode_attn", schedule.decode_shape_key(b, pages), "float32",
+        schedule.resolve_backend(interpret), build,
+        [{"block_pages": bp} for bp in space],
+        label=label or "decode_attn",
+        reference={"block_pages": ref_bp})
+
+
 def _chip():
     from ..ops.pallas_kernels import pallas_available
 

@@ -225,6 +225,38 @@ def test_shed_burn_rule_fires_on_overload_sheds():
     assert got.get("slo_deadline_burn") == "FIRING"
 
 
+def test_decode_ttft_burn_rule_fires_on_ttft_misses():
+    """The default decode_ttft_burn rule: TTFT SLO misses burning the
+    budget over admitted decode sequences fire it — its windows read
+    the decode counter group, so fleet deadline misses alone leave it
+    quiet."""
+    rule = alerts.get_rule("decode_ttft_burn")
+    serving.reset_stats()
+    serving._STATS["decode_sequences"] = 100
+    t = 1000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["decode_sequences"] += 100
+    serving._STATS["decode_ttft_misses"] += 50
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert got.get("decode_ttft_burn") == "FIRING"
+    assert rule.state == "FIRING"
+    ev = rule.last_evidence
+    assert ev["windows"]["fast"]["decode_ttft_misses"] == 50
+    assert ev["windows"]["fast"]["decode_sequences"] == 100
+    # a fleet deadline burn leaves the decode rule quiet
+    alerts.reset()
+    _seed_slo(requests=100)
+    t = 2000.0
+    alerts.evaluate(now=t, force=True)
+    serving._STATS["fleet_requests"] += 100
+    serving._STATS["fleet_deadline_exceeded"] += 50
+    t += 30
+    got = alerts.evaluate(now=t, force=True)
+    assert "decode_ttft_burn" not in got
+    assert got.get("slo_deadline_burn") == "FIRING"
+
+
 def test_slo_counters_applies_the_slo_burn_hook():
     _seed_slo(requests=10)
     clean = metrics.slo_counters()

@@ -503,15 +503,15 @@ def test_autotune_demo_cold_then_warm(tmp_path, monkeypatch, capsys):
     assert mod.main(["--demo", "--table", tbl]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["metric"] == "autotune_searches"
-    assert out["value"] == 8 and out["extra"]["errors"] == 0
+    assert out["value"] == 9 and out["extra"]["errors"] == 0
     data = json.load(open(tbl))
     assert schedule.validate_table(data) == []
-    assert len(data["entries"]) == 8
+    assert len(data["entries"]) == 9
     # the sweep covers flash fwd/bwd, the ring hop and transformer
-    # head shapes, and int8
+    # head shapes, paged decode attention, and int8
     kernels = {k.split("|")[0] for k in data["entries"]}
-    assert kernels == {"flash_fwd", "flash_bwd", "int8_fc", "int8_conv",
-                       "int8_requant"}
+    assert kernels == {"flash_fwd", "flash_bwd", "decode_attn", "int8_fc",
+                       "int8_conv", "int8_requant"}
     labels = {r["label"] for r in out["extra"]["results"]}
     assert "ring_hop" in labels
 
@@ -519,7 +519,7 @@ def test_autotune_demo_cold_then_warm(tmp_path, monkeypatch, capsys):
     assert mod.main(["--demo", "--table", tbl]) == 0
     out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out2["value"] == 0
-    assert out2["extra"]["skipped_warm"] == 8
+    assert out2["extra"]["skipped_warm"] == 9
 
 
 @pytest.mark.slow
@@ -534,7 +534,7 @@ def test_autotune_demo_cli_contract(tmp_path):
         capture_output=True, text=True, env=env, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     out = json.loads(r.stdout.strip().splitlines()[-1])
-    assert out["metric"] == "autotune_searches" and out["value"] == 8
+    assert out["metric"] == "autotune_searches" and out["value"] == 9
 
 
 def test_validate_baselines_schedule_table_cli(tmp_path):

@@ -79,6 +79,10 @@ def build_workloads(quick):
                                   quick=quick, label="transformer_fwd"),
         search.flash_bwd_workload(b=2, h=1, t=128, d=64, causal=True,
                                   quick=quick, label="transformer_bwd"),
+        # the serving decode step's paged-attention gather width, keyed
+        # at the DecodePredictor default geometry (serving/decode.py)
+        search.decode_attn_workload(b=4, pages=8, page_size=16,
+                                    quick=quick),
         search.int8_fc_workload(m=8, k=64, n=32),
         search.int8_conv_workload(n=2, c=8, hw=8, o=16),
         search.int8_requant_workload(rows=8, cols=32),

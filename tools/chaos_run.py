@@ -60,7 +60,8 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "replica_nan_storm", "int8_calib_mismatch",
               "perf_regression", "slo_burn", "step_time_anomaly",
               "record_corrupt", "nonfinite_grad", "rollout_bad_weights",
-              "canary_slo_regression", "autoscale_flap")
+              "canary_slo_regression", "autoscale_flap",
+              "decode_replica_death", "kv_pool_exhaustion")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -1154,6 +1155,113 @@ def _drill_autoscale_flap(mx, workdir):
         fleet.close()
 
 
+def _decode_net(mx):
+    """Tiny deterministic transformer LM + eager greedy reference for
+    the decode drills.  The reference rolls the FULL context through the
+    uncaptured block each token — the paged path must match it
+    token-for-token (greedy argmax is deterministic)."""
+    import numpy as np
+
+    from mxnet_tpu.gluon.model_zoo.transformer import transformer_lm
+
+    mx.random.seed(11)
+    net = transformer_lm(vocab=40, units=24, num_heads=2, num_layers=1,
+                         max_len=48)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 8), np.int32), dtype="int32"))
+
+    def ref_decode(prompt, n):
+        seq = list(prompt)
+        out = []
+        for _ in range(n):
+            logits = net(mx.nd.array(np.asarray([seq], np.int32),
+                                     dtype="int32"))
+            nxt = int(np.asarray(logits.asnumpy())[0, -1].argmax())
+            out.append(nxt)
+            seq.append(nxt)
+        return out
+
+    return net, ref_decode
+
+
+def _drill_decode_replica_death(mx, workdir):
+    """A decode replica dies mid-stream (fault raises inside its engine
+    loop while a sequence is half-generated).  The StreamRouter must
+    reroute the orphaned stream to the surviving replica — re-prefilling
+    from the already-emitted tokens — and the client must receive the
+    SAME token sequence as an uninterrupted greedy decode.  Afterwards
+    ``revive()`` restores capacity and every KV page is back in the
+    free pool."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+
+    serving.reset_stats()
+    net, ref_decode = _decode_net(mx)
+
+    def factory():
+        return serving.DecodePredictor(net, page_size=4, num_pages=24,
+                                       max_seqs=3, prefill_buckets=(8,),
+                                       warmup=True)
+
+    router = serving.StreamRouter(factory, replicas=2, ttft_slo_ms=60000)
+    try:
+        prompt = [5, 11, 23, 2]
+        # fires on the victim engine loop's 3rd iteration — after TTFT,
+        # mid-stream, with pages held
+        with faults.inject("decode_replica_death", at_step=2,
+                           times=1) as f:
+            got = router.submit_stream(prompt, 12).result(timeout=120)
+        expect = ref_decode(prompt, 12)
+        live_after_death = router.live_replicas
+        revived = router.revive()
+        s = serving.stats()
+        pages_held = sum(b.predictor.pool.in_use for b in router.replicas)
+        ok = (got == expect and f.fired == 1
+              and s["decode_reroutes"] >= 1
+              and live_after_death == 1
+              and revived == 1 and router.live_replicas == 2
+              and pages_held == 0)
+        return ok, (f"fired={f.fired} parity={got == expect} "
+                    f"reroutes={s['decode_reroutes']} "
+                    f"live_after_death={live_after_death} "
+                    f"revived={revived} pages_held={pages_held}")
+    finally:
+        router.close()
+
+
+def _drill_kv_pool_exhaustion(mx, workdir):
+    """The paged KV pool reports zero free pages at admission time (the
+    fault starves ``PagePool.alloc``).  Admission must BACKPRESSURE —
+    the stream stays queued, nothing crashes, no partial allocation
+    leaks — and once the fault clears the sequence is admitted and
+    finishes token-for-token correct."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving.batcher import DecodeBatcher
+
+    serving.reset_stats()
+    net, ref_decode = _decode_net(mx)
+    pred = serving.DecodePredictor(net, page_size=4, num_pages=8,
+                                   max_seqs=2, prefill_buckets=(8,),
+                                   warmup=True)
+    bat = DecodeBatcher(pred, ttft_slo_ms=60000)
+    try:
+        prompt = [7, 3, 29, 14]
+        with faults.inject("kv_pool_exhaustion", at_step=0,
+                           times=3) as f:
+            got = bat.submit(prompt, 6).result(timeout=120)
+        expect = ref_decode(prompt, 6)
+        s = serving.stats()
+        ok = (got == expect and f.fired >= 1
+              and s["decode_backpressure"] >= 1
+              and pred.pool.in_use == 0)
+        return ok, (f"fired={f.fired} parity={got == expect} "
+                    f"backpressure={s['decode_backpressure']} "
+                    f"pages_held={pred.pool.in_use}")
+    finally:
+        bat.close()
+
+
 def _dispatch_drill(mx, kind, tmp):
     if kind == "nan_grad":
         return _drill_nan_grad(mx, tmp)
@@ -1200,6 +1308,10 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_rollout_gate(mx, tmp, kind)
     if kind == "autoscale_flap":
         return _drill_autoscale_flap(mx, tmp)
+    if kind == "decode_replica_death":
+        return _drill_decode_replica_death(mx, tmp)
+    if kind == "kv_pool_exhaustion":
+        return _drill_kv_pool_exhaustion(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 

@@ -34,8 +34,16 @@ Sections (details on stderr):
            is rejected by the canary health gate with zero
            client-visible errors and zero lost requests.
 
+- decode (``--decode``): the generative-decode sweep (docs/decode.md) —
+           continuous token-level batching over the paged KV cache
+           under churn (staggered admissions, mixed prompt buckets,
+           mid-stream cancellations, pool smaller than the offered
+           load). Reports tokens/s, TTFT p50/p99 and inter-token p99;
+           gates ZERO retraces after warmup, full token budgets on
+           every completed stream, and a clean page pool.
+
 Run: JAX_PLATFORMS=cpu python tools/serving_bench.py [--iters N]
-     [--skip-fleet] [--skip-int8] [--operate]
+     [--skip-fleet] [--skip-int8] [--operate] [--decode]
 """
 from __future__ import annotations
 
@@ -343,6 +351,96 @@ def bench_operate(mx, serving, clients=8, phase_s=2.0):
     }
 
 
+def bench_decode(mx, serving, seqs=18, new_tokens=12, clients=6):
+    """The decode sweep (docs/decode.md): continuous token-level
+    batching under churn — ``clients`` threads submit ``seqs`` streams
+    with staggered admissions, mixed prompt lengths (several prefill
+    buckets) and mid-stream cancellations, against a pool much smaller
+    than the offered load, so sequences join/leave the running batch
+    constantly. Reports tokens/s, TTFT p50/p99 and inter-token p99 from
+    the serving stats, and gates: ZERO retraces after warmup (the
+    executable set is frozen — membership churn is runtime operands
+    only), every completed stream got its full token budget, and every
+    KV page is back in the pool."""
+    import numpy as np
+
+    from mxnet_tpu.gluon.model_zoo.transformer import transformer_lm
+    from mxnet_tpu.serving.batcher import DecodeBatcher
+
+    serving.reset_stats()
+    mx.random.seed(9)
+    net = transformer_lm(vocab=64, units=32, num_heads=2, num_layers=2,
+                         max_len=64)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 8), np.int32), dtype="int32"))
+    pred = serving.DecodePredictor(net, page_size=4, num_pages=24,
+                                   max_seqs=3, prefill_buckets=(8, 16),
+                                   warmup=True)
+    warm_keys = list(pred.compiled_keys)
+    bat = DecodeBatcher(pred, ttft_slo_ms=60000)
+    rs = np.random.RandomState(5)
+    prompts = [[int(t) for t in rs.randint(0, 64, rs.randint(3, 14))]
+               for _ in range(seqs)]
+    results = {"full": 0, "cancelled": 0, "short": 0, "err": 0}
+    lock = threading.Lock()
+
+    def client(tid):
+        for i in range(tid, seqs, clients):
+            try:
+                s = bat.submit(prompts[i], new_tokens)
+                if i % 5 == 4:
+                    # churn: rip this stream out mid-generation
+                    it = s.tokens(timeout=60)
+                    next(it)
+                    next(it)
+                    s.cancel()
+                    with lock:
+                        results["cancelled"] += 1
+                    continue
+                toks = s.result(timeout=120)
+                with lock:
+                    results["full" if len(toks) == new_tokens
+                            else "short"] += 1
+            except Exception:
+                with lock:
+                    results["err"] += 1
+            time.sleep(0.002 * (tid % 3))  # stagger re-admissions
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    time.sleep(0.05)  # let cancelled streams' evictions settle
+    stats = serving.stats()
+    retraced = [k for k in pred.compiled_keys if k not in warm_keys]
+    pages_held = pred.pool.in_use
+    bat.close()
+    ok = (not retraced and results["err"] == 0 and results["short"] == 0
+          and results["full"] == seqs - results["cancelled"]
+          and pages_held == 0 and stats["decode_p99_ttft_us"] > 0)
+    return {
+        "streams": seqs,
+        "clients": clients,
+        "tokens_per_s": round(stats["decode_tokens"] / dt, 1),
+        "ttft_p50_us": stats["decode_p50_ttft_us"],
+        "ttft_p99_us": stats["decode_p99_ttft_us"],
+        "itl_p99_us": stats["decode_p99_itl_us"],
+        "completed": results["full"],
+        "cancelled": results["cancelled"],
+        "errors": results["err"],
+        "preemptions": stats["decode_preemptions"],
+        "backpressure": stats["decode_backpressure"],
+        "pages_inuse_peak": stats["decode_pages_inuse_peak"],
+        "retraces_after_warmup": len(retraced),
+        "pages_held": pages_held,
+        "gate_ok": ok,
+    }
+
+
 # the int8-vs-bf16 release gate lives in ONE place (bench_int8.py owns
 # the model-level measurement; this sweep enforces the same bar on the
 # Predictor path) so a retune can never fork the threshold
@@ -465,6 +563,11 @@ def main(argv=None):
     ap.add_argument("--operate", action="store_true",
                     help="run the operator sweep (autoscale under load + "
                          "canaried rollout) and gate the exit code on it")
+    ap.add_argument("--decode", action="store_true",
+                    help="run the decode sweep (paged KV continuous "
+                         "batching under churn: tokens/s, TTFT, "
+                         "inter-token p99, zero-retrace gate) and gate "
+                         "the exit code on it")
     args = ap.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -543,6 +646,21 @@ def main(argv=None):
               f"{operate['counts']['lost']} -> "
               f"{'ok' if operate_ok else 'FAIL'}", file=sys.stderr)
 
+    decode = None
+    decode_ok = True
+    if args.decode:
+        decode = bench_decode(mx, serving)
+        decode_ok = decode["gate_ok"]
+        print(f"decode ({decode['streams']} streams, {decode['clients']} "
+              f"clients, {decode['cancelled']} cancelled): "
+              f"{decode['tokens_per_s']:.0f} tokens/s, TTFT p50 "
+              f"{decode['ttft_p50_us']} us / p99 {decode['ttft_p99_us']} "
+              f"us, inter-token p99 {decode['itl_p99_us']} us, "
+              f"preemptions {decode['preemptions']}, retraces after "
+              f"warmup {decode['retraces_after_warmup']}, pages held "
+              f"{decode['pages_held']} -> "
+              f"{'ok' if decode_ok else 'FAIL'}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "serving_samples_per_s_b16",
         "value": round(batched, 1),
@@ -564,9 +682,11 @@ def main(argv=None):
             "int8_gate_ok": int8_ok,
             "operate": operate,
             "operate_gate_ok": operate_ok,
+            "decode": decode,
+            "decode_gate_ok": decode_ok,
         },
     }))
-    return 0 if (fleet_ok and int8_ok and operate_ok) else 1
+    return 0 if (fleet_ok and int8_ok and operate_ok and decode_ok) else 1
 
 
 if __name__ == "__main__":
