@@ -39,6 +39,15 @@ BASELINE_IMG_S = 109.0  # reference K80 img/s, bs=32
 # falls off the captured path lands under it.
 TRANSFORMER_MFU_FLOOR = 1e-4
 
+# Scaling-efficiency floor for --dist: the pod-partitioned captured
+# step over the GLOBAL mesh must stay within 10% of running the same
+# global batch on a single host's device slice. On the simulated CI pod
+# the virtual devices share one CPU, so ideal strong scaling is flat
+# wall time (same total flops) — the gate catches pod-partitioning
+# overhead (per-host program dispatch, mesh bookkeeping, halo/reshard
+# cost), not raw speedup, which only a real pod can show.
+DIST_SCALING_FLOOR = 0.9
+
 
 def _throughput(trainer, x, y, iters, warmup=2, step=None):
     """Training-step throughput on a device-resident synthetic batch — the
@@ -252,6 +261,90 @@ def main_transformer(capture_mode=True):
     return 0 if ok else 1
 
 
+def main_dist():
+    """Pod scaling-efficiency gate (docs/distributed.md).
+
+    Simulated pod: 4 virtual hosts x 2 chips over 8 forced CPU devices.
+    Strong scaling on a fixed global batch — time the captured
+    transformer step (a) on the GLOBAL pod mesh at dp = hosts*chips and
+    (b) on one host's device slice at dp = chips, and gate
+    ``t_single / t_pod >= DIST_SCALING_FLOOR``. Must run before jax
+    initializes (the virtual-device flag is process-wide).
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import numpy as np
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import capture, gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import transformer as tzoo
+
+    hosts = 4
+    topo = parallel.PodTopology.simulated(hosts)
+    chips = topo.devices_per_host
+    # big enough that per-device program dispatch (~ms on CPU) amortizes
+    # into the compute; tiny batches would measure dispatch, not scaling
+    batch, seqlen = 64, 64
+    rng = np.random.RandomState(0)
+    x = (rng.rand(batch, seqlen) * 64).astype(np.int32)
+    y = (rng.rand(batch, seqlen) * 64).astype(np.int32)
+    iters = 4
+
+    def timed_step(mesh, prefix, pod=None):
+        mx.random.seed(0)
+        net = tzoo.transformer_lm(prefix=prefix)
+        net.initialize(mx.initializer.Xavier())
+        net(mx.nd.zeros((2, 8)))
+        trainer = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            "adam", {"learning_rate": 1e-3}, mesh=mesh,
+            param_rules=parallel.SpecLayout.for_mesh(mesh).param_rules(),
+            batch_axis_name="dp", dtype="bfloat16")
+        if pod is not None:
+            trainer.bind_pod(pod)
+        step = capture.capture(trainer)
+        xd = jax.device_put(x, trainer.batch_sharding)
+        yd = jax.device_put(y, trainer.batch_sharding)
+        step(xd, yd).block_until_ready()  # compile
+        step(xd, yd).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(iters):
+            loss = step(xd, yd)
+        loss.block_until_ready()
+        return time.perf_counter() - t0, float(loss)
+
+    pod_mesh, topo = parallel.pod_mesh({"dp": hosts * chips}, topo)
+    t_pod, loss_pod = timed_step(pod_mesh, "benchpod_", pod=topo)
+    single_devs = [topo.devices[o] for o in topo.host_ordinals(0)]
+    single_mesh = parallel.create_mesh({"dp": chips}, single_devs)
+    t_single, _ = timed_step(single_mesh, "benchsingle_")
+
+    eff = t_single / t_pod if t_pod > 0 else 0.0
+    ok = eff >= DIST_SCALING_FLOOR
+    print(f"# pod={hosts}x{chips} dp={hosts * chips}: "
+          f"t_pod={t_pod * 1e3 / iters:.1f}ms/step "
+          f"t_single(dp={chips})={t_single * 1e3 / iters:.1f}ms/step "
+          f"efficiency={eff:.3f} loss={loss_pod:.4f}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "dist_scaling_efficiency",
+        "value": round(eff, 4),
+        "unit": "fraction_of_linear",
+        "vs_baseline": round(eff / DIST_SCALING_FLOOR, 3),
+        "extra": {"hosts": hosts, "devices_per_host": chips,
+                  "t_pod_ms": round(t_pod * 1e3 / iters, 2),
+                  "t_single_ms": round(t_single * 1e3 / iters, 2),
+                  "floor": DIST_SCALING_FLOOR, "passed": ok},
+    }))
+    return 0 if ok else 1
+
+
 def main_stream():
     """Delegate to the streaming-ingestion gate (tools/stream_bench.py
     owns the workload; this entry point keeps the one-bench front door).
@@ -268,6 +361,8 @@ def main_stream():
 
 
 if __name__ == "__main__":
+    if "--dist" in sys.argv[1:]:
+        sys.exit(main_dist())
     if "--data=stream" in sys.argv[1:]:
         sys.exit(main_stream())
     if "--model=transformer" in sys.argv[1:]:

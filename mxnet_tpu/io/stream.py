@@ -536,6 +536,25 @@ class StreamBatchIter:
         with _LIVE_LOCK:
             _LIVE.add(self)
 
+    @classmethod
+    def for_pod(cls, topology, source, batch_size, decode, **kw):
+        """Per-host partition of the stream for a pod run: host ``h`` of
+        a :class:`~mxnet_tpu.parallel.mesh.PodTopology` reads records
+        ``gid % num_hosts == h`` (the PR-13 strided partition, so a
+        host-count change after elastic shrink re-strides the SAME
+        remainder instead of re-reading consumed records). Pass the
+        result to :meth:`DevicePrefetcher.for_trainer` to overlap the
+        host's decode with its devices' compute."""
+        for name in ("part_index", "num_parts"):
+            if name in kw:
+                raise ValueError(
+                    f"for_pod derives {name} from the topology "
+                    f"(num_hosts={int(topology.num_hosts)}, "
+                    f"this_host={int(topology.this_host)}); don't pass it")
+        return cls(source, batch_size, decode,
+                   part_index=int(topology.this_host),
+                   num_parts=int(topology.num_hosts), **kw)
+
     # ------------------------------------------------------------ geometry
 
     @property

@@ -101,6 +101,79 @@ def _validate_config(coordinator, num_processes, process_id):
             "1..65535 (check DMLC_PS_ROOT_PORT)")
 
 
+def _claim_pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError, TypeError):
+        return False
+    return True
+
+
+def _claim_dir(coordinator):
+    path = os.environ.get("MXNET_TPU_DIST_CLAIM_DIR")
+    if path:
+        return path
+    import hashlib
+    import tempfile
+
+    # one claim namespace per coordinator endpoint, so two unrelated
+    # jobs on the same machine never contest each other's ranks
+    slug = hashlib.sha1(str(coordinator).encode("utf-8")).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(),
+                        f"mxnet_tpu-dist-claims-{slug}")
+
+
+def _claim_rank(coordinator, num_processes, process_id):
+    """Reject duplicate ranks BEFORE the jax.distributed handshake.
+
+    Two workers launched with the same DMLC_WORKER_ID otherwise race
+    inside the coordination service: one wins, the other hangs or aborts
+    with an opaque barrier error long after launch. Each worker claims
+    its rank by creating ``rank-<id>.claim`` (O_EXCL, body = claimant
+    pid) in a per-coordinator directory; a live claim by another process
+    is a structured :class:`DistConfigError` naming both the contested
+    rank and the claimant, while claims whose pid is dead are stale
+    debris from a previous job and are replaced silently. The claim is
+    on-machine only — cross-host duplicates still fail inside jax, but
+    every launcher this repo ships (tools/launch.py) colocates workers,
+    which is exactly where the footgun lives."""
+    directory = _claim_dir(coordinator)
+    path = os.path.join(directory, f"rank-{int(process_id)}.claim")
+    os.makedirs(directory, exist_ok=True)
+    for _ in range(2):  # second pass only after unlinking a stale claim
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        except FileExistsError:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    claimant = fh.read().strip()
+            except OSError:
+                claimant = ""
+            if claimant == str(os.getpid()):
+                return path  # our own earlier claim (retried bootstrap)
+            if claimant and _claim_pid_alive(claimant):
+                raise DistConfigError(
+                    f"DMLC_WORKER_ID={int(process_id)} is already claimed "
+                    f"by live process pid={claimant} for coordinator "
+                    f"{coordinator} (claim file {path}); every worker "
+                    f"needs a distinct rank in 0..{int(num_processes) - 1} "
+                    "— check the launcher's DMLC_WORKER_ID assignments")
+            try:  # stale claim (dead pid / unreadable) — reap and retry
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        try:
+            os.write(fd, str(os.getpid()).encode("ascii"))
+        finally:
+            os.close(fd)
+        return path
+    raise DistConfigError(
+        f"DMLC_WORKER_ID={int(process_id)} claim file {path} is being "
+        "contested faster than stale claims can be reaped; two workers "
+        "are racing for the same rank")
+
+
 def init_distributed(coordinator=None, num_processes=None, process_id=None,
                      timeout=None, max_retries=None, backoff=None):
     """Initialize the jax distributed runtime (idempotent).
@@ -148,6 +221,7 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None,
         if coordinator is None or num_processes is None or process_id is None:
             return False  # not launched as a distributed job
         _validate_config(coordinator, num_processes, process_id)
+        _claim_rank(coordinator, num_processes, process_id)
         if timeout is None:
             timeout = float(os.environ.get("MXNET_TPU_DIST_TIMEOUT", "300"))
         if max_retries is None:
@@ -250,6 +324,14 @@ def _jax_dist_init(jax, coordinator, num_processes, process_id, remaining):
     jax version supports initialization_timeout (older versions fall back
     to jax's internal default — the socket probe above still bounds the
     unreachable-coordinator case)."""
+    try:
+        # CPU hosts run cross-process collectives over Gloo; without
+        # this the CPU backend refuses multiprocess computations
+        # outright. Must land before the backend initializes (it does:
+        # nothing may touch jax before jax.distributed.initialize).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: CPU collectives are implicit or absent
     kwargs = dict(coordinator_address=coordinator,
                   num_processes=num_processes, process_id=process_id)
     try:

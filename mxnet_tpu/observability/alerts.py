@@ -109,6 +109,7 @@ ALERT_RULE_IDS = (
     "numerics_grad_explosion",# in-graph tap: grad norm off median+k*MAD
     "numerics_dead_layer",    # in-graph tap: a layer stopped training
     "decode_ttft_burn",       # decode TTFT SLO-miss burn rate, 2 windows
+    "pod_host_down",          # a pod host's heartbeat/liveness lost
 )
 
 
@@ -573,6 +574,24 @@ def _probe_input_stall(ctx):
     return value, detail
 
 
+def _probe_pod_hosts(ctx):
+    """Dead pod hosts per the watchdog's host-domain liveness tracker.
+    None (no data) until this process configures a pod — a single-host
+    run must never evaluate, let alone fire, a host-down alert. The
+    sticky dead set keeps the incident FIRING until re-admission
+    (``watchdog.configure_pod`` / ``reset_hosts``) resolves it."""
+    watchdog = sys.modules.get("mxnet_tpu.resilience.watchdog")
+    if watchdog is None:
+        return None, None
+    snap = watchdog.pod_snapshot()
+    if not snap.get("configured"):
+        return None, None
+    dead = sorted(snap.get("dead_hosts") or ())
+    return len(dead), {"dead_hosts": dead,
+                       "num_hosts": snap.get("num_hosts"),
+                       "coordinator": snap.get("coordinator")}
+
+
 def _probe_numerics(cond_name):
     """Threshold probe over one in-graph numerics divergence condition
     (``observability.numerics``): the tap evaluates the detector on its
@@ -664,6 +683,12 @@ def _default_rules():
                         "the error budget in both the fast and slow "
                         "window (TTFT over MXNET_TPU_DECODE_TTFT_SLO_MS "
                         "at admission)"),
+        ThresholdRule(
+            "pod_host_down", _probe_pod_hosts, ">=", 1,
+            description="a pod host failure domain is dead: the "
+                        "watchdog's liveness layer (heartbeats, pid "
+                        "checks, stall blame) marked at least one host "
+                        "rank dead; sticky until re-admission"),
     )
 
 

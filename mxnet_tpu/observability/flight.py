@@ -41,7 +41,7 @@ import time
 from . import _STATS
 
 __all__ = ["record", "events", "snapshot", "clear", "set_ring",
-           "ring_size", "last_seq"]
+           "ring_size", "last_seq", "set_host", "host"]
 
 from collections import deque
 
@@ -75,10 +75,31 @@ def ring_size():
         return _RING.maxlen if _RING is not None else 0
 
 
+_HOST = None  # pod host rank stamped onto every event (None = untagged)
+
+
+def set_host(host):
+    """Tag every subsequent event with this process's pod host rank
+    (``watchdog.configure_pod`` calls this), so one pod-wide merge of
+    per-host rings still attributes each event to its failure domain.
+    ``None`` removes the tag; returns the previous value."""
+    global _HOST
+    prev = _HOST
+    _HOST = None if host is None else int(host)
+    return prev
+
+
+def host():
+    """The pod host rank events are currently tagged with, or None."""
+    return _HOST
+
+
 def record(kind, **fields):
     """Append one event. ``fields`` must be flat JSON-serializable
-    values (the crash-report writer stringifies anything else). Returns
-    the event's sequence number, or 0 when the recorder is disabled."""
+    values (the crash-report writer stringifies anything else). Events
+    carry the pod host rank when :func:`set_host` has been called (an
+    explicit ``host=`` field wins). Returns the event's sequence number,
+    or 0 when the recorder is disabled."""
     global _LAST_SEQ
     if _RING is None:
         return 0
@@ -86,6 +107,8 @@ def record(kind, **fields):
              "kind": str(kind)}
     for k, v in fields.items():
         event.setdefault(k, v)  # kind/seq/t/ns are the recorder's own
+    if _HOST is not None:
+        event.setdefault("host", _HOST)  # explicit host= field wins
     with _LOCK:
         # seq is drawn under the SAME lock hold as the append, so ring
         # order always equals seq order and last_seq() is a sound

@@ -49,7 +49,8 @@ __all__ = ["counter", "gauge", "histogram", "get", "registry",
            "snapshot", "sample", "series", "render_prometheus",
            "flush_json", "start_flusher", "stop_flusher", "serve_http",
            "update_slo", "update_decode_slo", "update_input_stall",
-           "update_derived", "slo_counters", "decode_counters",
+           "update_pod", "update_derived", "slo_counters",
+           "decode_counters",
            "note_span", "reset", "Counter", "Gauge", "Histogram"]
 
 _LOCK = threading.Lock()
@@ -467,6 +468,54 @@ def update_input_stall():
     return value
 
 
+# --------------------------------------------------- derived pod gauges
+
+# Pod liveness view, derived from the watchdog's host-domain tracker by
+# update_pod(): ONE aggregated picture of the whole pod on every host's
+# exporter, so the alert engine fires host-down alerts from any
+# survivor even while the dead host's own exporter is gone.
+_POD_HOSTS = gauge(
+    "mxnet_tpu_pod_hosts",
+    "hosts in the pod's current topology (absent when no pod is "
+    "configured)")
+_POD_HOSTS_LIVE = gauge(
+    "mxnet_tpu_pod_hosts_live",
+    "pod hosts not currently marked dead by the watchdog liveness layer")
+_POD_HOST_UP = gauge(
+    "mxnet_tpu_pod_host_up",
+    "1 while the labeled pod host rank is live, 0 once the watchdog "
+    "marks it dead (sticky until re-admission)", labels=("host",))
+
+
+def update_pod():
+    """Refresh the ``mxnet_tpu_pod_*`` gauges from the watchdog's pod
+    snapshot. A process that never configured a pod leaves every pod
+    series absent (a single-host run has no pod, not a pod of one);
+    after an elastic shrink the renumbered topology's host series
+    replace the old ones so cardinality tracks the live pod."""
+    import sys
+
+    watchdog = sys.modules.get("mxnet_tpu.resilience.watchdog")
+    if watchdog is None:
+        return None
+    snap = watchdog.pod_snapshot()
+    if not snap.get("configured"):
+        for h in list(_POD_HOST_UP.labelsets()):
+            _POD_HOST_UP.remove(**dict(h))
+        return None
+    num = int(snap["num_hosts"])
+    dead = set(snap["dead_hosts"])
+    _POD_HOSTS.set(num)
+    _POD_HOSTS_LIVE.set(num - len(dead & set(range(num))))
+    current = {str(h) for h in range(num)}
+    for ls in list(_POD_HOST_UP.labelsets()):
+        if dict(ls).get("host") not in current:
+            _POD_HOST_UP.remove(**dict(ls))
+    for h in range(num):
+        _POD_HOST_UP.set(0.0 if h in dead else 1.0, host=h)
+    return snap
+
+
 def update_derived():
     """Refresh every auto-derived gauge family — fleet SLO, input-stall
     fraction, and the per-executable perf-ledger gauges — in one place,
@@ -479,6 +528,7 @@ def update_derived():
     counters = slo_counters()
     update_slo(counters)
     update_decode_slo()
+    update_pod()
     stall = update_input_stall()
     from . import perf as _perf
 

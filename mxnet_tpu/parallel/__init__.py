@@ -6,7 +6,8 @@ shardings; tp/pp/sp axes — absent in the reference — are exposed here as
 first-class (free on XLA).
 """
 from .mesh import (create_mesh, default_mesh, named_mesh, local_devices,
-                   AXES, shard_map)
+                   AXES, shard_map, PodTopology, pod_mesh,
+                   shrink_mesh_hosts)
 from .functional import functional_call, param_arrays, aux_arrays
 from .layout import SpecLayout
 from .trainer import ShardedTrainer, make_update_fn

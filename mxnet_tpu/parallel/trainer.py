@@ -84,6 +84,10 @@ class ShardedTrainer:
         # one, a dead peer stays terminal (enable_recovery attaches late)
         self._ckpt_mgr = checkpoint_manager
         self.last_recovery = None
+        # pod topology (parallel.mesh.PodTopology): set by bind_pod/
+        # for_pod when the mesh spans host failure domains; None means
+        # rank-level elastic recovery only
+        self._pod = None
         self._bind_mesh(mesh if mesh is not None else create_mesh())
         self._place()
         # elastic execution state (resilience.elastic): current sticky
@@ -296,6 +300,11 @@ class ShardedTrainer:
                             type(self.loss_fn).__name__),
             "mesh": {str(a): int(s) for a, s in
                      zip(self.mesh.axis_names, self.mesh.devices.shape)},
+            # host grouping changes the collective layout over a pod
+            # (same axis sizes, different failure domains / ICI order)
+            "pod": None if self._pod is None else
+                   (int(self._pod.num_hosts),
+                    int(self._pod.devices_per_host)),
             "rules": [(p.pattern, str(s)) for p, s in self._rules],
             "dtype": self._compute_dtype,
             "batch_axis": self._batch_axis,
@@ -420,6 +429,47 @@ class ShardedTrainer:
         mesh = create_mesh(axes, devs)
         return cls(net, loss_fn, optimizer, optimizer_params, mesh=mesh,
                    **kwargs)
+
+    @classmethod
+    def for_pod(cls, net, loss_fn, optimizer="sgd", optimizer_params=None,
+                axes=None, coordinator=None, num_processes=None,
+                process_id=None, topology=None, **kwargs):
+        """Build a trainer over a pod with HOST-level failure domains
+        (docs/distributed.md). Like ``for_multihost`` — jax.distributed
+        bootstraps from args or the DMLC_* env protocol when the job is
+        multi-process — but the mesh device order is host-major
+        (``parallel.mesh.pod_mesh``), the watchdog's pod liveness layer
+        is configured with this process's place in it, and a lost host
+        recovers by excising its WHOLE device slice in one pod-wide
+        mesh shrink. A single process partitions its local devices into
+        ``MXNET_TPU_POD_HOSTS`` simulated host groups instead, so the
+        same recovery logic runs in-process (CI's simulated pod)."""
+        from ..kvstore.dist import init_distributed
+        from .mesh import pod_mesh
+
+        init_distributed(coordinator, num_processes, process_id)
+        mesh, topo = pod_mesh(axes, topology=topology)
+        trainer = cls(net, loss_fn, optimizer, optimizer_params,
+                      mesh=mesh, **kwargs)
+        return trainer.bind_pod(topo)
+
+    def bind_pod(self, topology):
+        """Attach a ``parallel.mesh.PodTopology``: folds the host
+        grouping into the capture fingerprint, enables host-domain
+        recovery in ``step``, and declares this process's place to the
+        watchdog's pod liveness layer (heartbeats + dead-host
+        detection). Returns self for chaining."""
+        from ..resilience import watchdog as _watchdog
+
+        self._pod = topology
+        if topology is not None:
+            _watchdog.configure_pod(topology.num_hosts, topology.this_host)
+        return self
+
+    @property
+    def pod(self):
+        """The bound PodTopology, or None off-pod."""
+        return self._pod
 
     def set_learning_rate(self, lr):
         """Change the learning rate (gluon Trainer.set_learning_rate
@@ -608,6 +658,10 @@ class ShardedTrainer:
                     _watchdog.check_peers(
                         detail="parallel.ShardedTrainer.step")
                     _faults.maybe_hang("hang_step")
+                    # a pod host wedged (not crashed) at the collective
+                    # entry: the stall converts to a dead-host verdict
+                    # via the watchdog's pod liveness layer
+                    _faults.maybe_hang("host_hang_collective")
                     _faults.maybe_oom_step()
                     with _obs_trace.span("sharded.execute",
                                          microbatches=n):
@@ -632,8 +686,12 @@ class ShardedTrainer:
                 # checkpoint manager attached the run survives it: shrink
                 # the mesh to the survivors, reload the latest
                 # reshardable checkpoint onto it, and re-run this batch
-                if self._ckpt_mgr is None or self._multiproc \
-                        or not _elastic.mesh_shrink_enabled():
+                if self._ckpt_mgr is None \
+                        or not _elastic.mesh_shrink_enabled() \
+                        or (self._multiproc and self._pod is None):
+                    # multi-process recovery needs host failure domains
+                    # (bind_pod/for_pod): without the pod topology there
+                    # is no survivable shrink of a global mesh
                     raise
                 x, y = self._recover_peer_loss(e, x, y)
                 if length is not None:
@@ -701,6 +759,24 @@ class ShardedTrainer:
                     "(resilience.CheckpointManager.restore_latest)"
                 ) from cause
 
+    @staticmethod
+    def _host_local_batch(arr):
+        """A batch operand safe to re-place on a shrunk mesh. On a real
+        pod the assembled global batch is NOT fully addressable and
+        jax cannot reshard it onto the survivors' smaller mesh — fall
+        back to this host's own rows (its addressable shards, in batch
+        order), which is exactly what this process fed ``step``."""
+        import jax
+
+        if not isinstance(arr, jax.Array) or arr.is_fully_addressable:
+            return arr
+        import numpy as np
+
+        shards = {tuple(sl.start or 0 for sl in s.index):
+                  np.asarray(s.data) for s in arr.addressable_shards}
+        return np.concatenate(
+            [shards[k] for k in sorted(shards)], axis=0)
+
     def _recover_peer_loss(self, err, x, y):
         """Mesh-shrink resume: rebuild a smaller mesh from the surviving
         ranks, reload the latest (reshardable, v2) checkpoint onto it,
@@ -720,6 +796,11 @@ class ShardedTrainer:
         from ..resilience import watchdog as _watchdog
         from .mesh import MeshShrinkError, shrink_mesh
 
+        if self._pod is not None:
+            hosts = (list(getattr(err, "hosts", ()) or ())
+                     or _watchdog.dead_hosts())
+            if hosts:
+                return self._recover_host_loss(err, x, y, hosts)
         dead = _watchdog.dead_peers() or list(getattr(err, "ranks", ()))
         old_axes = {str(a): int(s) for a, s in
                     zip(self.mesh.axis_names, self.mesh.devices.shape)}
@@ -759,6 +840,82 @@ class ShardedTrainer:
             "this step re-runs on the survivors (capacity is reduced — "
             "see the crash report)")
         bs = self._batch_sharding
+        x, y = self._host_local_batch(x), self._host_local_batch(y)
+        return jax.device_put(x, bs), jax.device_put(y, bs)
+
+    def _recover_host_loss(self, err, x, y, hosts):
+        """Host-domain mesh-shrink resume (docs/distributed.md): the
+        whole failure domain — every device rank of the dead host(s) —
+        leaves the mesh in ONE shrink. The coordinated restart:
+        survivors barrier (so nobody restores against a checkpoint a
+        faster peer is about to supersede), the global mesh is rebuilt
+        host-major from the surviving hosts (renumbered 0..k-1), the
+        watchdog pod layer is re-declared for the smaller pod at the
+        next generation, and the latest reshardable v2 checkpoint is
+        reloaded onto the shrunk topology. Raises when no host-aligned
+        shrink exists or no valid checkpoint survives — then the loss
+        was genuinely terminal."""
+        import math
+        import warnings
+
+        import jax
+
+        from ..resilience import elastic as _elastic
+        from ..resilience import watchdog as _watchdog
+        from .mesh import MeshShrinkError, shrink_mesh_hosts
+
+        hosts = sorted({int(h) for h in hosts})
+        old_axes = {str(a): int(s) for a, s in
+                    zip(self.mesh.axis_names, self.mesh.devices.shape)}
+        try:
+            _watchdog.pod_barrier()
+        except _watchdog.PeerLostError as late:
+            # a survivor died before making the barrier: fold it into
+            # this recovery instead of recovering twice
+            hosts = sorted(set(hosts) | set(getattr(late, "hosts", ())))
+        try:
+            new_mesh, new_topo, kept = shrink_mesh_hosts(
+                self.mesh, hosts, self._pod,
+                batch_axis=self._batch_axis)
+        except MeshShrinkError:
+            raise err  # no host-aligned smaller mesh: genuinely terminal
+        batch_names = self._batch_axis_names()
+        old_dp = math.prod(int(old_axes.get(a, 1)) for a in batch_names)
+        new_axes = {str(a): int(s) for a, s in
+                    zip(new_mesh.axis_names, new_mesh.devices.shape)}
+        new_dp = math.prod(int(new_axes.get(a, 1)) for a in batch_names)
+        gen = (_watchdog.pod_info() or {}).get("generation", 0) + 1
+        self._bind_mesh(new_mesh)
+        self._pod = new_topo
+        if getattr(self._ckpt_mgr, "_pod", None) is not None:
+            # the manager's distributed commit must follow the shrunk,
+            # renumbered topology too
+            self._ckpt_mgr.bind_pod(new_topo)
+        # the dead generation's bookkeeping must not leak into the
+        # renumbered pod: fresh peer set, fresh host registry/heartbeats
+        _watchdog.reset_peers()
+        _watchdog.configure_pod(new_topo.num_hosts, new_topo.this_host,
+                                generation=gen)
+        manifest = self._ckpt_mgr.restore_latest(trainer=self)
+        if manifest is None:
+            raise RuntimeError(
+                f"pod host(s) {hosts} lost and no valid checkpoint "
+                f"exists to reload onto the shrunk {new_dp}-shard mesh; "
+                "cannot recover") from err
+        self._elastic_n = _elastic.rearm_microbatches(
+            self._elastic_n, old_dp, new_dp)
+        _elastic._STATS["elastic_mesh_shrinks"] += 1
+        _watchdog.note_peer_recovery(err, manifest, old_axes, new_axes)
+        self.last_recovery = manifest
+        axis_label = "x".join(batch_names)
+        warnings.warn(
+            f"pod host(s) {hosts} lost: resumed from checkpoint step "
+            f"{manifest.get('step')} on a pod shrunk to host(s) "
+            f"{list(kept)} ({old_dp} -> {new_dp} '{axis_label}' "
+            "shard(s)); this step re-runs on the survivors (capacity is "
+            "reduced — see the crash report)")
+        bs = self._batch_sharding
+        x, y = self._host_local_batch(x), self._host_local_batch(y)
         return jax.device_put(x, bs), jax.device_put(y, bs)
 
     def _build_elastic(self):

@@ -63,9 +63,24 @@ sync save cost at 25M params.
 Works with both trainer flavors: the eager ``gluon.Trainer`` (via its
 states-bytes API) and the pjit-ed ``parallel.ShardedTrainer``
 (params/aux/opt_state re-placed onto the mesh with the trainer's own
-NamedShardings on restore). Multi-host note: the manager is a
-per-process writer; on a multi-process mesh have rank 0 save
-(replicated state) or point each rank at its own directory.
+NamedShardings on restore).
+
+On a pod (``CheckpointManager(..., pod=PodTopology)``), a save is a
+**distributed commit** (docs/distributed.md): every host writes ONLY
+the shards it owns — owner = the host of the lowest host-major device
+holding that shard index, a global rule every process computes
+identically, so replicated state is written exactly once pod-wide —
+into one shared ``.{tag}.tmp.pod`` temp dir, then its per-host commit
+marker; host 0 merges the markers into the manifest after a
+shard-complete barrier and publishes with the same single-rename. A
+partial-pod crash (the ``ckpt_partial_pod`` fault) therefore leaves
+either a fully restorable checkpoint or clean temp debris for the
+staleness GC — never a torn manifest. The single-process simulated pod
+plays each host's part in host order, so the identical protocol runs
+in tier-1 CI. Retention additionally never reclaims a manifest-absent
+checkpoint dir until it has been quiet past
+``MXNET_TPU_CKPT_ORPHAN_GRACE_S`` — another host may still be writing
+shards into it.
 """
 from __future__ import annotations
 
@@ -76,6 +91,7 @@ import os
 import re
 import shutil
 import threading
+import time
 import zlib
 
 import numpy as _np
@@ -90,12 +106,15 @@ _MANIFEST = "manifest.json"
 _PARAMS = "params.npz"      # v1 payload name (read-side compatibility)
 _TRAINER = "trainer.state"
 _ARRAYS_DIR = "arrays"
+_COMMIT_DIR = "commit"      # per-host markers of a pod distributed commit
 _FORMAT_VERSION = 2
 
 _STATS = {"ckpt_saves": 0, "ckpt_save_failures": 0, "ckpt_restores": 0,
           "ckpt_restore_skipped": 0, "ckpt_pruned": 0,
+          "ckpt_prune_deferred": 0,
           "ckpt_async_saves": 0, "ckpt_async_waits": 0,
-          "ckpt_async_failures": 0}
+          "ckpt_async_failures": 0,
+          "ckpt_pod_commits": 0, "ckpt_pod_commit_failures": 0}
 
 # Managers with a possibly-in-flight async writer. A daemon writer
 # thread would be killed mid-write by normal interpreter exit, silently
@@ -177,6 +196,32 @@ def _pid_alive(pid):
     except PermissionError:
         return True
     return True
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else float(default)
+    except ValueError:
+        return float(default)
+
+
+def _newest_mtime(path):
+    """Most recent mtime anywhere under ``path`` (the "is anyone still
+    writing into this?" probe for shared pod-commit dirs)."""
+    newest = 0.0
+    try:
+        newest = os.stat(path).st_mtime
+    except OSError:
+        pass
+    for root, _dirs, files in os.walk(path):
+        for n in files:
+            try:
+                newest = max(newest,
+                             os.stat(os.path.join(root, n)).st_mtime)
+            except OSError:
+                pass
+    return newest
 
 
 def _fsync_dir(path):
@@ -320,6 +365,37 @@ def _unique_shards(value, copy=True):
     return [(_full_index(arr.shape), arr)]
 
 
+def _pod_owned_shards(value, pod, copy=True):
+    """[(index, host-array, owner_host)] — ``_unique_shards`` with each
+    shard attributed to the pod host that OWNS (and therefore writes)
+    it in a distributed commit: the host of the lowest host-major
+    device holding that shard index, computed from the GLOBAL
+    device→index map so every process agrees and a replicated array is
+    written exactly once pod-wide. Shards whose owner cannot be
+    resolved (plain host values) default to host 0."""
+    owner_of = {}
+    sharding = getattr(value, "sharding", None)
+    if sharding is not None and hasattr(sharding, "devices_indices_map"):
+        try:
+            dmap = sharding.devices_indices_map(
+                tuple(int(d) for d in value.shape))
+        except Exception:
+            dmap = {}
+        for dev, idx in dmap.items():
+            key = _norm_index(idx, value.shape)
+            try:
+                cand = (int(pod.host_of_device(dev)),
+                        int(getattr(dev, "id", 0)))
+            except Exception:
+                continue
+            cur = owner_of.get(key)
+            if cur is None or cand < cur:
+                owner_of[key] = cand
+    return [(index, arr,
+             owner_of.get(index, (0, 0))[0])
+            for index, arr in _unique_shards(value, copy=copy)]
+
+
 def _async_mode():
     """Resolve the async writer mode (``MXNET_TPU_CKPT_ASYNC_MODE``:
     ``fork`` | ``thread`` | ``auto``). Auto picks fork exactly where it
@@ -355,9 +431,16 @@ class CheckpointManager:
         Checkpoints pinned by an active restore or an in-flight async
         publish are never pruned.
     prefix : str — checkpoint directory name prefix.
+    pod : parallel.mesh.PodTopology, optional — arms the distributed
+        commit: saves become the shared-dir shard-ownership protocol
+        described in the module docstring (every host its own shards,
+        host 0 publishes after the marker barrier). ``bind_pod``
+        attaches it after construction; a 1-host pod degrades to the
+        ordinary single-writer path.
     """
 
-    def __init__(self, directory, keep_n=None, prefix="ckpt"):
+    def __init__(self, directory, keep_n=None, prefix="ckpt", pod=None):
+        self._pod = pod
         self.directory = os.fspath(directory)
         if keep_n is None:
             keep_n = int(os.environ.get("MXNET_TPU_CKPT_KEEP", "5"))
@@ -371,6 +454,13 @@ class CheckpointManager:
                 self._gc_debris()    # startup GC: orphaned (a)sync temp
             except OSError:          # dirs from a previous dead process
                 pass
+
+    def bind_pod(self, pod):
+        """Attach (or with None, detach) the PodTopology the distributed
+        commit writes against — a mesh shrink re-binds the shrunk,
+        renumbered topology here. Returns self for chaining."""
+        self._pod = pod
+        return self
 
     # ------------------------------------------------------------- listing
 
@@ -534,6 +624,23 @@ class CheckpointManager:
         # describe the stream position at the moment of the save, not
         # wherever an async writer later gets around to looking
         data_state = None if data_iter is None else dict(data_iter.state())
+        pod = self._pod
+        if pod is not None and int(pod.num_hosts) > 1 \
+                and _is_sharded_trainer(trainer):
+            if async_:
+                raise ValueError(
+                    "a pod distributed commit is synchronous: the "
+                    "shard-complete barrier IS the save (async_=True "
+                    "is unsupported with a bound pod)")
+            snap = self._snapshot(step, net, trainer, epoch, extra, tag,
+                                  copy=False, data_state=data_state,
+                                  pod=pod)
+            with _obs_trace.span("ckpt.save_pod", step=int(step),
+                                 mode="pod"):
+                path = self._write_snapshot_pod(snap, tag, final)
+            _obs_flight.record("ckpt", op="save", step=int(step), tag=tag,
+                               pod_hosts=int(pod.num_hosts))
+            return path
         if not async_:
             # a synchronous save completes before the caller can run
             # another (donating) step, so zero-copy views are safe —
@@ -691,17 +798,22 @@ class CheckpointManager:
             info["error"] = e
 
     def _snapshot(self, step, net, trainer, epoch, extra, tag, copy=True,
-                  data_state=None):
+                  data_state=None, pod=None):
         """Host-side snapshot of everything the checkpoint will persist
         — after this returns, the writer never touches device state, so
         an async publish is isolated from subsequent (donating) steps.
         ``copy=False`` (fork mode) takes zero-copy views instead of
-        owned copies; the fork's COW provides the isolation."""
+        owned copies; the fork's COW provides the isolation. With
+        ``pod``, each shard additionally carries its owning host
+        (3-tuples consumed only by ``_write_snapshot_pod``)."""
         kind = "sharded" if _is_sharded_trainer(trainer) else "gluon"
         arrays = []  # [(key, dtype_str, shape, spec_json, [(index, np)])]
 
         def add(key, value, sharding=None):
-            shards = _unique_shards(value, copy=copy)
+            if pod is not None:
+                shards = _pod_owned_shards(value, pod, copy=copy)
+            else:
+                shards = _unique_shards(value, copy=copy)
             first = shards[0][1]
             arrays.append((key, _np.dtype(first.dtype).str,
                            tuple(int(d) for d in _np.shape(value)),
@@ -816,6 +928,156 @@ class CheckpointManager:
             raise
         return final
 
+    def _write_snapshot_pod(self, snap, tag, final):
+        """Distributed-commit writer (docs/distributed.md): every host
+        writes ONLY the shards it owns into ONE shared temp dir, then
+        its per-host commit marker; host 0 publishes the manifest after
+        a shard-complete barrier over the markers. A partial-pod crash
+        (the ``ckpt_partial_pod`` fault fires after a host's shards but
+        before its marker) leaves either a fully restorable checkpoint
+        or clean temp debris for the staleness GC — never a torn
+        manifest. The single-process simulated pod plays each host's
+        part in host order, so the identical protocol (crash point
+        included) runs in tier-1 CI."""
+        pod = self._pod
+        simulated = bool(getattr(pod, "simulated", True))
+        this_host = int(pod.this_host)
+        tmpdir = os.path.join(self.directory, f".{tag}.tmp.pod")
+        if simulated and os.path.isdir(tmpdir):
+            # single process owns the whole commit: a crashed previous
+            # attempt's debris must not leak stale markers into this one
+            shutil.rmtree(tmpdir)
+        commit_dir = os.path.join(tmpdir, _COMMIT_DIR)
+        os.makedirs(os.path.join(tmpdir, _ARRAYS_DIR), exist_ok=True)
+        os.makedirs(commit_dir, exist_ok=True)
+
+        def write_host(h):
+            meta = {}
+            for i, (key, dtype, shape, spec, shards) in \
+                    enumerate(snap["arrays"]):
+                recs = []
+                for j, (index, arr, owner) in enumerate(shards):
+                    if owner != h:
+                        continue
+                    fname = f"{_ARRAYS_DIR}/{i:05d}-h{h:03d}-{j:03d}.bin"
+                    data = _np.ascontiguousarray(arr).tobytes()
+                    atomic_write_bytes(os.path.join(tmpdir, fname), data)
+                    recs.append({"file": fname,
+                                 "index": [[a, b] for a, b in index],
+                                 "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                                 "size": len(data)})
+                meta[key] = {"shape": list(shape), "dtype": dtype,
+                             "spec": spec, "shards": recs}
+            # the partial-pod kill lands HERE: shards durable, marker
+            # absent — the barrier can never count this host complete
+            faults.maybe_crash("ckpt_partial_pod")
+            atomic_write_bytes(
+                os.path.join(commit_dir, f"host-{h:03d}.json"),
+                json.dumps({"host": h, "arrays": meta}, indent=1).encode())
+
+        try:
+            for h in (range(int(pod.num_hosts)) if simulated
+                      else (this_host,)):
+                write_host(h)
+            if this_host == 0:
+                merged = self._await_pod_markers(commit_dir, pod)
+                # consumed markers must not ride into the published dir
+                shutil.rmtree(commit_dir, ignore_errors=True)
+                manifest = dict(snap["manifest"])
+                manifest["arrays"] = merged
+                manifest["files"] = {}
+                manifest["pod"] = {
+                    "num_hosts": int(pod.num_hosts),
+                    "devices_per_host": int(pod.devices_per_host)}
+                atomic_write_bytes(os.path.join(tmpdir, _MANIFEST),
+                                   json.dumps(manifest, indent=1).encode())
+                old = None
+                if os.path.isdir(final):
+                    old = os.path.join(self.directory,
+                                       f".{tag}.old.{os.getpid()}")
+                    if os.path.isdir(old):
+                        shutil.rmtree(old)
+                    os.replace(final, old)
+                with self._pin(final):
+                    os.replace(tmpdir, final)
+                    _fsync_dir(self.directory)
+                    if old is not None:
+                        shutil.rmtree(old, ignore_errors=True)
+                    _STATS["ckpt_saves"] += 1
+                    _STATS["ckpt_pod_commits"] += 1
+                    self._prune()
+            else:
+                # non-publishing hosts leave the barrier only when the
+                # commit is visible — save() is a pod-wide barrier
+                self._await_pod_publish(final)
+        except faults.SimulatedCrash:
+            _STATS["ckpt_save_failures"] += 1
+            _STATS["ckpt_pod_commit_failures"] += 1
+            raise  # leave the shared debris, like a real host kill
+        except BaseException:
+            _STATS["ckpt_save_failures"] += 1
+            _STATS["ckpt_pod_commit_failures"] += 1
+            if simulated:
+                shutil.rmtree(tmpdir, ignore_errors=True)
+            # real pods never rmtree here: peers may still be writing
+            # into the shared dir — the staleness GC reclaims it
+            raise
+        return final
+
+    def _await_pod_markers(self, commit_dir, pod):
+        """Host 0's shard-complete barrier: wait for every host's commit
+        marker (``MXNET_TPU_CKPT_COMMIT_TIMEOUT_S``, default 120s), then
+        merge the per-host shard records into one manifest ``arrays``
+        section (shape/dtype disagreement between markers is corruption,
+        not a merge)."""
+        timeout = _env_float("MXNET_TPU_CKPT_COMMIT_TIMEOUT_S", 120.0)
+        deadline = time.monotonic() + timeout
+        want = [f"host-{h:03d}.json" for h in range(int(pod.num_hosts))]
+        while True:
+            missing = [w for w in want
+                       if not os.path.isfile(os.path.join(commit_dir, w))]
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pod commit barrier timed out after {timeout:g}s "
+                    f"waiting for marker(s) {missing} — the manifest is "
+                    "NOT published; previous checkpoints are intact")
+            time.sleep(0.05)
+        merged = {}
+        for w in want:
+            with open(os.path.join(commit_dir, w)) as f:
+                marker = json.load(f)
+            for key, meta in marker["arrays"].items():
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = {"shape": meta["shape"],
+                                   "dtype": meta["dtype"],
+                                   "spec": meta["spec"],
+                                   "shards": list(meta["shards"])}
+                elif (cur["shape"] != meta["shape"]
+                      or cur["dtype"] != meta["dtype"]):
+                    raise CheckpointCorruptError(
+                        f"pod commit markers disagree on '{key}': "
+                        f"{cur['shape']}/{cur['dtype']} vs "
+                        f"{meta['shape']}/{meta['dtype']}")
+                else:
+                    cur["shards"].extend(meta["shards"])
+        return merged
+
+    def _await_pod_publish(self, final):
+        """A non-publishing host's side of the commit barrier: wait for
+        host 0's manifest to become visible (same timeout knob)."""
+        timeout = _env_float("MXNET_TPU_CKPT_COMMIT_TIMEOUT_S", 120.0)
+        deadline = time.monotonic() + timeout
+        manifest = os.path.join(final, _MANIFEST)
+        while not os.path.isfile(manifest):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pod commit publish of {final} not visible after "
+                    f"{timeout:g}s — host 0 lost mid-commit?")
+            time.sleep(0.05)
+
     def _gc_debris(self):
         """Clean up after dead writers: remove stale ``.{tag}.tmp.{pid}``
         dirs (a kill mid-save — sync or async) and handle
@@ -824,15 +1086,25 @@ class CheckpointManager:
         so it is renamed back; otherwise it is deleted. Live pids
         (concurrent writers into the same directory) are left alone."""
         pat = re.compile(
-            rf"^\.({re.escape(self.prefix)}-\d+)\.(tmp|old)\.(\d+)$")
+            rf"^\.({re.escape(self.prefix)}-\d+)\.(tmp|old)\.(\d+|pod)$")
         for name in os.listdir(self.directory):
             m = pat.match(name)
             if not m:
                 continue
-            tag, kind, pid = m.group(1), m.group(2), int(m.group(3))
+            tag, kind, owner = m.group(1), m.group(2), m.group(3)
+            path = os.path.join(self.directory, name)
+            if owner == "pod":
+                # a shared pod-commit dir has no single owner pid: reap
+                # only once every writer has plausibly stopped (quiet
+                # past the orphan grace) — the exact debris a
+                # partial-pod crash leaves behind
+                grace = _env_float("MXNET_TPU_CKPT_ORPHAN_GRACE_S", 900.0)
+                if _newest_mtime(path) + grace < time.time():
+                    shutil.rmtree(path, ignore_errors=True)
+                continue
+            pid = int(owner)
             if pid == os.getpid() or _pid_alive(pid):
                 continue
-            path = os.path.join(self.directory, name)
             final = os.path.join(self.directory, tag)
             if kind == "old" and not os.path.isdir(final):
                 os.replace(path, final)  # resurrect the moved-aside step
@@ -849,6 +1121,17 @@ class CheckpointManager:
         for _, path in ckpts[:max(0, len(ckpts) - self.keep_n)]:
             if path in pinned:
                 continue  # held open by a restore or async publish
+            if not os.path.isfile(os.path.join(path, _MANIFEST)) \
+                    and not os.path.isfile(os.path.join(path, _PARAMS)):
+                # manifest-absent and not a v1 checkpoint: another host
+                # (a peer manager, external tooling) may still be
+                # writing shards into it — retention must never race a
+                # live writer. Defer until it has been quiet past the
+                # orphan grace; then it is debris, not a checkpoint.
+                grace = _env_float("MXNET_TPU_CKPT_ORPHAN_GRACE_S", 900.0)
+                if _newest_mtime(path) + grace >= time.time():
+                    _STATS["ckpt_prune_deferred"] += 1
+                    continue
             shutil.rmtree(path, ignore_errors=True)
             _STATS["ckpt_pruned"] += 1
             removed += 1

@@ -62,6 +62,26 @@ Supported fault kinds (the hook that honours each is noted):
                                   collective raises PeerLostError (rank
                                   from ``MXNET_TPU_FAULT_PEER_RANK``,
                                   default 1)
+- ``host_death``                — declare an entire pod host dead so the
+                                  next step's host check raises
+                                  PeerLostError naming the host (host
+                                  from ``MXNET_TPU_FAULT_HOST_RANK``,
+                                  default 1; all its device ranks are
+                                  excised in one mesh shrink)
+- ``host_hang_collective``      — wedge the captured step's collective
+                                  entry on one host in an interruptible
+                                  sleep; the pod watchdog must convert
+                                  the stall into a dead-host verdict
+- ``coordinator_loss``          — declare the coordinator host (lowest
+                                  live host rank) dead; survivors must
+                                  promote the next live host and shrink
+- ``ckpt_partial_pod``          — SimulatedCrash inside the distributed
+                                  checkpoint commit after this host's
+                                  shards are written but before the
+                                  shard-complete barrier publishes the
+                                  manifest (``CheckpointManager`` pod
+                                  path; must leave clean debris, never a
+                                  torn manifest)
 - ``replica_crash``             — one serving-fleet replica dies mid-batch
                                   (thread replicas fail the batch with
                                   ``ReplicaCrash``; subprocess replicas
@@ -177,6 +197,7 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_nonfinite_grad",
            "maybe_crash", "maybe_dist_connect_fault", "maybe_nan_batch",
            "maybe_hang", "maybe_oom_step", "maybe_peer_death",
+           "maybe_host_death", "maybe_coordinator_loss",
            "maybe_replica_crash", "maybe_replica_hang",
            "maybe_replica_nan_storm", "maybe_calib_table_drift",
            "maybe_perf_regression", "maybe_slo_burn",
@@ -674,6 +695,31 @@ def maybe_peer_death():
     if fault is not None and fault.should_fire():
         return int(os.environ.get("MXNET_TPU_FAULT_PEER_RANK", "1"))
     return None
+
+
+def maybe_host_death():
+    """When ``host_death`` fires, return the pod host rank to declare
+    dead (``MXNET_TPU_FAULT_HOST_RANK``, default 1); else None. The
+    watchdog's host check marks every rank of that host dead and raises
+    PeerLostError naming the host, so recovery excises the whole host's
+    device slice in one mesh shrink."""
+    if not _ACTIVE:
+        return None
+    fault = _ACTIVE.get("host_death")
+    if fault is not None and fault.should_fire():
+        return int(os.environ.get("MXNET_TPU_FAULT_HOST_RANK", "1"))
+    return None
+
+
+def maybe_coordinator_loss():
+    """When ``coordinator_loss`` fires, return True once; else False.
+    The watchdog's host check treats it as the death of the current
+    coordinator (lowest live host rank), so survivors must promote the
+    next live host and shrink the pod around the loss."""
+    if not _ACTIVE:
+        return False
+    fault = _ACTIVE.get("coordinator_loss")
+    return fault is not None and fault.should_fire()
 
 
 def maybe_rollout_bad_weights(params):

@@ -38,6 +38,20 @@ that PeerLostError and *survives* it — smaller mesh, reshardable
 checkpoint reload, ``note_peer_recovery`` crash-report amendment —
 instead of dying (docs/resilience.md, "mesh-shrink resume").
 
+On a pod the failure domain is the **host**, not the rank: one dead
+process takes all of its device ranks with it. ``configure_pod``
+declares this process's place in the pod; the host registry then
+tracks liveness per host (``mark_host_dead`` / ``dead_hosts``, sticky
+until ``reset_hosts`` re-admission), publishes heartbeats (``host-<h>.hb``
+files with the writer pid in ``MXNET_TPU_HEARTBEAT_DIR`` for a real
+multi-process pod; in-memory for the single-process simulated pod),
+and detects peer-host death *before* entering a collective — a
+pid-dead or stale heartbeat (``MXNET_TPU_HOST_HEARTBEAT_TIMEOUT``)
+raises PeerLostError with ``.hosts`` naming the failure domain, which
+the trainer's host-level recovery excises in one pod-wide mesh shrink.
+A stall that fires while the liveness layer can blame a host is
+likewise converted to a dead-host verdict (docs/distributed.md).
+
 The async raise lands at a Python bytecode boundary, so it interrupts
 Python-level waits (locks, short sleeps, retry loops) but not a thread
 parked inside one C call; the crash report is written either way, which
@@ -66,7 +80,10 @@ from . import faults as _faults
 __all__ = ["StallError", "PeerLostError", "guard", "collective_guard",
            "check_peers", "timeout_for", "crash_dir", "note_step",
            "note_rollback", "note_peer_recovery", "mark_peer_dead",
-           "dead_peers", "reset_peers", "stats", "reset_stats", "PHASES"]
+           "dead_peers", "reset_peers", "stats", "reset_stats", "PHASES",
+           "configure_pod", "pod_info", "pod_snapshot", "reset_pod",
+           "mark_host_dead", "dead_hosts", "reset_hosts", "heartbeat",
+           "check_hosts", "coordinator", "pod_barrier"]
 
 PHASES = ("step", "collective", "batch", "probe")
 
@@ -77,6 +94,7 @@ _STATS = {
     "watchdog_rollbacks": 0,      # stalls recovered via checkpoint rollback
     "watchdog_peer_lost": 0,      # ranks declared dead
     "watchdog_peer_recoveries": 0,  # peer losses survived by mesh shrink
+    "watchdog_host_lost": 0,      # pod hosts declared dead
 }
 
 
@@ -122,9 +140,13 @@ class StallError(RuntimeError):
 
 class PeerLostError(StallError):
     """A collective lost a peer: the named rank(s) are dead, so the
-    operation would have blocked forever. ``ranks`` lists them."""
+    operation would have blocked forever. ``ranks`` lists dead worker
+    ranks; ``hosts`` lists dead pod hosts when the loss is a whole
+    failure domain (host-level recovery excises every one of that
+    host's device ranks in a single mesh shrink)."""
 
     ranks = ()
+    hosts = ()
 
 
 # ---------------------------------------------------------------------- peers
@@ -180,6 +202,337 @@ def _peer_lost_error(ranks, detail, stalled=None):
     err.ranks = ranks
     err.timeout = stalled
     return err
+
+
+# ------------------------------------------------------------------------ pod
+
+# Host-level failure domains (docs/distributed.md). A "host" is one
+# failure domain of the pod: one process in a real multi-host job, one
+# contiguous group of virtual devices in the single-process simulated
+# pod. _POD is this process's declared place in it; the dead-host set
+# is sticky until reset_hosts() re-admits a host (or configure_pod
+# re-declares the topology after a shrink renumbers the survivors).
+
+_POD = None          # {"num_hosts", "this_host", "heartbeat_dir"} or None
+_DEAD_HOSTS: set = set()
+_HB_SEEN: dict = {}  # host -> monotonic beat time (simulated pods)
+_BARRIER_SEQ = itertools.count(1)
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except (OSError, ValueError, TypeError):
+        return False
+    return True
+
+
+def configure_pod(num_hosts, this_host, heartbeat_dir=None, generation=0):
+    """Declare this process's place in the pod and reset host-liveness
+    bookkeeping to match (the re-admission point: a recovery that
+    shrinks and renumbers the pod re-declares it here, bumping
+    ``generation`` so the smaller pod's heartbeat files never collide
+    with the dead generation's debris in the shared dir). With no
+    ``heartbeat_dir`` (and ``MXNET_TPU_HEARTBEAT_DIR`` unset) the pod
+    is the in-memory simulated kind; a real multi-process pod names a
+    shared directory and peers detect each other's death through the
+    heartbeat files in it. Tags every flight event with the host rank
+    and publishes the first beat. Returns the pod info dict."""
+    global _POD
+    num_hosts = int(num_hosts)
+    this_host = int(this_host)
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    if not 0 <= this_host < num_hosts:
+        raise ValueError(
+            f"this_host={this_host} out of range for {num_hosts} host(s)")
+    if heartbeat_dir is None:
+        heartbeat_dir = (os.environ.get("MXNET_TPU_HEARTBEAT_DIR", "")
+                         .strip() or None)
+    with _PEER_LOCK:
+        _POD = {"num_hosts": num_hosts, "this_host": this_host,
+                "heartbeat_dir": heartbeat_dir,
+                "generation": int(generation)}
+        _DEAD_HOSTS.clear()
+        _HB_SEEN.clear()
+    try:
+        _obs_flight.set_host(this_host)
+    except Exception:
+        pass
+    heartbeat()
+    return dict(_POD)
+
+
+def pod_info():
+    """This process's declared pod place ({num_hosts, this_host,
+    heartbeat_dir}), or None when no pod is configured."""
+    with _PEER_LOCK:
+        return dict(_POD) if _POD is not None else None
+
+
+def pod_snapshot():
+    """One queryable pod view for metrics/alerts: configured flag, host
+    counts, sticky dead-host list, current coordinator."""
+    with _PEER_LOCK:
+        if _POD is None:
+            return {"configured": False}
+        dead = sorted(_DEAD_HOSTS)
+        return {"configured": True,
+                "num_hosts": _POD["num_hosts"],
+                "this_host": _POD["this_host"],
+                "dead_hosts": dead,
+                "live_hosts": [h for h in range(_POD["num_hosts"])
+                               if h not in _DEAD_HOSTS],
+                "coordinator": next(
+                    (h for h in range(_POD["num_hosts"])
+                     if h not in _DEAD_HOSTS), None)}
+
+
+def reset_pod():
+    """Forget the pod declaration and all host bookkeeping (tests)."""
+    global _POD
+    with _PEER_LOCK:
+        _POD = None
+        _DEAD_HOSTS.clear()
+        _HB_SEEN.clear()
+    try:
+        _obs_flight.set_host(None)
+    except Exception:
+        pass
+
+
+def mark_host_dead(host):
+    """Record that pod ``host`` — the whole failure domain, every one
+    of its device ranks — is gone. Sticky until :func:`reset_hosts`
+    (or a :func:`configure_pod` re-declaration) re-admits it."""
+    host = int(host)
+    with _PEER_LOCK:
+        newly_dead = host not in _DEAD_HOSTS
+        if newly_dead:
+            _DEAD_HOSTS.add(host)
+            _STATS["watchdog_host_lost"] += 1
+    if newly_dead:
+        _obs_flight.record("peer", host=host, status="dead")
+
+
+def dead_hosts():
+    with _PEER_LOCK:
+        return sorted(_DEAD_HOSTS)
+
+
+def reset_hosts(hosts=None):
+    """Forget dead-host bookkeeping (tests; or after a re-admitted host
+    rejoins). With ``hosts`` given, only those are cleared."""
+    with _PEER_LOCK:
+        if hosts is None:
+            _DEAD_HOSTS.clear()
+        else:
+            for h in hosts:
+                _DEAD_HOSTS.discard(int(h))
+
+
+def coordinator():
+    """The pod's current coordinator: the lowest live host rank, or
+    None when no pod is configured (or every host is dead)."""
+    with _PEER_LOCK:
+        if _POD is None:
+            return None
+        for h in range(_POD["num_hosts"]):
+            if h not in _DEAD_HOSTS:
+                return h
+    return None
+
+
+def _host_lost_error(hosts, detail, stalled=None):
+    hosts = tuple(sorted(int(h) for h in hosts))
+    what = detail or "collective"
+    if stalled is None:
+        msg = (f"pod host(s) {list(hosts)} lost: refusing to enter "
+               f"{what} that would block forever on the dead host(s)")
+    else:
+        msg = (f"pod host(s) {list(hosts)} lost: {what} stalled past "
+               f"its {stalled:.3g}s watchdog deadline waiting on the "
+               "dead host(s)")
+    err = PeerLostError(msg)
+    err.phase = "collective"
+    err.detail = detail
+    err.hosts = hosts
+    err.timeout = stalled
+    return err
+
+
+def heartbeat(host=None):
+    """Publish one liveness beat for ``host`` (default: this host).
+    Real pod: an atomic ``host-<h>.hb`` file (writer pid inside) in the
+    pod's heartbeat dir, so peers detect death by pid-liveness and file
+    staleness. Simulated pod: an in-memory timestamp. No-op when no pod
+    is configured."""
+    info = pod_info()
+    if info is None:
+        return
+    h = info["this_host"] if host is None else int(host)
+    d = info["heartbeat_dir"]
+    if not d:
+        with _PEER_LOCK:
+            _HB_SEEN[h] = time.monotonic()
+        return
+    gen = info.get("generation", 0)
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"host-{h}.gen{gen}.hb")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"host": h, "pid": os.getpid(),
+                       "time": time.time()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # a missed beat is staleness, never a crash
+
+
+def _scan_stale_hosts():
+    """Scan peer heartbeat files (real pods only): a beat whose writer
+    pid is dead is an immediate host loss; one older than
+    ``MXNET_TPU_HOST_HEARTBEAT_TIMEOUT`` seconds (unset/0 disables the
+    staleness rule; pid-death detection is always on) is a presumed
+    loss. Marks and returns newly-dead hosts without raising. A host
+    that never wrote a beat is still bootstrapping — absence of
+    evidence is not a verdict."""
+    info = pod_info()
+    if info is None:
+        return []
+    d = info["heartbeat_dir"]
+    if not d or not os.path.isdir(d):
+        return []
+    raw = os.environ.get("MXNET_TPU_HOST_HEARTBEAT_TIMEOUT", "").strip()
+    try:
+        stale_after = float(raw) if raw else 0.0
+    except ValueError:
+        stale_after = 0.0
+    gen = info.get("generation", 0)
+    already = set(dead_hosts())
+    newly = []
+    for h in range(info["num_hosts"]):
+        if h == info["this_host"] or h in already:
+            continue
+        path = os.path.join(d, f"host-{h}.gen{gen}.hb")
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+            age = time.time() - os.stat(path).st_mtime
+        except (OSError, ValueError):
+            continue
+        pid = beat.get("pid")
+        if pid is not None and not _pid_alive(pid):
+            mark_host_dead(h)
+            newly.append(h)
+        elif stale_after > 0 and age > stale_after:
+            mark_host_dead(h)
+            newly.append(h)
+    return newly
+
+
+def check_hosts(detail=None):
+    """One host-liveness consultation: poll the ``host_death`` and
+    ``coordinator_loss`` fault hooks, scan peer heartbeats, publish our
+    own beat, and raise PeerLostError (``.hosts`` naming every dead
+    host) when the caller is about to enter an operation that would
+    block forever on a dead failure domain. No-op when no pod is
+    configured. Called by :func:`check_peers`, so every
+    ``ShardedTrainer.step`` attempt consults it."""
+    if pod_info() is None:
+        return
+    host = _faults.maybe_host_death()
+    if host is not None:
+        mark_host_dead(host)
+    if _faults.maybe_coordinator_loss():
+        c = coordinator()
+        if c is not None:
+            mark_host_dead(c)
+    _scan_stale_hosts()
+    heartbeat()
+    dead = dead_hosts()
+    if dead:
+        raise _host_lost_error(dead, detail)
+
+
+def _stall_suspect_hosts():
+    """Hosts the pod liveness layer can blame for an expired guard:
+    pid-dead or stale peer heartbeats (real pods), or the armed
+    ``host_hang_collective`` fault's victim (the injected hang IS that
+    host's wedged collective entry — deterministic CPU coverage for
+    the hang-not-crash host failure). Never blames this host."""
+    info = pod_info()
+    if info is None:
+        return []
+    suspects = []
+    try:
+        if _faults.get("host_hang_collective") is not None:
+            suspects.append(
+                int(os.environ.get("MXNET_TPU_FAULT_HOST_RANK", "1")))
+    except Exception:
+        pass
+    suspects.extend(_scan_stale_hosts())
+    out = []
+    for h in suspects:
+        if h != info["this_host"] and h not in out:
+            out.append(h)
+    return out
+
+
+def pod_barrier(live_hosts=None, timeout=None, tag=None):
+    """Align the surviving hosts before a coordinated restart (shrink →
+    restore → re-stride happens on every survivor against the same
+    checkpoint). Simulated pods return immediately — one process IS the
+    pod. Real pods rendezvous on ``barrier-<tag>-host<h>.ok`` files in
+    the heartbeat dir (``tag`` defaults to a per-process sequence, so
+    lockstep callers agree); a live host that fails to arrive within
+    ``MXNET_TPU_POD_BARRIER_TIMEOUT`` seconds (default 60) is marked
+    dead and PeerLostError is raised so recovery re-runs against the
+    smaller pod. Returns the tuple of hosts that made the barrier."""
+    info = pod_info()
+    if info is None:
+        return ()
+    dead = set(dead_hosts())
+    if live_hosts is None:
+        live_hosts = [h for h in range(info["num_hosts"]) if h not in dead]
+    d = info["heartbeat_dir"]
+    if not d:
+        return tuple(h for h in live_hosts if h not in dead)
+    if tag is None:
+        tag = next(_BARRIER_SEQ)
+    if timeout is None:
+        raw = os.environ.get("MXNET_TPU_POD_BARRIER_TIMEOUT", "").strip()
+        try:
+            timeout = float(raw) if raw else 60.0
+        except ValueError:
+            timeout = 60.0
+    os.makedirs(d, exist_ok=True)
+    mine = os.path.join(d, f"barrier-{tag}-host{info['this_host']}.ok")
+    with open(mine, "w") as f:
+        f.write(str(os.getpid()))
+    deadline = time.monotonic() + float(timeout)
+    waiting = [h for h in live_hosts if h != info["this_host"]]
+    while waiting:
+        waiting = [h for h in waiting if not os.path.exists(
+            os.path.join(d, f"barrier-{tag}-host{h}.ok"))]
+        if not waiting:
+            break
+        _scan_stale_hosts()
+        waiting = [h for h in waiting if h not in set(dead_hosts())]
+        if not waiting:
+            break
+        if time.monotonic() >= deadline:
+            for h in waiting:
+                mark_host_dead(h)
+            raise _host_lost_error(waiting, f"pod_barrier({tag})",
+                                   stalled=float(timeout))
+        time.sleep(0.05)
+    still_dead = set(dead_hosts())
+    return tuple(h for h in live_hosts if h not in still_dead)
 
 
 # ------------------------------------------------------------------- guarding
@@ -311,7 +664,10 @@ def check_peers(detail=None):
     every dead rank) when the caller is about to enter an operation that
     would block forever on them. Called by ``collective_guard`` and at
     the top of every ``parallel.ShardedTrainer.step`` attempt — the
-    hook the elastic mesh-shrink recovery catches."""
+    hook the elastic mesh-shrink recovery catches. On a configured pod
+    the host-liveness layer is consulted first (:func:`check_hosts`),
+    so a dead failure domain outranks any single dead rank."""
+    check_hosts(detail)
     rank = _faults.maybe_peer_death()
     if rank is not None:
         mark_peer_dead(rank)
@@ -380,10 +736,12 @@ def note_peer_recovery(err, manifest=None, old_axes=None, new_axes=None):
     _STATS["watchdog_peer_recoveries"] += 1
     _obs_flight.record("peer", status="recovered",
                        ranks=list(getattr(err, "ranks", ()) or ()),
+                       hosts=list(getattr(err, "hosts", ()) or ()),
                        restored_step=None if manifest is None
                        else manifest.get("step"))
     info = {
         "ranks": list(getattr(err, "ranks", ()) or ()),
+        "hosts": list(getattr(err, "hosts", ()) or ()),
         "old_mesh_axes": old_axes,
         "new_mesh_axes": new_axes,
         "restored_step": None if manifest is None else manifest.get("step"),
@@ -488,11 +846,21 @@ def _fire(g):
     _obs_flight.record("stall", phase=g.phase, detail=g.detail,
                        timeout_s=g.timeout, step=g.step)
     dead = dead_peers()
+    hosts = ()
+    if g.phase in ("collective", "step") and not dead:
+        hosts = tuple(_stall_suspect_hosts())
+        for h in hosts:
+            mark_host_dead(h)
     if g.phase == "collective" and dead:
         cls = PeerLostError
         template = _peer_lost_error(dead, g.detail, stalled=g.timeout)
         message = str(template)
         extra = {"ranks": tuple(dead)}
+    elif hosts:
+        cls = PeerLostError
+        template = _host_lost_error(hosts, g.detail, stalled=g.timeout)
+        message = str(template)
+        extra = {"hosts": hosts}
     else:
         cls = StallError
         what = g.detail or g.phase

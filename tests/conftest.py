@@ -108,3 +108,10 @@ def pytest_configure(config):
         "token-length bucketing (mxnet_tpu/parallel/layout.py, "
         "gluon/model_zoo/transformer.py, docs/parallel.md); fast cases "
         "run in tier-1, the MFU bench gate carries the slow marker too")
+    config.addinivalue_line(
+        "markers",
+        "pod: pod-scale elastic runtime — host failure domains over the "
+        "global mesh, pod liveness, distributed-commit checkpointing "
+        "(parallel/mesh.py, resilience/watchdog.py + checkpoint.py, "
+        "docs/distributed.md); fast simulated-pod cases run in tier-1, "
+        "the real 2-process drill carries the slow marker too")

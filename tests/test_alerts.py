@@ -45,6 +45,7 @@ def _clean_layer():
     trace.clear()
     faults.reset()
     watchdog.reset_peers()
+    watchdog.reset_pod()
     yield
     alerts.reset()
     alerts.set_enabled(prev)
@@ -432,6 +433,31 @@ def test_input_stall_threshold_rule():
     assert got.get("input_stall_high") == "FIRING"
     assert alerts.get_rule("input_stall_high").last_evidence["value"] \
         == pytest.approx(0.8, abs=0.01)
+
+
+def test_pod_host_down_rule():
+    """pod_host_down: no data without a configured pod; FIRING once the
+    watchdog's liveness layer marks a host dead (naming it and the
+    surviving coordinator); RESOLVED on re-admission."""
+    # unconfigured pod -> rule evaluates to no-data, never fires
+    assert alerts.evaluate(now=2000.0, force=True) == {}
+    watchdog.configure_pod(4, 0)
+    try:
+        assert alerts.evaluate(now=2001.0, force=True) == {}
+        watchdog.mark_host_dead(2)
+        got = alerts.evaluate(now=2002.0, force=True)
+        assert got.get("pod_host_down") == "FIRING"
+        ev = alerts.get_rule("pod_host_down").last_evidence
+        assert ev["dead_hosts"] == [2]
+        assert ev["num_hosts"] == 4 and ev["coordinator"] == 0
+        # re-admission (sticky set cleared) resolves the incident once
+        # the rule's cooldown has passed
+        watchdog.reset_hosts()
+        t = 2002.0 + alerts.get_rule("pod_host_down").cooldown_s + 1
+        got = alerts.evaluate(now=t, force=True)
+        assert got.get("pod_host_down") == "RESOLVED"
+    finally:
+        watchdog.reset_pod()
 
 
 def test_step_time_drift_rule_and_fault_hook():

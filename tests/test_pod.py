@@ -1,0 +1,421 @@
+"""Pod-scale elastic runtime (docs/distributed.md): host failure
+domains over the global mesh. Fast tier-1 coverage runs the SIMULATED
+pod — one process, 8 virtual CPU devices partitioned into virtual
+hosts — with no subprocess spawns: topology mapping, host-major pod
+mesh, host-slice mesh shrink (alignment rule included), the watchdog's
+pod liveness layer (heartbeats, dead-pid detection, barrier), the
+distributed-commit checkpoint layout, retention-vs-live-writer pinning,
+duplicate-rank rejection, launcher failure propagation, and the pod
+observability gauges. The REAL 2-process drill (rank death + cross-host
+recovery, tools/launch.py + jax.distributed over Gloo) rides behind the
+slow marker.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import capture, parallel
+from mxnet_tpu.io import stream
+from mxnet_tpu.observability import flight, metrics
+from mxnet_tpu.parallel.mesh import (MeshShrinkError, PodTopology,
+                                     pod_mesh, shrink_mesh_hosts)
+from mxnet_tpu.resilience import CheckpointManager, checkpoint, watchdog
+
+pytestmark = pytest.mark.pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_pod():
+    import jax
+
+    assert len(jax.devices()) >= 8
+    watchdog.reset_pod()
+    watchdog.reset_peers()
+    yield
+    watchdog.reset_pod()
+    watchdog.reset_peers()
+
+
+def _dead_pid():
+    """A pid that is certainly not alive: a child that already exited."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+# ------------------------------------------------------------- topology
+
+def test_topology_mapping():
+    import jax
+
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    assert (topo.num_hosts, topo.devices_per_host) == (4, 2)
+    assert topo.host_ordinals(1) == (2, 3)
+    assert topo.host_of(5) == 2
+    assert topo.host_of_device(topo.devices[7]) == 3
+    assert list(topo.hosts()) == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        topo.host_ordinals(4)
+    with pytest.raises(ValueError):
+        PodTopology.simulated(3, jax.devices()[:8])  # 8 % 3 != 0
+
+
+def test_pod_mesh_is_host_major():
+    import jax
+
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    mesh, topo2 = pod_mesh({"dp": 4, "tp": 2}, topo)
+    assert topo2 is topo
+    # host h's devices occupy flat (C-order) ordinals [2h, 2h+2)
+    flat = list(mesh.devices.flat)
+    assert [d.id for d in flat] == [d.id for d in topo.devices]
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+        {"dp": 4, "tp": 2}
+
+
+def test_shrink_mesh_hosts_excises_whole_host():
+    import jax
+
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    mesh, _ = pod_mesh({"dp": 8}, topo)
+    new_mesh, new_topo, kept = shrink_mesh_hosts(mesh, [0], topo)
+    # 6 surviving dp slots trim to 4 (power of two): hosts 1 and 2
+    assert kept == (1, 2)
+    assert dict(zip(new_mesh.axis_names, new_mesh.devices.shape)) == \
+        {"dp": 4}
+    assert [d.id for d in new_mesh.devices.flat] == \
+        [d.id for d in topo.devices[2:6]]
+    # renumbered 0..k-1, still host-major
+    assert (new_topo.num_hosts, new_topo.devices_per_host) == (2, 2)
+    assert new_topo.host_ordinals(1) == (2, 3)
+
+
+def test_shrink_mesh_hosts_non_batch_axis():
+    import jax
+
+    # dp slots span BOTH hosts of a 2-host pod ({"dp":2,"tp":4} is
+    # host-major: tp varies fastest), so a dead host aligns to dp slots
+    topo = PodTopology.simulated(2, jax.devices()[:8])
+    mesh, _ = pod_mesh({"dp": 2, "tp": 4}, topo)
+    new_mesh, new_topo, kept = shrink_mesh_hosts(mesh, [1], topo)
+    assert kept == (0,)
+    assert dict(zip(new_mesh.axis_names, new_mesh.devices.shape)) == \
+        {"dp": 1, "tp": 4}
+    assert new_topo.num_hosts == 1
+
+
+def test_shrink_mesh_hosts_misaligned_raises():
+    import jax
+
+    # host 1 owns ordinals (2,3); dp slots are {0..3}/{4..7} and tp
+    # slots stride across them — no axis tiles exactly, must refuse
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    mesh, _ = pod_mesh({"dp": 2, "tp": 4}, topo)
+    with pytest.raises(MeshShrinkError, match="do not align"):
+        shrink_mesh_hosts(mesh, [1], topo)
+
+
+# --------------------------------------------------- trainer + capture
+
+def _dense_pod_trainer(num_hosts=4, ckpt_dir=None):
+    import jax
+
+    topo = PodTopology.simulated(num_hosts, jax.devices()[:8])
+    mgr = (None if ckpt_dir is None else
+           CheckpointManager(str(ckpt_dir), keep_n=3, pod=topo))
+    net = mx.gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    trainer = parallel.ShardedTrainer.for_pod(
+        net, lambda p, l: ((p - l) ** 2), "sgd",
+        {"learning_rate": 0.1}, axes={"dp": 8}, topology=topo,
+        checkpoint_manager=mgr)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    return trainer, mgr, x, y
+
+
+def test_for_pod_simulated_captured_step():
+    trainer, _, x, y = _dense_pod_trainer()
+    assert trainer.pod.num_hosts == 4
+    info = watchdog.pod_info()
+    assert info and info["num_hosts"] == 4 and info["this_host"] == 0
+    step = capture.capture(trainer)
+    loss = step(x, y)
+    assert np.isfinite(np.asarray(loss)).all()
+    # every flight event is tagged with this process's host rank
+    seq = flight.last_seq()
+    flight.record("test", probe="pod")
+    (evt,) = flight.events(since_seq=seq)
+    assert evt["host"] == 0
+
+
+# -------------------------------------------------------- pod liveness
+
+def test_configure_pod_validates_and_resets():
+    with pytest.raises(ValueError):
+        watchdog.configure_pod(0, 0)
+    with pytest.raises(ValueError):
+        watchdog.configure_pod(2, 2)
+    watchdog.configure_pod(4, 1)
+    snap = watchdog.pod_snapshot()
+    assert snap["configured"] and snap["live_hosts"] == [0, 1, 2, 3]
+    watchdog.mark_host_dead(2)
+    assert watchdog.dead_hosts() == [2]
+    assert watchdog.pod_snapshot()["dead_hosts"] == [2]  # sticky
+    # re-declaration IS the re-admission point
+    watchdog.configure_pod(4, 1)
+    assert watchdog.dead_hosts() == []
+
+
+def test_coordinator_is_lowest_live_host():
+    assert watchdog.coordinator() is None  # no pod configured
+    watchdog.configure_pod(3, 0)
+    assert watchdog.coordinator() == 0
+    watchdog.mark_host_dead(0)
+    assert watchdog.coordinator() == 1  # promotion
+
+
+def test_heartbeat_dead_pid_detection(tmp_path):
+    hb = str(tmp_path / "hb")
+    watchdog.configure_pod(2, 0, heartbeat_dir=hb)
+    mine = os.path.join(hb, "host-0.gen0.hb")
+    assert os.path.isfile(mine)  # first beat published at configure
+    assert json.load(open(mine))["pid"] == os.getpid()
+    # forge host 1's beat from an already-dead writer
+    with open(os.path.join(hb, "host-1.gen0.hb"), "w") as f:
+        json.dump({"host": 1, "pid": _dead_pid(), "time": time.time()}, f)
+    with pytest.raises(watchdog.PeerLostError) as exc:
+        watchdog.check_hosts("unit")
+    assert exc.value.hosts == (1,)
+    assert watchdog.dead_hosts() == [1]
+
+
+def test_heartbeat_staleness_rule(tmp_path, monkeypatch):
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("MXNET_TPU_HOST_HEARTBEAT_TIMEOUT", "0.2")
+    watchdog.configure_pod(2, 0, heartbeat_dir=hb)
+    watchdog.heartbeat(host=1)  # live pid, but the beat goes stale
+    path = os.path.join(hb, "host-1.gen0.hb")
+    old = time.time() - 5.0
+    os.utime(path, (old, old))
+    with pytest.raises(watchdog.PeerLostError):
+        watchdog.check_hosts("unit")
+    assert watchdog.dead_hosts() == [1]
+    # a host that never beat is still bootstrapping, never a verdict
+    watchdog.configure_pod(3, 0, heartbeat_dir=str(tmp_path / "hb2"))
+    watchdog.check_hosts("unit")  # no raise
+
+
+def test_pod_barrier_simulated_is_noop():
+    watchdog.configure_pod(4, 0)  # no heartbeat dir: one process IS it
+    assert watchdog.pod_barrier() == (0, 1, 2, 3)
+
+
+def test_pod_barrier_real_rendezvous_and_timeout(tmp_path):
+    hb = str(tmp_path / "hb")
+    watchdog.configure_pod(2, 0, heartbeat_dir=hb)
+    watchdog.heartbeat(host=1)  # keep the staleness scan quiet
+    # peer already arrived: rendezvous completes
+    with open(os.path.join(hb, "barrier-t1-host1.ok"), "w") as f:
+        f.write("peer")
+    assert watchdog.pod_barrier(tag="t1", timeout=5) == (0, 1)
+    # peer never arrives: it is marked dead and the loss surfaces
+    with pytest.raises(watchdog.PeerLostError) as exc:
+        watchdog.pod_barrier(tag="t2", timeout=0.3)
+    assert exc.value.hosts == (1,)
+    assert watchdog.dead_hosts() == [1]
+
+
+def test_update_pod_gauges():
+    assert metrics.update_pod() is None  # unconfigured: series absent
+    watchdog.configure_pod(4, 0)
+    watchdog.mark_host_dead(3)
+    snap = metrics.update_pod()
+    assert snap["dead_hosts"] == [3]
+    assert metrics._POD_HOSTS.value() == 4
+    assert metrics._POD_HOSTS_LIVE.value() == 3
+    assert metrics._POD_HOST_UP.value(host=0) == 1.0
+    assert metrics._POD_HOST_UP.value(host=3) == 0.0
+    # a shrink renumbers: stale host series must be pruned
+    watchdog.configure_pod(2, 0)
+    metrics.update_pod()
+    assert metrics._POD_HOST_UP.value(host=3) is None
+    assert metrics._POD_HOSTS_LIVE.value() == 2
+
+
+# ------------------------------------------------- distributed commit
+
+def test_pod_checkpoint_distributed_commit(tmp_path):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    # weight rows sharded over dp=4: shard i lives exactly on host i's
+    # device slice, so every host owns (and writes) real payload
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_n=3, pod=topo)
+    net = mx.gluon.nn.Dense(4, in_units=4, prefix="podckpt_")
+    net.initialize()
+    trainer = parallel.ShardedTrainer.for_pod(
+        net, lambda p, l: ((p - l) ** 2), "sgd",
+        {"learning_rate": 0.1}, axes={"dp": 4, "tp": 2}, topology=topo,
+        checkpoint_manager=mgr,
+        param_rules=[(r".*weight$", P("dp", None))])
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    trainer.step(x, y)
+    with pytest.raises(ValueError, match="async"):
+        mgr.save(1, trainer=trainer, async_=True)
+    path = mgr.save(1, trainer=trainer)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["pod"] == {"num_hosts": 4, "devices_per_host": 2}
+    # every host wrote its own tagged shards (replicated arrays are
+    # deduped to host 0; the dp-sharded weight spreads over all four);
+    # the commit-marker dir is gone once the manifest is published
+    shard_hosts = {f.split("-")[1] for f in
+                   os.listdir(os.path.join(path, "arrays"))}
+    assert shard_hosts == {"h000", "h001", "h002", "h003"}
+    assert not os.path.isdir(os.path.join(path, "commit"))
+    assert not [d for d in os.listdir(mgr.directory)
+                if d.endswith(".tmp.pod")]  # no debris on success
+
+    # cross-topology restore: a DIFFERENT (shrunk) mesh bitwise-matches
+    before = {k: np.asarray(v) for k, v in trainer.params.items()}
+    devs = jax.devices()[:4]
+    net2 = mx.gluon.nn.Dense(4, in_units=4, prefix="podckpt_")
+    net2.initialize()
+    t2 = parallel.ShardedTrainer(
+        net2, lambda p, l: ((p - l) ** 2), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=parallel.create_mesh({"dp": 4}, devs))
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"), keep_n=3)
+    manifest2 = mgr2.restore_latest(trainer=t2)
+    assert manifest2 is not None and manifest2["step"] == 1
+    for k, v in t2.params.items():
+        assert np.asarray(v).tobytes() == before[k].tobytes(), k
+
+
+def test_prune_never_races_a_live_pod_writer(tmp_path, monkeypatch):
+    """Regression (satellite bugfix): retention GC must not delete a
+    manifest-absent checkpoint dir another host is still writing."""
+    from mxnet_tpu import resilience
+
+    resilience.reset_stats()
+    net = mx.gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_n=1)
+    for step in (1, 2):
+        mgr.save(step, net=net)
+    assert [s for s, _ in mgr.list_checkpoints()] == [2]
+    # a peer manager started step-3 but has not published its manifest:
+    # from this manager's view, an old manifest-absent dir
+    straggler = os.path.join(mgr.directory, "ckpt-00000000")
+    os.makedirs(os.path.join(straggler, "arrays"))
+    with open(os.path.join(straggler, "arrays", "x.bin"), "wb") as f:
+        f.write(b"live writer")
+    mgr.save(3, net=net)  # triggers _prune
+    assert os.path.isdir(straggler), "pruned a dir a peer may be writing"
+    assert checkpoint.stats()["ckpt_prune_deferred"] >= 1
+    # quiet past the orphan grace it IS debris, and retention takes it
+    monkeypatch.setenv("MXNET_TPU_CKPT_ORPHAN_GRACE_S", "0")
+    mgr.save(4, net=net)
+    assert not os.path.isdir(straggler)
+
+
+# ------------------------------------------------------ rank handshake
+
+def test_duplicate_rank_rejected_at_handshake(tmp_path, monkeypatch):
+    from mxnet_tpu.kvstore import dist
+
+    monkeypatch.setenv("MXNET_TPU_DIST_CLAIM_DIR", str(tmp_path))
+    coord = "127.0.0.1:9999"
+    dist._claim_rank(coord, 2, 0)
+    dist._claim_rank(coord, 2, 0)  # same process re-claims fine
+    # another LIVE process already holds rank 1
+    with open(os.path.join(str(tmp_path), "rank-1.claim"), "w") as f:
+        f.write(str(os.getppid()))
+    with pytest.raises(dist.DistConfigError) as exc:
+        dist._claim_rank(coord, 2, 1)
+    msg = str(exc.value)
+    assert "DMLC_WORKER_ID=1" in msg and str(os.getppid()) in msg
+    # a DEAD claimant is stale debris from a crashed run: reclaimable
+    with open(os.path.join(str(tmp_path), "rank-1.claim"), "w") as f:
+        f.write(str(_dead_pid()))
+    dist._claim_rank(coord, 2, 1)
+    with open(os.path.join(str(tmp_path), "rank-1.claim")) as f:
+        assert f.read() == str(os.getpid())
+
+
+def test_launch_local_propagates_failing_rank(monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from launch import launch_local
+
+    monkeypatch.setenv("MXNET_TPU_LAUNCH_GRACE_S", "3")
+    prog = ("import os, sys, time\n"
+            "if os.environ['DMLC_WORKER_ID'] == '1':\n"
+            "    sys.stderr.write('boom-from-rank1')\n"
+            "    sys.exit(7)\n"
+            "time.sleep(60)\n")
+    t0 = time.monotonic()
+    rc = launch_local(2, [sys.executable, "-c", prog])
+    assert rc == 7  # the FAILING rank's code, not the sibling's SIGTERM
+    assert time.monotonic() - t0 < 30, "siblings were not torn down"
+    fail = launch_local.last_failure
+    assert fail and fail["rank"] == 1 and fail["code"] == 7
+    assert "boom-from-rank1" in fail["stderr_tail"]
+    # success resets the failure record
+    rc = launch_local(2, [sys.executable, "-c", "pass"])
+    assert rc == 0 and launch_local.last_failure is None
+
+
+# --------------------------------------------------------- data plane
+
+def test_stream_for_pod_partitions_by_host(tmp_path):
+    import jax
+    from mxnet_tpu import recordio
+
+    prefix = str(tmp_path / "data-00000")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(12):
+        payload = np.full(3, i, np.float32).tobytes()
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payload))
+    rec.close()
+
+    topo = PodTopology.simulated(2, jax.devices()[:8])
+    it = stream.StreamBatchIter.for_pod(
+        topo, [prefix + ".rec"], batch_size=2,
+        decode=stream.raw_decoder((3,)), epochs=1)
+    assert (it.stream.part_index, it.stream.num_parts) == (0, 2)
+    seen = sorted(int(b.data[i, 0]) for b in it for i in range(2))
+    assert seen == [0, 2, 4, 6, 8, 10]  # gid % num_hosts == this_host
+    with pytest.raises(ValueError, match="for_pod derives"):
+        stream.StreamBatchIter.for_pod(
+            topo, [prefix + ".rec"], batch_size=2,
+            decode=stream.raw_decoder((3,)), num_parts=4)
+
+
+# ------------------------------------------------------ real 2-process
+
+@pytest.mark.slow
+def test_pod_two_process_host_death_recovery():
+    """The real thing: 2 processes x 2 virtual devices over
+    jax.distributed/Gloo; rank 1 dies between steps, rank 0 detects it
+    through the shared heartbeat dir, shrinks the pod to its own host
+    slice, restores the distributed-commit checkpoint and must match a
+    shrunk-topology oracle bitwise (__graft_entry__._dryrun_pod)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"),
+         "dryrun-pod", "4"],
+        env=env, capture_output=True, text=True, timeout=280)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "dryrun pod (2 procs x 2 devices, host death) OK" in r.stdout

@@ -531,12 +531,16 @@ RESILIENCE_KEYS = frozenset({
     "ckpt_saves", "ckpt_save_failures", "ckpt_restores",
     "ckpt_restore_skipped", "ckpt_pruned",
     "ckpt_async_saves", "ckpt_async_waits", "ckpt_async_failures",
+    # pod distributed commit + GC pin (PR 19)
+    "ckpt_pod_commits", "ckpt_pod_commit_failures", "ckpt_prune_deferred",
     # faults
     "faults_armed", "faults_fired",
     # watchdog (PR 4; peer recovery PR 5)
     "watchdog_guards", "watchdog_stalls", "watchdog_crash_reports",
     "watchdog_rollbacks", "watchdog_peer_lost",
     "watchdog_peer_recoveries",
+    # pod host-domain liveness (PR 19)
+    "watchdog_host_lost",
     # elastic (PR 4; mesh shrink PR 5)
     "elastic_oom_events", "elastic_shrinks", "elastic_accum_steps",
     "elastic_mesh_shrinks",

@@ -55,7 +55,9 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "ckpt_crash_before_manifest", "ckpt_async_crash",
               "hang_step", "hang_collective", "hang_batch", "peer_death",
               "peer_death_recover", "peer_death_multiaxis", "oom_step",
-              "dist_connect_timeout",
+              "dist_connect_timeout", "host_death",
+              "host_hang_collective", "coordinator_loss",
+              "ckpt_partial_pod",
               "capture_step", "replica_crash", "replica_hang",
               "replica_nan_storm", "int8_calib_mismatch",
               "perf_regression", "slo_burn", "step_time_anomaly",
@@ -333,6 +335,287 @@ def _drill_peer_death_multiaxis(mx, workdir):
           and trainer.last_recovery["step"] == 1)
     return ok, (f"axes {new_axes} bitwise={bitwise} recoveries="
                 f"{s['watchdog_peer_recoveries']}")
+
+
+def _pod_dense_trainer(mx, workdir, prefix, seed):
+    """4-virtual-host x 2-chip simulated pod, dp=8 Dense trainer with a
+    pod-bound checkpoint manager — the shared rig of the host-domain
+    drills."""
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.parallel.mesh import PodTopology, pod_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.resilience import CheckpointManager
+
+    topo = PodTopology.simulated(4, jax.devices()[:8])
+    mesh, topo = pod_mesh({"dp": 8}, topo)
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(4, in_units=4, prefix=prefix)
+    net.initialize()
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3,
+                            pod=topo)
+    trainer = ShardedTrainer(net, lambda p, l: ((p - l) ** 2),
+                             optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=mesh,
+                             checkpoint_manager=mgr).bind_pod(topo)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    return trainer, mgr, x, y
+
+
+def _drill_host_death(mx, workdir):
+    """A whole HOST (all 4 of its chips) dies during a CAPTURED
+    dp×fsdp×tp transformer step on a 2-virtual-host pod (the CI pod
+    shape: 2 hosts x 4 chips) and the run survives: host 1's rank slice
+    IS dp slot 1, so the pod-wide shrink excises it whole, the
+    distributed-commit checkpoint reloads cross-topology onto the
+    survivor's mesh, and the continued run is bitwise-equal to a
+    hand-seeded oracle trainer built directly on the shrunk pod
+    (docs/distributed.md)."""
+    import warnings
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu import capture
+    from mxnet_tpu.gluon.model_zoo import transformer as tzoo
+    from mxnet_tpu.parallel import SpecLayout
+    from mxnet_tpu.parallel.mesh import PodTopology, create_mesh, pod_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.resilience import (CheckpointManager, elastic, faults,
+                                      watchdog)
+
+    # recovery recompiles the transformer step on the shrunk mesh inside
+    # a fresh step guard — the deadline must cover compile time
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "180"
+    if len(jax.devices()) < 8:
+        return False, "needs >= 8 devices (xla_force_host_platform_device_count)"
+
+    def build_net():
+        mx.random.seed(31)
+        net = tzoo.transformer_lm(vocab=16, units=8, num_heads=2,
+                                  num_layers=1, max_len=16,
+                                  prefix="chaos_pod_tlm_")
+        net.initialize()
+        net(mx.nd.zeros((2, 4)))
+        return net
+
+    def build_trainer(net, mesh, mgr=None):
+        layout = SpecLayout.for_mesh(mesh)
+        return ShardedTrainer(
+            net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            mesh=mesh, param_rules=layout.param_rules(),
+            batch_axis_name=layout.batch_axes(), checkpoint_manager=mgr)
+
+    topo = PodTopology.simulated(2, jax.devices()[:8])
+    mesh, topo = pod_mesh({"dp": 2, "fsdp": 2, "tp": 2}, topo)
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3,
+                            pod=topo)
+    trainer = build_trainer(build_net(), mesh, mgr).bind_pod(topo)
+    step = capture.capture(trainer)
+    rs = np.random.RandomState(31)
+    x = (rs.rand(8, 8) * 16).astype(np.int32)
+    y = (rs.rand(8, 8) * 16).astype(np.int32)
+    step(x, y)
+    mgr.save(1, trainer=trainer)        # pod distributed commit
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("host_death"):   # victim: host 1 (dp slot 0)
+            loss1 = step(x, y)          # dies -> pod shrink -> re-runs
+    new_axes = {str(a): int(s) for a, s in
+                zip(trainer.mesh.axis_names, trainer.mesh.devices.shape)}
+    loss2 = step(x, y)                  # training continues on survivors
+
+    # hand-seeded oracle: same net, built DIRECTLY on the surviving
+    # hosts' devices, restored from the same distributed-commit
+    # checkpoint — the recovered pod must match it bitwise
+    oracle = build_trainer(build_net(),
+                           create_mesh({"dp": 1, "fsdp": 2, "tp": 2},
+                                       jax.devices()[:4]))
+    mgr.restore_latest(trainer=oracle)
+    o1, o2 = oracle.step(x, y), oracle.step(x, y)
+    bitwise = (
+        np.float32(loss1).tobytes() == np.float32(o1).tobytes()
+        and np.float32(loss2).tobytes() == np.float32(o2).tobytes()
+        and all(np.array_equal(np.asarray(trainer.params[k]),
+                               np.asarray(oracle.params[k]))
+                for k in trainer.params))
+    s = {**watchdog.stats(), **elastic.stats()}
+    pod = trainer.pod
+    ok = (new_axes == {"dp": 1, "fsdp": 2, "tp": 2} and bitwise
+          and pod is not None and pod.num_hosts == 1
+          and s["watchdog_host_lost"] >= 1
+          and s["watchdog_peer_recoveries"] >= 1
+          and s["elastic_mesh_shrinks"] >= 1
+          and trainer.last_recovery is not None
+          and trainer.last_recovery["step"] == 1)
+    return ok, (f"axes {new_axes} hosts=2->"
+                f"{pod.num_hosts if pod else '?'} bitwise={bitwise} "
+                f"host_lost={s['watchdog_host_lost']}")
+
+
+def _drill_host_hang_collective(mx, workdir):
+    """A pod host WEDGES (not crashes) at the collective entry: no
+    process exits, so only the watchdog's stall deadline can see it. The
+    stall converts to a dead-host verdict via the pod liveness layer's
+    suspect-blame (the armed fault names its victim; a real pod scans
+    stale heartbeats), and recovery proceeds exactly as for a crash."""
+    import threading
+    import warnings
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.resilience import elastic, faults, watchdog
+
+    if len(jax.devices()) < 8:
+        return False, "needs >= 8 devices (xla_force_host_platform_device_count)"
+    # detection needs a SHORT step deadline, but the post-shrink retry
+    # recompiles inside a fresh guard reading the same env knob — lift
+    # the deadline the moment the stall converts to a dead-host verdict
+    # (the mark precedes the async raise, and recovery takes far longer
+    # than this watcher's poll interval)
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "0.75"
+    stop = threading.Event()
+
+    def lift():
+        while not stop.is_set():
+            if watchdog.dead_hosts():
+                os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "180"
+                return
+            time.sleep(0.002)
+
+    lifter = threading.Thread(target=lift, daemon=True)
+    lifter.start()
+    try:
+        trainer, mgr, x, y = _pod_dense_trainer(mx, workdir,
+                                                "chaos_hang_host_", 37)
+        trainer.step(x, y)
+        mgr.save(1, trainer=trainer)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject("host_hang_collective"):  # victim: host 1
+                loss = trainer.step(x, y)  # wedges -> stall -> shrink
+    finally:
+        stop.set()
+    new_dp = int(trainer.mesh.shape.get("dp", 0))
+    trainer.step(x, y)                     # training continues
+    s = {**watchdog.stats(), **elastic.stats()}
+    pod = trainer.pod
+    ok = (new_dp == 4 and pod is not None and pod.num_hosts == 2
+          and np.isfinite(float(loss))
+          and s["watchdog_host_lost"] >= 1
+          and s["watchdog_peer_recoveries"] >= 1
+          and s["elastic_mesh_shrinks"] >= 1
+          and trainer.last_recovery is not None
+          and trainer.last_recovery["step"] == 1)
+    return ok, (f"dp 8->{new_dp} hosts=4->"
+                f"{pod.num_hosts if pod else '?'} "
+                f"host_lost={s['watchdog_host_lost']}")
+
+
+def _drill_coordinator_loss(mx, workdir):
+    """The COORDINATOR host (rank 0) dies: the liveness layer marks it,
+    survivors shrink it out of the pod, and the lowest surviving host is
+    promoted — the renumbered topology's new host 0 is the old host 1,
+    and the pod keeps training under the new coordinator."""
+    import warnings
+
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.resilience import elastic, faults, watchdog
+
+    # recovery recompiles on the shrunk mesh inside a fresh step guard
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "120"
+    if len(jax.devices()) < 8:
+        return False, "needs >= 8 devices (xla_force_host_platform_device_count)"
+    trainer, mgr, x, y = _pod_dense_trainer(mx, workdir, "chaos_coord_",
+                                            41)
+    trainer.step(x, y)
+    mgr.save(1, trainer=trainer)
+    coord_before = watchdog.coordinator()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject("coordinator_loss"):
+            loss = trainer.step(x, y)      # host 0 dies -> promotion
+    coord_after = watchdog.coordinator()
+    new_dp = int(trainer.mesh.shape.get("dp", 0))
+    trainer.step(x, y)                     # training continues
+    s = {**watchdog.stats(), **elastic.stats()}
+    pod = trainer.pod
+    import jax as _jax
+
+    # host 0 (ordinals 0,1) excised; trim keeps ordinals 2..5, so the
+    # promoted pod's first device is the old global ordinal 2
+    promoted = (pod is not None and pod.devices is not None
+                and pod.devices[0].id == _jax.devices()[2].id)
+    ok = (coord_before == 0 and coord_after == 0 and promoted
+          and new_dp == 4 and pod.num_hosts == 2
+          and np.isfinite(float(loss))
+          and s["watchdog_host_lost"] >= 1
+          and s["watchdog_peer_recoveries"] >= 1
+          and trainer.last_recovery is not None)
+    return ok, (f"dp 8->{new_dp} promoted={promoted} "
+                f"hosts=4->{pod.num_hosts if pod else '?'}")
+
+
+def _drill_ckpt_partial_pod(mx, workdir):
+    """A host crashes MID-DISTRIBUTED-COMMIT (after its shards, before
+    its completion marker): the manifest is never published, so the
+    failed attempt is pure debris — the previous checkpoint restores
+    bitwise, and the staleness GC reaps the shared tmpdir once its
+    orphan grace expires. Never a torn manifest, never a lost
+    checkpoint."""
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.resilience import checkpoint, faults
+
+    if len(jax.devices()) < 8:
+        return False, "needs >= 8 devices (xla_force_host_platform_device_count)"
+    trainer, mgr, x, y = _pod_dense_trainer(mx, workdir, "chaos_cpp_", 43)
+    directory = os.path.join(workdir, "ckpt")
+    trainer.step(x, y)
+    mgr.save(1, trainer=trainer)           # clean distributed commit
+    before = {k: np.asarray(v).copy() for k, v in trainer.params.items()}
+    trainer.step(x, y)                     # advance past the checkpoint
+    crashed = False
+    try:
+        with faults.inject("ckpt_partial_pod"):
+            mgr.save(2, trainer=trainer)   # dies after host 0's shards
+    except faults.SimulatedCrash:
+        crashed = True
+    if not crashed:
+        return False, "ckpt_partial_pod fault never fired"
+    entries = sorted(os.listdir(directory))
+    torn = [e for e in entries if e == "ckpt-00000002"]
+    debris = [e for e in entries if e.endswith(".tmp.pod")]
+    man = mgr.restore_latest(trainer=trainer)
+    restored = (man is not None and man["step"] == 1
+                and all(np.array_equal(np.asarray(trainer.params[k]),
+                                       before[k]) for k in before))
+    # the shared tmpdir is debris, reaped only past its orphan grace
+    prior = os.environ.get("MXNET_TPU_CKPT_ORPHAN_GRACE_S")
+    try:
+        os.environ["MXNET_TPU_CKPT_ORPHAN_GRACE_S"] = "0"
+        mgr._gc_debris()
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_TPU_CKPT_ORPHAN_GRACE_S", None)
+        else:
+            os.environ["MXNET_TPU_CKPT_ORPHAN_GRACE_S"] = prior
+    reaped = not any(e.endswith(".tmp.pod") for e in os.listdir(directory))
+    kept = os.path.isfile(os.path.join(directory, "ckpt-00000001",
+                                       "manifest.json"))
+    s = checkpoint.stats()
+    ok = (not torn and len(debris) == 1 and restored and reaped and kept
+          and s["ckpt_pod_commit_failures"] >= 1)
+    return ok, (f"torn={torn} debris={len(debris)} restored={restored} "
+                f"reaped={reaped}")
 
 
 def _drill_hang_step(mx, workdir):
@@ -1274,6 +1557,14 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_peer_death_recover(mx, tmp)
     if kind == "peer_death_multiaxis":
         return _drill_peer_death_multiaxis(mx, tmp)
+    if kind == "host_death":
+        return _drill_host_death(mx, tmp)
+    if kind == "host_hang_collective":
+        return _drill_host_hang_collective(mx, tmp)
+    if kind == "coordinator_loss":
+        return _drill_coordinator_loss(mx, tmp)
+    if kind == "ckpt_partial_pod":
+        return _drill_ckpt_partial_pod(mx, tmp)
     if kind == "hang_step":
         return _drill_hang_step(mx, tmp)
     if kind == "hang_collective":
@@ -1329,6 +1620,7 @@ def run_kind(kind, workdir=None):
     os.environ.update(_ENV)
     faults.reset()
     watchdog.reset_peers()
+    watchdog.reset_pod()
     tmp = workdir or tempfile.mkdtemp(prefix="chaos_")
     mark = _obs_flight.last_seq()
     try:
@@ -1344,6 +1636,7 @@ def run_kind(kind, workdir=None):
     finally:
         faults.reset()
         watchdog.reset_peers()
+        watchdog.reset_pod()
         for k, v in saved_env.items():
             if v is None:
                 os.environ.pop(k, None)

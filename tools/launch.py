@@ -30,9 +30,41 @@ def free_port():
         return s.getsockname()[1]
 
 
-def launch_local(n, cmd, port=None, env_extra=None):
+def _stderr_tail(path, limit=4096):
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - limit))
+            return fh.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def launch_local(n, cmd, port=None, env_extra=None, kill_siblings=True,
+                 grace=None):
+    """Spawn ``n`` local workers; returns the job's exit code.
+
+    Each worker's stderr is captured to a temp file. When the first
+    worker exits non-zero, the remaining ranks get SIGTERM and, after
+    ``grace`` seconds (env ``MXNET_TPU_LAUNCH_GRACE_S``, default 10),
+    SIGKILL — survivors would otherwise hang in collectives waiting for
+    the dead peer. The FAILING rank's exit code is returned (not a
+    sibling's SIGTERM code), its stderr tail is echoed to this process's
+    stderr, and ``launch_local.last_failure`` holds
+    ``{"rank", "code", "stderr_tail"}`` for programmatic callers
+    (None on success). ``kill_siblings=False`` keeps survivors running —
+    the elastic-recovery drills need the job to outlive one rank's
+    death."""
+    import tempfile
+    import time
+
     port = port or free_port()
+    if grace is None:
+        grace = float(os.environ.get("MXNET_TPU_LAUNCH_GRACE_S", "10"))
+    launch_local.last_failure = None
     procs = []
+    logs = []
     try:
         for rank in range(n):
             env = dict(os.environ)
@@ -44,13 +76,18 @@ def launch_local(n, cmd, port=None, env_extra=None):
                 "DMLC_PS_ROOT_URI": "127.0.0.1",
                 "DMLC_PS_ROOT_PORT": str(port),
             })
-            procs.append(subprocess.Popen(cmd, env=env))
+            log = tempfile.NamedTemporaryFile(
+                mode="wb", prefix=f"mxnet_tpu-launch-r{rank}-",
+                suffix=".stderr", delete=False)
+            logs.append(log.name)
+            try:
+                procs.append(subprocess.Popen(cmd, env=env, stderr=log))
+            finally:
+                log.close()
         # Poll all workers: if any dies, tear the whole job down at once
-        # (surviving ranks would otherwise hang in collectives waiting for
-        # the dead peer — the dmlc tracker does the same).
-        import time
-
+        # (the dmlc tracker does the same).
         rc = 0
+        failed_rank = None
         live = list(procs)
         term_deadline = None  # set when SIGTERM was sent; escalate to SIGKILL
         while live:
@@ -59,17 +96,25 @@ def launch_local(n, cmd, port=None, env_extra=None):
                 if code is None:
                     continue
                 live.remove(p)
-                if code != 0:
-                    rc = rc or code
-                    for q in live:
-                        q.send_signal(signal.SIGTERM)
-                    if term_deadline is None:
-                        term_deadline = time.monotonic() + 10.0
+                if code != 0 and failed_rank is None:
+                    failed_rank = procs.index(p)
+                    rc = code
+                    if kill_siblings:
+                        for q in live:
+                            q.send_signal(signal.SIGTERM)
+                        term_deadline = time.monotonic() + grace
             if term_deadline is not None and time.monotonic() > term_deadline:
                 for q in live:
                     if q.poll() is None:
                         q.kill()
             time.sleep(0.1)
+        if failed_rank is not None:
+            tail = _stderr_tail(logs[failed_rank])
+            launch_local.last_failure = {
+                "rank": failed_rank, "code": rc, "stderr_tail": tail}
+            sys.stderr.write(
+                f"launch.py: worker rank {failed_rank} exited with code "
+                f"{rc}; stderr tail:\n{tail}\n")
         return rc
     finally:
         for p in procs:
@@ -77,9 +122,17 @@ def launch_local(n, cmd, port=None, env_extra=None):
                 p.send_signal(signal.SIGTERM)
         for p in procs:
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=max(1.0, grace))
             except subprocess.TimeoutExpired:
                 p.kill()
+        for path in logs:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+launch_local.last_failure = None
 
 
 def main(argv=None):
