@@ -127,6 +127,15 @@ def aot_enabled():
     return enabled() and cache_dir() is not None
 
 
+def _integrity_enabled():
+    """Is the in-graph step fingerprint armed (resilience.integrity)?
+    Late import: capture loads before the resilience package in some
+    entry orders."""
+    from .resilience import integrity as _integrity
+
+    return _integrity.fingerprint_enabled()
+
+
 def _cache_limit_bytes():
     try:
         mb = float(os.environ.get("MXNET_TPU_COMPILE_CACHE_MAX_MB", "2048"))
@@ -893,6 +902,36 @@ class CapturedTrainerStep:
         self._entries = {}
         self._last_sig = None
         self._step_count = 0
+        # last step's in-graph fingerprint output (resilience.integrity;
+        # lazy — host-read only on last_fingerprint access)
+        self._last_fp_out = None
+
+    @property
+    def last_fingerprint(self):
+        """uint32 fingerprint of the last executed step, or None when
+        fingerprinting is off (resilience.integrity). Identical across
+        the captured, eager-fallback, and bulk paths by construction."""
+        if self._last_fp_out is None:
+            return None
+        import numpy as np
+
+        return int(np.asarray(self._last_fp_out))
+
+    def _note_eager_fp(self):
+        """Host-side fingerprint of the step that just ran eagerly (the
+        kill-switch / capture-failure path) — folds the same operand set
+        as the in-graph output, so eager and captured agree bitwise."""
+        from .resilience import integrity as _integrity
+
+        if not _integrity.fingerprint_enabled():
+            self._last_fp_out = None
+            return
+        import numpy as np
+
+        named_p, named_g = _integrity.net_named_state(self.net)
+        self._last_fp_out = np.uint32(
+            _integrity.step_fold_host(named_p, named_g))
+        _integrity.note_fingerprint_step()
 
     # ------------------------------------------------------------ step python
     def _opt_host_snapshot(self):
@@ -958,6 +997,7 @@ class CapturedTrainerStep:
         from . import autograd
         from .jit import TraceSession, _active
         from .ndarray.ndarray import NDArray
+        from .resilience import integrity as _integrity
 
         trainer = self.trainer
         tap = self.numerics
@@ -1044,7 +1084,13 @@ class CapturedTrainerStep:
                 for cell in upd.mutated:
                     cell._data = jnp.where(passed, cell._data,
                                            upd.orig[id(cell)])
-        return loss, flags, tap_out
+        # in-graph step fingerprint (resilience.integrity): folded AFTER
+        # the sentinel select so it digests the values that actually
+        # landed — rides out as one extra scalar of the SAME program
+        fp = None
+        if _integrity.fingerprint_enabled():
+            fp = _integrity.step_fold(*_integrity.net_named_state(self.net))
+        return loss, flags, tap_out, fp
 
     # ------------------------------------------------------------------ build
     def _build(self, x_nd, y_nd, batch_size, sig):
@@ -1094,6 +1140,9 @@ class CapturedTrainerStep:
         has_scale = self.loss_scaler is not None
         has_norm = self.sentinel is not None \
             and self.sentinel.grad_norm_threshold is not None
+        from .resilience import integrity as _integrity
+
+        has_fp = _integrity.fingerprint_enabled()
         tap_rows = self.numerics.rows if has_tap else ()
         step = self
 
@@ -1121,7 +1170,7 @@ class CapturedTrainerStep:
                             TraceSession() as inner:
                         inner.note_created(x2)
                         inner.note_created(y2)
-                        loss, flags, tap_out = step._run_step_python(
+                        loss, flags, tap_out, fp = step._run_step_python(
                             x2, y2, batch_size, scale_t, gate_t, tap_t)
                     if with_tap and \
                             tuple(step.numerics.rows) != tuple(tap_rows):
@@ -1135,6 +1184,8 @@ class CapturedTrainerStep:
                         outs.append(flags[0])
                         if flags[1] is not None:
                             outs.append(flags[1])
+                    if fp is not None:
+                        outs.append(fp)
                     if tap_out is not None:
                         outs.append(tap_out)
                     new_state = [c._data for c in state_cells]
@@ -1184,7 +1235,9 @@ class CapturedTrainerStep:
             "has_gate": has_gate, "has_norm": has_norm,
             "has_tap": has_tap, "tap_rows": tap_rows,
             "tap_gates": has_tap and self.numerics.gates_updates,
-            "tap_idx": 1 + int(has_flag) + int(has_norm),
+            "has_fp": has_fp,
+            "fp_idx": 1 + int(has_flag) + int(has_norm),
+            "tap_idx": 1 + int(has_flag) + int(has_norm) + int(has_fp),
             "states_ref": self.trainer._updaters[0].states,
             "ctx": x_nd.context,
             # the same fp ⊕ avals identity aot_compile just ledgered,
@@ -1222,6 +1275,10 @@ class CapturedTrainerStep:
             # stat selection are runtime operands and must NOT key here
             "numerics": None if self.numerics is None
                 else self.numerics.plan_signature(),
+            # the in-graph step fingerprint adds an output to the traced
+            # program (resilience.integrity) — an AOT artifact compiled
+            # with the other setting must never false-hit
+            "integrity": _integrity_enabled(),
         }
         return fingerprint(parts)
 
@@ -1363,6 +1420,13 @@ class CapturedTrainerStep:
         for c, v in zip(entry["cells"], new_state):
             c._data = v
         loss = NDArray(outs[0], entry["ctx"])
+        if entry.get("has_fp"):
+            from .resilience import integrity as _integrity
+
+            self._last_fp_out = outs[entry["fp_idx"]]
+            _integrity.note_fingerprint_step()
+        else:
+            self._last_fp_out = None
         # reading the flag is a host sync that breaks async dispatch
         # pipelining. Anything that GATES on it — sentinel, AMP scaler,
         # a halt/skip tap — reads it every step: the in-program select
@@ -1501,6 +1565,7 @@ class CapturedTrainerStep:
             finally:
                 if reattach:
                     trainer._sentinel = None
+            self._note_eager_fp()
             return loss
         from .resilience import faults as _faults
         from .resilience import watchdog as _watchdog
@@ -1544,6 +1609,7 @@ class CapturedTrainerStep:
                 raise
             return None
         self._apply_flag(finite_ok, norm_ok, checking)
+        self._note_eager_fp()
         return loss
 
 

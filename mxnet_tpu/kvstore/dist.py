@@ -27,6 +27,7 @@ import time
 
 import numpy as _np
 
+from ..observability import flight as _obs_flight
 from ..resilience import faults as _faults
 from ..resilience import watchdog as _watchdog
 from .kvstore import KVStore, KVStoreTPU, _pairs
@@ -464,6 +465,33 @@ class KVStoreDist(KVStoreTPU):
     def barrier(self):
         if self.num_workers > 1:
             self._get_ring().allreduce(_np.zeros((1,), _np.float32))
+
+    def fingerprint_agree(self, named):
+        """Do ALL workers' replicas of ``named`` fold to the same
+        xsf32-v1 fingerprint? Decides with the ring's sum allreduce
+        alone: the 32-bit fingerprint splits into 16-bit halves (so
+        every channel stays exact in float64), and both the sum and the
+        square-sum of each half are reduced — by strict convexity,
+        ``sum(x_i) == n*x`` AND ``sum(x_i^2) == n*x^2`` holds on a rank
+        only when every ``x_i`` equals its own ``x``, so the verdict is
+        exact and symmetric on every rank (no probabilistic hashing).
+        Counts a mismatch into the integrity layer's checkpoint/
+        boundary counters and flight-records it."""
+        fp = self.state_fingerprint(named)
+        if self.num_workers <= 1:
+            return True
+        from ..resilience import integrity as _integrity
+
+        halves = _np.array([fp & 0xFFFF, fp >> 16], _np.float64)
+        vec = _np.concatenate([halves, halves * halves])
+        total = self._get_ring().allreduce(vec)
+        agree = bool(_np.array_equal(total, vec * float(self.num_workers)))
+        if not agree:
+            _integrity._STATS["integrity_ckpt_mismatches"] += 1
+            _integrity._MET_MISMATCHES.inc(surface="checkpoint")
+            _obs_flight.record("integrity", op="kv_disagree",
+                               rank=self.rank, fingerprint=fp)
+        return agree
 
 
 def _from_np(arr, like):

@@ -293,6 +293,31 @@ class KVStoreTPU(KVStore):
             acc = jnp.add(acc, d)
         return NDArray(acc, values[0].context)
 
+    def state_fingerprint(self, named):
+        """xsf32-v1 fold of ``named`` ({name: NDArray or array}) — this
+        worker's local view of a replicated state, as one 32-bit
+        integer (``resilience.integrity``)."""
+        import numpy as np
+
+        from ..resilience import integrity as _integrity
+
+        items = named.items() if hasattr(named, "items") else named
+        host = {str(k): np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                                   else v)
+                for k, v in items}
+        return int(_integrity.fold_host(host))
+
+    def fingerprint_agree(self, named):
+        """Do all workers hold bit-identical replicas of ``named``? A
+        worker whose copy silently diverged (an SDC'd broadcast or a
+        corrupted local apply) is invisible to loss curves — this is
+        the cross-rank boundary check of the integrity layer. On a
+        single-process store the replicas ARE the same buffers, so
+        agreement is trivial; ``KVStoreDist`` overrides with a real
+        worker-ring comparison."""
+        self.state_fingerprint(named)  # folding must succeed everywhere
+        return True
+
 
 def _pairs(key, value):
     if isinstance(key, (str, int)):

@@ -110,6 +110,7 @@ ALERT_RULE_IDS = (
     "numerics_dead_layer",    # in-graph tap: a layer stopped training
     "decode_ttft_burn",       # decode TTFT SLO-miss burn rate, 2 windows
     "pod_host_down",          # a pod host's heartbeat/liveness lost
+    "sdc_detected",           # integrity layer caught silent corruption
 )
 
 
@@ -227,6 +228,28 @@ def _health_counters():
             "health_skipped_steps": sentinel._STATS["health_skipped_steps"],
             "sentinel_grad_norm_trips":
                 sentinel._STATS["sentinel_grad_norm_trips"],
+        }
+    except Exception:
+        return {}
+
+
+def _integrity_counters():
+    """The SDC-detection counters the ``sdc_detected`` rule windows —
+    pulled lazily so importing observability never drags the
+    resilience layer in (same model as ``_health_counters``)."""
+    try:
+        import sys
+
+        integrity = sys.modules.get("mxnet_tpu.resilience.integrity")
+        if integrity is None:
+            return {}
+        st = integrity._STATS
+        return {
+            "integrity_audit_mismatches": st["integrity_audit_mismatches"],
+            "integrity_selftest_failures":
+                st["integrity_selftest_failures"],
+            "integrity_serving_failures": st["integrity_serving_failures"],
+            "integrity_ckpt_mismatches": st["integrity_ckpt_mismatches"],
         }
     except Exception:
         return {}
@@ -689,6 +712,15 @@ def _default_rules():
                         "watchdog's liveness layer (heartbeats, pid "
                         "checks, stall blame) marked at least one host "
                         "rank dead; sticky until re-admission"),
+        CounterSpikeRule(
+            "sdc_detected", "integrity",
+            ("integrity_audit_mismatches", "integrity_selftest_failures",
+             "integrity_serving_failures", "integrity_ckpt_mismatches"),
+            threshold=1,
+            description="silent data corruption caught: a shadow replay "
+                        "audit, device self-test, serving golden-query "
+                        "check or checkpoint manifest fingerprint "
+                        "mismatched inside one fast window"),
     )
 
 
@@ -896,7 +928,8 @@ def evaluate(now=None, force=False, slo=None, input_stall=None):
         obs = {"now": now, "seq": _flight.last_seq(),
                "slo": _slo_counters() if slo is None else slo,
                "decode": _decode_counters(),
-               "health": _health_counters()}
+               "health": _health_counters(),
+               "integrity": _integrity_counters()}
         with _LOCK:
             # a clock that moved backwards (a synthetic test clock after
             # a real-clock run, or vice versa) restarts the window

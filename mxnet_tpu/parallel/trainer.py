@@ -95,6 +95,13 @@ class ShardedTrainer:
         # for crash reports (the executables live in _bind_mesh state)
         self._elastic_n = 1
         self._step_count = 0
+        # SDC defense (resilience.integrity): the last step's in-graph
+        # fingerprint output (lazy — host-read only on access) and the
+        # SIGTERM preemption trap (finish the step, checkpoint, drain)
+        self._last_fp_out = None
+        from ..resilience import integrity as _integrity
+
+        _integrity.install_preempt_handler()
 
     def _spec_for(self, name):
         from jax.sharding import PartitionSpec as P
@@ -190,20 +197,27 @@ class ShardedTrainer:
         self.opt_state = jax.tree.map(put, self.opt_state,
                                       self._opt_sharding())
 
-    def _opt_sharding(self):
+    def _opt_sharding(self, mesh=None, param_sharding=None):
         """Sharding pytree for opt_state: param-shaped state leaves
         (momenta, adam moments, master copies) follow their parameter's
         sharding; everything else (step counter, rng keys) is replicated.
         Used both for placement and for the step's in/out shardings — the
         two MUST agree, or the donated state input aliases an
-        incompatibly-sharded output buffer (XLA INTERNAL size-mismatch)."""
+        incompatibly-sharded output buffer (XLA INTERNAL size-mismatch).
+        ``mesh``/``param_sharding`` override the trainer's own bindings
+        so the integrity shadow replay can mirror the same structure
+        onto a different same-shape mesh."""
         import jax
 
+        if mesh is None:
+            mesh = self.mesh
+        if param_sharding is None:
+            param_sharding = self._param_sharding
         repl = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec())
+            mesh, jax.sharding.PartitionSpec())
 
         def shard_for(name, leaf):
-            ps = self._param_sharding.get(name)
+            ps = param_sharding.get(name)
             p = self.params.get(name)
             if ps is not None and p is not None \
                     and hasattr(leaf, "shape") \
@@ -284,6 +298,7 @@ class ShardedTrainer:
         A changed fingerprint is a re-capture, recorded in the retrace
         forensics; an unchanged one re-links the on-disk AOT artifact."""
         from .. import capture as _capture
+        from ..resilience import integrity as _integrity
 
         parts = {
             "params": sorted((k, tuple(v.shape), str(v.dtype))
@@ -313,6 +328,10 @@ class ShardedTrainer:
             # program change: fold the table token in so the next step()
             # re-traces instead of reusing the stale captured program
             "schedule": _capture._schedule_token(),
+            # the in-graph step fingerprint adds an output to the traced
+            # program (resilience.integrity) — an AOT artifact compiled
+            # with the other setting must never false-hit
+            "integrity": _integrity.fingerprint_enabled(),
         }
         return _capture.fingerprint(parts)
 
@@ -338,13 +357,23 @@ class ShardedTrainer:
     def _build_step(self):
         import jax
 
+        from ..resilience import integrity as _integrity
+
         update = self._update
         compute_loss = self._make_compute_loss()
+        # in-graph step fingerprint (resilience.integrity): one extra
+        # uint32 output of the SAME program — zero extra executables.
+        # Armed at build time; the capture fingerprint folds the flag so
+        # an AOT artifact compiled without it can never false-hit.
+        fp_on = self._fp_armed = _integrity.fingerprint_enabled()
 
         def step(params, aux, opt_state, x, y):
             (loss, new_aux), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(params, aux, x, y)
             new_params, new_opt = update(params, grads, opt_state)
+            if fp_on:
+                return (new_params, new_aux, new_opt, loss,
+                        _integrity.step_fold(new_params, grads))
             return new_params, new_aux, new_opt, loss
 
         # opt_state shardings are pinned on BOTH sides: donation aliases
@@ -353,7 +382,7 @@ class ShardedTrainer:
         # otherwise shard tp-param momenta and break the aliasing)
         opt_sharding = self._opt_sharding()
         out_shardings = (self._param_sharding, self._aux_sharding,
-                         opt_sharding, None)
+                         opt_sharding, None) + ((None,) if fp_on else ())
         self._step = self._capture_exec(
             step, "sharded_step",
             in_shardings=(self._param_sharding, self._aux_sharding,
@@ -387,15 +416,22 @@ class ShardedTrainer:
             w = (mask * (float(mask.size) / jnp.sum(mask)))[..., None]
             return compute_loss(params, aux, x, y, w)
 
+        from ..resilience import integrity as _integrity
+
+        fp_on = self._fp_armed = _integrity.fingerprint_enabled()
+
         def step(params, aux, opt_state, x, y, length):
             (loss, new_aux), grads = jax.value_and_grad(
                 masked_loss, has_aux=True)(params, aux, x, y, length)
             new_params, new_opt = update(params, grads, opt_state)
+            if fp_on:
+                return (new_params, new_aux, new_opt, loss,
+                        _integrity.step_fold(new_params, grads))
             return new_params, new_aux, new_opt, loss
 
         opt_sharding = self._opt_sharding()
         out_shardings = (self._param_sharding, self._aux_sharding,
-                         opt_sharding, None)
+                         opt_sharding, None) + ((None,) if fp_on else ())
         self._step_masked = self._capture_exec(
             step, "sharded_step_masked",
             in_shardings=(self._param_sharding, self._aux_sharding,
@@ -620,6 +656,11 @@ class ShardedTrainer:
                     length = jax.device_put(length, bs)
         self._step_count += 1
         _watchdog.note_step(self._step_count)
+        from ..resilience import integrity as _integrity
+
+        # retained pre-step snapshot for the shadow-replay audit (None
+        # unless this step is on the audit cadence)
+        snap = _integrity.snapshot_step(self, x, y)
         rows = int(x.shape[0])
         shards = self._batch_shards()
 
@@ -668,18 +709,43 @@ class ShardedTrainer:
                         if length is not None:
                             if self._step_masked is None:  # mesh rebound
                                 self._build_masked_step()
-                            self.params, self.aux, self.opt_state, loss = \
-                                self._step_masked(self.params, self.aux,
-                                                  self.opt_state, x, y,
-                                                  length)
+                            outs = self._step_masked(self.params, self.aux,
+                                                     self.opt_state, x, y,
+                                                     length)
+                            (self.params, self.aux, self.opt_state,
+                             loss) = outs[:4]
+                            self._last_fp_out = \
+                                outs[4] if len(outs) > 4 else None
                         elif n <= 1:
                             if self._step is None:  # mesh rebound mid-retry
                                 self._build_step()
-                            self.params, self.aux, self.opt_state, loss = \
-                                self._step(self.params, self.aux,
-                                           self.opt_state, x, y)
+                            outs = self._step(self.params, self.aux,
+                                              self.opt_state, x, y)
+                            (self.params, self.aux, self.opt_state,
+                             loss) = outs[:4]
+                            self._last_fp_out = \
+                                outs[4] if len(outs) > 4 else None
                         else:
                             loss = self._accum_step(n, x, y)
+                    # SDC fault hooks land AFTER the step (corrupting the
+                    # new state) and the shadow-replay audit runs INSIDE
+                    # the attempt loop: a transient verdict rolls back and
+                    # retries this batch, a sticky-device verdict raises
+                    # PeerLostError into the same mesh-shrink recovery
+                    # path as a dead peer
+                    if self._last_fp_out is not None:
+                        _integrity.note_fingerprint_step()
+                    self.params = _faults.maybe_sdc_bitflip_param(
+                        self.params)
+                    self.params = _faults.maybe_sdc_sticky_param(
+                        self.params, self.mesh)
+                    if snap is not None:
+                        verdict = _integrity.audit_step(
+                            self, snap, n=n, length=length,
+                            live_fp=self._last_fp_out)
+                        if verdict == "retry":
+                            continue
+                        snap = None
                 break
             except _watchdog.PeerLostError as e:
                 # a dead peer is unrecoverable in place — but with a
@@ -694,6 +760,8 @@ class ShardedTrainer:
                     # is no survivable shrink of a global mesh
                     raise
                 x, y = self._recover_peer_loss(e, x, y)
+                snap = None  # pre-step snapshot is stale after a
+                # checkpoint restore — the re-run batch is not audited
                 if length is not None:
                     length = jax.device_put(length, self._batch_sharding)
                 shards = self._batch_shards()
@@ -740,6 +808,10 @@ class ShardedTrainer:
         if microbatches is None and n > self._elastic_n:
             self._elastic_n = n  # sticky: don't re-OOM every step (a
             # short tail batch's fallback must not discard the shrink)
+        if _integrity.preempt_requested() or _faults.maybe_preempt():
+            # SIGTERM (or a drilled preempt): the in-flight step is done —
+            # emergency checkpoint, drain, exit cleanly
+            _integrity.preempt_exit(self, loss=loss)
         return loss
 
     def _check_state_alive(self, cause):
@@ -964,6 +1036,7 @@ class ShardedTrainer:
         import jax.numpy as jnp
 
         from ..resilience import elastic as _elastic
+        from ..resilience import faults as _faults
 
         if self._grads_fn is None:
             self._build_elastic()
@@ -985,8 +1058,22 @@ class ShardedTrainer:
             loss_sum = loss if loss_sum is None else loss_sum + loss
         inv = 1.0 / n
         acc = jax.tree.map(lambda g: g * inv, acc)
+        acc = _faults.maybe_sdc_bitflip_grad(acc)
         params, opt_state = self._apply_fn(params, acc, opt_state)
         self.params, self.aux, self.opt_state = params, aux, opt_state
+        from ..resilience import integrity as _integrity
+
+        if _integrity.fingerprint_enabled():
+            # the accumulated path has no single fused executable to grow
+            # an output on — fold the same fingerprint host-side over the
+            # applied params and the accumulated (divided) grads
+            import numpy as np
+
+            self._last_fp_out = np.uint32(_integrity.step_fold_host(
+                {k: np.asarray(v) for k, v in params.items()},
+                {k: np.asarray(v) for k, v in acc.items()}))
+        else:
+            self._last_fp_out = None
         return loss_sum / n
 
     def get_states_bytes(self):
@@ -1100,3 +1187,149 @@ class ShardedTrainer:
                 p.data()._set_data(fetch(self.aux[name]))
         if RNG_KEY in self.aux:
             _random.generator_key()._set_data(fetch(self.aux[RNG_KEY]))
+
+    @property
+    def last_fingerprint(self):
+        """uint32 in-graph fingerprint of the last executed step, or None
+        when fingerprinting is off (resilience.integrity). Reading it is
+        the only host sync — the step itself never blocks on it."""
+        if self._last_fp_out is None:
+            return None
+        import numpy as np
+
+        return int(np.asarray(self._last_fp_out))
+
+    def integrity_replay(self, mesh, params, aux, opt_state, x, y,
+                         microbatches=1, length=None):
+        """Re-execute ONE training step from host-side pre-step state on
+        an alternate same-shape mesh (the shadow slice of the SDC audit,
+        resilience.integrity.audit_step). Mirrors the live variant
+        exactly — fused, pad-masked, or n-microbatch accumulation — since
+        the variants are not bitwise-interchangeable (different grad
+        arithmetic); the shadow mesh keeps the live mesh's shape and axis
+        names so GSPMD emits the same collective structure and float
+        reduction order. Returns ``(host new_params dict, uint32
+        fingerprint or None)``. The trainer's own state, mesh, and
+        executables are untouched; replay executables are plain
+        non-donating jits cached per (shadow devices, variant, capture
+        fingerprint)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..resilience import integrity as _integrity
+
+        fp_on = _integrity.fingerprint_enabled()
+        n = max(1, int(microbatches))
+        key = (tuple(int(d.id) for d in mesh.devices.flat), n,
+               length is not None, fp_on, self._capture_fingerprint())
+        cached = getattr(self, "_replay_cache", None)
+        if cached is not None and cached[0] == key:
+            shards, fns = cached[1], cached[2]
+        else:
+            param_sh = {k: NamedSharding(mesh, self._spec_for(k))
+                        for k in params}
+            repl = NamedSharding(mesh, P())
+            aux_sh = {k: repl for k in aux}
+            batch_sh = NamedSharding(mesh, P(self._batch_axis))
+            opt_sh = self._opt_sharding(mesh=mesh,
+                                        param_sharding=param_sh)
+            shards = (param_sh, aux_sh, batch_sh, opt_sh)
+            update = self._update
+            compute_loss = self._make_compute_loss()
+            if length is not None:
+                def masked_loss(p, a, xx, yy, ll):
+                    t = int(xx.shape[1])
+                    mask = (jnp.arange(t, dtype=jnp.int32)[None, :]
+                            < ll.astype(jnp.int32)[:, None]
+                            ).astype(jnp.float32)
+                    w = (mask * (float(mask.size) / jnp.sum(mask))
+                         )[..., None]
+                    return compute_loss(p, a, xx, yy, w)
+
+                def rstep(p, a, o, xx, yy, ll):
+                    (_loss, _na), grads = jax.value_and_grad(
+                        masked_loss, has_aux=True)(p, a, xx, yy, ll)
+                    new_p, _no = update(p, grads, o)
+                    fp = _integrity.step_fold(new_p, grads) \
+                        if fp_on else jnp.uint32(0)
+                    return new_p, fp
+
+                fns = jax.jit(
+                    rstep,
+                    in_shardings=(param_sh, aux_sh, opt_sh, batch_sh,
+                                  batch_sh, batch_sh),
+                    out_shardings=(param_sh, None))
+            elif n <= 1:
+                def rstep(p, a, o, xx, yy):
+                    (_loss, _na), grads = jax.value_and_grad(
+                        compute_loss, has_aux=True)(p, a, xx, yy)
+                    new_p, _no = update(p, grads, o)
+                    fp = _integrity.step_fold(new_p, grads) \
+                        if fp_on else jnp.uint32(0)
+                    return new_p, fp
+
+                fns = jax.jit(
+                    rstep,
+                    in_shardings=(param_sh, aux_sh, opt_sh, batch_sh,
+                                  batch_sh),
+                    out_shardings=(param_sh, None))
+            else:
+                def grads_fn(p, a, xx, yy):
+                    (loss, new_a), grads = jax.value_and_grad(
+                        compute_loss, has_aux=True)(p, a, xx, yy)
+                    return grads, new_a, loss
+
+                def apply_fn(p, g, o):
+                    return update(p, g, o)
+
+                fns = (
+                    jax.jit(grads_fn,
+                            in_shardings=(param_sh, aux_sh, batch_sh,
+                                          batch_sh),
+                            out_shardings=(param_sh, aux_sh, None)),
+                    jax.jit(apply_fn,
+                            in_shardings=(param_sh, param_sh, opt_sh),
+                            out_shardings=(param_sh, opt_sh)))
+            self._replay_cache = (key, shards, fns)
+        param_sh, aux_sh, batch_sh, opt_sh = shards
+        p_dev = {k: jax.device_put(np.asarray(v), param_sh[k])
+                 for k, v in params.items()}
+        a_dev = {k: jax.device_put(np.asarray(v), aux_sh[k])
+                 for k, v in aux.items()}
+        o_dev = jax.tree.map(
+            lambda leaf, sh: jax.device_put(np.asarray(leaf), sh),
+            opt_state, opt_sh)
+        x_dev = jax.device_put(np.asarray(x), batch_sh)
+        y_dev = jax.device_put(np.asarray(y), batch_sh)
+        if length is not None:
+            l_dev = jax.device_put(np.asarray(length), batch_sh)
+            new_p, fp = fns(p_dev, a_dev, o_dev, x_dev, y_dev, l_dev)
+        elif n <= 1:
+            new_p, fp = fns(p_dev, a_dev, o_dev, x_dev, y_dev)
+        else:
+            gfn, afn = fns
+            rows = int(x_dev.shape[0])
+            mb = rows // n
+            acc = None
+            a_cur = a_dev
+            for i in range(n):
+                sl = slice(i * mb, (i + 1) * mb)
+                x_i = jax.device_put(x_dev[sl], batch_sh)
+                y_i = jax.device_put(y_dev[sl], batch_sh)
+                grads, a_cur, _loss = gfn(p_dev, a_cur, x_i, y_i)
+                acc = grads if acc is None \
+                    else jax.tree.map(jnp.add, acc, grads)
+            inv = 1.0 / n
+            acc = jax.tree.map(lambda g: g * inv, acc)
+            new_p, _o = afn(p_dev, acc, o_dev)
+            host_p = {k: np.asarray(v) for k, v in new_p.items()}
+            fp = np.uint32(_integrity.step_fold_host(
+                host_p,
+                {k: np.asarray(v) for k, v in acc.items()})) \
+                if fp_on else None
+            return host_p, (None if fp is None else int(fp))
+        host_p = {k: np.asarray(v) for k, v in new_p.items()}
+        return host_p, (int(np.asarray(fp)) if fp_on else None)

@@ -18,13 +18,18 @@ Five cooperating pieces (docs/resilience.md):
   mesh-shrink resume (smaller mesh + reshardable checkpoint reload);
 - :mod:`faults` — deterministic fault-injection harness used by the
   test suite (and ``tools/chaos_run.py`` drills) to prove the above
-  actually work.
+  actually work;
+- :mod:`integrity` — silent-data-corruption defense: in-graph step
+  fingerprints, shadow replay audits on a second device slice, device
+  self-test + sticky quarantine, checkpoint-manifest fingerprints,
+  serving golden-query audits, and graceful SIGTERM preemption.
 """
 from . import faults
 from . import checkpoint as _checkpoint_mod
 from . import sentinel as _sentinel_mod
 from . import watchdog
 from . import elastic
+from . import integrity
 from .checkpoint import (CheckpointManager, CheckpointCorruptError,
                          atomic_write_bytes)
 from .sentinel import HealthSentinel, NumericHealthError, note_skip
@@ -33,7 +38,7 @@ from .watchdog import StallError, PeerLostError
 __all__ = ["CheckpointManager", "CheckpointCorruptError",
            "atomic_write_bytes", "HealthSentinel", "NumericHealthError",
            "note_skip", "StallError", "PeerLostError", "faults",
-           "watchdog", "elastic", "stats", "reset_stats"]
+           "watchdog", "elastic", "integrity", "stats", "reset_stats"]
 
 
 def stats():
@@ -45,6 +50,7 @@ def stats():
     out.update(faults.stats())
     out.update(watchdog.stats())
     out.update(elastic.stats())
+    out.update(integrity.stats())
     return out
 
 
@@ -54,3 +60,4 @@ def reset_stats():
     faults.reset_stats()
     watchdog.reset_stats()
     elastic.reset_stats()
+    integrity.reset_stats()

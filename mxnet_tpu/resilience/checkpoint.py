@@ -561,6 +561,8 @@ class CheckpointManager:
             except CheckpointCorruptError as e:
                 _STATS["ckpt_restore_skipped"] += 1
                 warnings.warn(f"skipping corrupt checkpoint: {e}")
+                _obs_flight.record("ckpt", op="restore_skipped",
+                                   path=path, reason=str(e))
         return None
 
     # ---------------------------------------------------------------- pins
@@ -844,6 +846,25 @@ class CheckpointManager:
                     add(f"param:{name}", v)
             if trainer is not None:
                 trainer_bytes = trainer.get_states_bytes()
+        # parameter-state fingerprint (resilience.integrity): verified
+        # on restore BEFORE the trainer is mutated — a checkpoint whose
+        # payloads pass CRC but whose values were written by a lying
+        # chip is still caught. Skipped on pod/multi-process saves (the
+        # global state is not fully addressable from one host).
+        from . import integrity as _integrity
+
+        integrity_rec = None
+        if _integrity.fingerprint_enabled() and pod is None:
+            if kind == "sharded":
+                if not getattr(trainer, "_multiproc", False):
+                    integrity_rec = _integrity.manifest_fingerprint(
+                        {k: _np.asarray(v)
+                         for k, v in trainer.params.items()})
+            elif net is not None:
+                integrity_rec = _integrity.manifest_fingerprint(
+                    {name: _np.asarray(p.data().data_
+                                       if hasattr(p, "data") else p)
+                     for name, p in _net_param_map(net).items()})
         return {"kind": kind, "arrays": arrays,
                 "trainer_bytes": trainer_bytes,
                 "manifest": {"format_version": _FORMAT_VERSION,
@@ -855,6 +876,7 @@ class CheckpointManager:
                              "loss_scaler": _scaler_state(trainer),
                              "mesh_axes": mesh_axes,
                              "data_state": data_state,
+                             "integrity": integrity_rec,
                              "extra": extra or {}}}
 
     def _write_snapshot(self, snap, tag, final, is_async=False,
@@ -1161,12 +1183,18 @@ class CheckpointManager:
             with self._pin(path):
                 try:
                     manifest, payloads = self._verify(path)
+                    return self._apply(manifest, payloads, net, trainer,
+                                       data_iter)
                 except CheckpointCorruptError as e:
+                    # _apply raises pre-mutation only (fingerprint or
+                    # shard-coverage failures surface before any state
+                    # is touched), so falling back to the previous
+                    # checkpoint is always safe here
                     _STATS["ckpt_restore_skipped"] += 1
                     warnings.warn(f"skipping corrupt checkpoint: {e}")
+                    _obs_flight.record("ckpt", op="restore_skipped",
+                                       path=path, reason=str(e))
                     continue
-                return self._apply(manifest, payloads, net, trainer,
-                                   data_iter)
         return None
 
     def restore(self, path, net=None, trainer=None, data_iter=None):
@@ -1204,6 +1232,21 @@ class CheckpointManager:
         version = manifest.get("format_version", 1)
         if version >= 2:
             entries = self._assemble_arrays(manifest, payloads)
+            rec = manifest.get("integrity")
+            if rec:
+                # value-level verification, pre-mutation: CRC covers the
+                # bytes as written; this covers what a lying chip wrote
+                from . import integrity as _integrity
+
+                if not _integrity.verify_manifest_fingerprint(
+                        rec,
+                        {k[len("param:"):]: v for k, v in entries.items()
+                         if k.startswith("param:")}):
+                    raise CheckpointCorruptError(
+                        f"step {manifest.get('step')}: reassembled "
+                        "parameter state does not match the manifest "
+                        "integrity fingerprint (silent data corruption "
+                        "at save time)")
             params = {k: v for k, v in entries.items()
                       if k.startswith(("param:", "aux:"))}
             opt = {k[len("opt:"):]: v for k, v in entries.items()

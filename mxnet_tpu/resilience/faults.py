@@ -170,6 +170,53 @@ Supported fault kinds (the hook that honours each is noted):
                                   backpressures instead of OOMing and
                                   no sequence wedges: queued prompts
                                   admit as soon as pages free
+- ``sdc_bitflip_param``         — flip ONE low mantissa bit of one
+                                  post-step parameter (transient silent
+                                  data corruption: finite, tiny, sails
+                                  past the sentinel; hooked after
+                                  ``ShardedTrainer``'s step executes) —
+                                  only the shadow replay audit
+                                  (``resilience.integrity``) can catch
+                                  it, classify it transient via the
+                                  all-pass self-test battery, and roll
+                                  the step back
+- ``sdc_bitflip_grad``          — same single-bit corruption on the
+                                  ACCUMULATED gradient before the
+                                  optimizer apply
+                                  (``ShardedTrainer._accum_step``), so
+                                  the corrupted update flows through
+                                  the real apply and the audit's accum
+                                  replay must detect the divergence
+- ``sdc_device_sticky``         — a sticky lying device: every step,
+                                  corrupt the post-step params while
+                                  the victim device
+                                  (``MXNET_TPU_FAULT_DEVICE``, default
+                                  0) is in the trainer's mesh, AND
+                                  corrupt that device's known-answer
+                                  self-test result
+                                  (``integrity.device_selftest``) —
+                                  the audit must attribute the
+                                  mismatch, quarantine the device, and
+                                  excise it via mesh shrink (arm with
+                                  ``times="*"``: sticky means forever)
+- ``sdc_serving``               — flip one low mantissa bit in every
+                                  prediction OUTPUT of one serving
+                                  replica (``MXNET_TPU_FAULT_REPLICA``
+                                  targeting; hooked into the fleet's
+                                  replica proxy AFTER the predictor
+                                  runs) — finite wrong answers no
+                                  sentinel sees; only the golden-query
+                                  audit (``integrity.audit_serving``)
+                                  catches and drains the liar
+- ``preempt``                   — simulated preemption notice
+                                  (``ShardedTrainer._step_impl`` step
+                                  boundary): the runtime must finish
+                                  the in-flight step, publish an
+                                  emergency async checkpoint, and exit
+                                  cleanly with ``integrity.Preempted``
+                                  — the drillable twin of the SIGTERM
+                                  trap (``integrity.
+                                  install_preempt_handler``)
 
 Arming is step-addressed and deterministic: ``arm(kind, at_step=k,
 times=n)`` fires on the k-th .. (k+n-1)-th invocation of the hook (0-based;
@@ -204,7 +251,10 @@ __all__ = ["SimulatedCrash", "FaultInjected", "InjectedOOM", "ReplicaCrash",
            "maybe_step_time_anomaly", "maybe_corrupt_record",
            "maybe_rollout_bad_weights", "maybe_canary_slo_regression",
            "maybe_autoscale_flap", "DecodeReplicaDead",
-           "maybe_decode_replica_death", "maybe_kv_pool_exhaustion"]
+           "maybe_decode_replica_death", "maybe_kv_pool_exhaustion",
+           "maybe_sdc_bitflip_param", "maybe_sdc_bitflip_grad",
+           "maybe_sdc_sticky_param", "maybe_sdc_selftest",
+           "maybe_sdc_serving", "maybe_preempt"]
 
 
 class SimulatedCrash(BaseException):
@@ -810,6 +860,165 @@ def maybe_decode_replica_death():
     if fault is None or not fault.should_fire():
         return
     raise DecodeReplicaDead("injected decode engine death mid-stream")
+
+
+# Silent-data-corruption faults (resilience/integrity.py): each one
+# produces FINITE wrong bits — a single low mantissa-bit flip — that no
+# NaN sentinel or loss explosion can see, so the drills prove the
+# fingerprint/audit layer is the only detector that fires.
+
+def _fault_device_target():
+    return int(os.environ.get("MXNET_TPU_FAULT_DEVICE", "0"))
+
+
+def _flip_low_bit(arr):
+    """One low-bit flip in the first element of a host copy of ``arr``
+    (numpy or jax array); returns a same-device/sharding replacement.
+    Low mantissa bit: the value stays finite and numerically tiny —
+    exactly the corruption class only bit-exact fingerprints catch."""
+    import numpy as np
+
+    host = np.asarray(arr)
+    flat = np.ascontiguousarray(host).ravel().copy()
+    if flat.size == 0:
+        return arr
+    size = flat.dtype.itemsize
+    if size == 4:
+        flat.view(np.uint32)[0] ^= np.uint32(1)
+    elif size == 2:
+        flat.view(np.uint16)[0] ^= np.uint16(1)
+    else:
+        flat.view(np.uint8)[0] ^= np.uint8(1)
+    out = flat.reshape(host.shape)
+    sharding = getattr(arr, "sharding", None)
+    if sharding is not None:
+        import jax
+
+        return jax.device_put(out, sharding)
+    return out
+
+
+def _flip_first_float(tree, kind):
+    """Flip one low bit in the first floating-point leaf of ``tree``
+    (dict name -> array). The victim is resolved BEFORE the caller
+    consumes the fire window (an all-integer tree must fail loudly)."""
+    import numpy as np
+
+    target = None
+    for name in sorted(tree):
+        a = tree[name]
+        if np.issubdtype(np.asarray(a).dtype, np.floating):
+            target = name
+            break
+    if target is None:
+        raise FaultInjected(
+            f"{kind} armed but there is no floating-point leaf to "
+            f"corrupt (leaves: {sorted(tree)})")
+    out = dict(tree)
+    out[target] = _flip_low_bit(tree[target])
+    return out
+
+
+def maybe_sdc_bitflip_param(params):
+    """Transient SDC on the post-step parameters (kind
+    ``sdc_bitflip_param``): one low mantissa-bit flip in one parameter
+    after the optimizer update landed — simulating a corrupted weight
+    write. Hooked after ``ShardedTrainer``'s step executes; only the
+    shadow replay audit can see it."""
+    if not _ACTIVE:
+        return params
+    fault = _ACTIVE.get("sdc_bitflip_param")
+    if fault is None:
+        return params
+    out = _flip_first_float(params, "sdc_bitflip_param")
+    if not fault.should_fire():
+        return params
+    return out
+
+
+def maybe_sdc_bitflip_grad(grads):
+    """Transient SDC on the accumulated gradient (kind
+    ``sdc_bitflip_grad``): one low-bit flip before the optimizer apply
+    (``ShardedTrainer._accum_step``), so the corrupted update flows
+    through the real apply executable."""
+    if not _ACTIVE:
+        return grads
+    fault = _ACTIVE.get("sdc_bitflip_grad")
+    if fault is None:
+        return grads
+    out = _flip_first_float(grads, "sdc_bitflip_grad")
+    if not fault.should_fire():
+        return grads
+    return out
+
+
+def maybe_sdc_sticky_param(params, mesh):
+    """The step-side half of a sticky lying device (kind
+    ``sdc_device_sticky``): while the victim device
+    (``MXNET_TPU_FAULT_DEVICE``) participates in ``mesh``, every fired
+    step corrupts the post-step params. Once recovery excises the
+    device from the mesh, the hook goes quiet — corruption stops
+    exactly when the quarantine takes effect."""
+    if not _ACTIVE:
+        return params
+    fault = _ACTIVE.get("sdc_device_sticky")
+    if fault is None:
+        return params
+    victim = _fault_device_target()
+    if victim not in {int(d.id) for d in mesh.devices.flat}:
+        return params
+    out = _flip_first_float(params, "sdc_device_sticky")
+    if not fault.should_fire():
+        return params
+    return out
+
+
+def maybe_sdc_selftest(result, device_id):
+    """The attribution-side half of ``sdc_device_sticky``: corrupt the
+    victim device's known-answer self-test result
+    (``integrity.device_selftest``), so the audit's battery names
+    exactly the lying chip."""
+    if not _ACTIVE:
+        return result
+    fault = _ACTIVE.get("sdc_device_sticky")
+    if fault is None or int(device_id) != _fault_device_target():
+        return result
+    if not fault.should_fire():
+        return result
+    out = result.copy()
+    out.ravel()[0] ^= 1
+    return out
+
+
+def maybe_sdc_serving(replica_id, outputs):
+    """Flip one low bit in the victim replica's prediction OUTPUT (kind
+    ``sdc_serving``; ``MXNET_TPU_FAULT_REPLICA`` targeting, checked
+    before the fire window is consumed). ``outputs`` is the Predictor
+    ``predict_raw`` result ``(list of arrays, n_rows)``. Unlike
+    ``replica_nan_storm`` the answer stays finite — wrong in a way only
+    the golden-query audit (``integrity.audit_serving``) can detect."""
+    if not _ACTIVE:
+        return outputs
+    fault = _ACTIVE.get("sdc_serving")
+    if fault is None or int(replica_id) != _fault_replica_target():
+        return outputs
+    outs, n = outputs
+    flipped = _flip_first_float(
+        {str(i): a for i, a in enumerate(outs)}, "sdc_serving")
+    if not fault.should_fire():
+        return outputs
+    return [flipped[str(i)] for i in range(len(outs))], n
+
+
+def maybe_preempt():
+    """When ``preempt`` fires, return True once: a simulated preemption
+    notice observed at the step boundary — the trainer must finish the
+    step, publish an emergency checkpoint, and raise
+    ``integrity.Preempted`` (the drillable twin of the SIGTERM trap)."""
+    if not _ACTIVE:
+        return False
+    fault = _ACTIVE.get("preempt")
+    return fault is not None and fault.should_fire()
 
 
 def maybe_kv_pool_exhaustion(available):

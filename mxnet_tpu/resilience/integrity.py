@@ -1,0 +1,676 @@
+"""Silent-data-corruption (SDC) defense: fingerprints, audits, quarantine.
+
+Every other fault the runtime survives is *loud* — crashes, hangs, OOMs,
+dead peers, torn checkpoints. A defective chip that silently computes
+wrong numbers corrupts weights, checkpoints, and served answers without
+tripping any of those detectors. This module closes that class with
+three cooperating layers (docs/integrity.md):
+
+1. **Step fingerprints** — a deterministic bit-exact fold (algorithm
+   ``xsf32-v1``: per-leaf wrapping sum + wrapping square-sum over the
+   raw uint32 words, xor-mixed, combined over sorted names) over the
+   step's post-update parameters and gradients. The fold uses only commutative exact integer ops, so
+   the same logical values produce the same 32-bit fingerprint on any
+   mesh topology, any reduction order, eager or compiled — it is
+   compiled as ONE extra scalar output of the captured step (zero extra
+   executables) and computable host-side for free comparison.
+2. **Shadow replay audit** — on a cadence
+   (``MXNET_TPU_INTEGRITY_AUDIT_EVERY``) the pre-step state is retained
+   on host and the step is re-executed on a *rotated* same-shape mesh
+   (same axis names and extents, different physical device assignment:
+   same GSPMD collective structure, bitwise-equal outputs). A
+   fingerprint mismatch means one execution lied. Attribution runs a
+   known-answer integer-GEMM self-test battery per device: a failing
+   device is sticky-quarantined and excised through the existing
+   mesh-shrink + reshardable-restore path (``PeerLostError`` →
+   ``ShardedTrainer._recover_peer_loss``); if every device passes, the
+   corruption was transient — the step rolls back to the retained
+   snapshot and re-runs.
+3. **Boundary checks** — checkpoint manifests carry the parameter-state
+   fingerprint and restores verify it before mutating the trainer
+   (resilience/checkpoint.py); serving replicas are audited with
+   golden-query known-answer checks that walk a lying replica through
+   the fleet's DRAINING → DEAD → RESTARTING machinery
+   (``audit_serving``).
+
+Preemption grace also lives here (``install_preempt_handler`` /
+``request_preempt``): SIGTERM finishes the in-flight step, fires an
+emergency async checkpoint, and exits cleanly (``Preempted``), drilled
+as the ``preempt`` fault kind.
+
+Fingerprinting is OFF by default (the seed step programs are bitwise
+unchanged); it arms via ``MXNET_TPU_INTEGRITY_FINGERPRINT=1`` or
+implicitly whenever the audit cadence is set.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import zlib
+
+from ..observability import flight as _obs_flight
+from ..observability import metrics as _obs_metrics
+
+__all__ = [
+    "ALGO", "fingerprint_enabled", "audit_every", "audit_due",
+    "fold_host", "fold_tree", "step_fold", "step_fold_host",
+    "net_named_state", "note_fingerprint_step", "state_fingerprint",
+    "manifest_fingerprint", "verify_manifest_fingerprint",
+    "snapshot_step",
+    "audit_step",
+    "device_selftest", "quarantine_device", "quarantined_devices",
+    "clear_quarantine", "audit_serving", "Preempted", "request_preempt",
+    "preempt_requested", "clear_preempt", "install_preempt_handler",
+    "preempt_exit", "stats", "reset_stats", "reset_state",
+]
+
+ALGO = "xsf32-v1"
+
+# fold constants: FNV-1a offset seed, string-hash multiplier for the
+# ordered combine, Knuth multiplicative constant mixing the wrapping sum
+_FOLD_SEED = 2166136261
+_FOLD_MUL = 1000003
+_DIGEST_MUL = 2654435761
+_MASK = 0xFFFFFFFF
+
+_STATS = {
+    "integrity_fingerprint_steps": 0,
+    "integrity_audits": 0,
+    "integrity_audit_skipped": 0,
+    "integrity_audit_mismatches": 0,
+    "integrity_selftests": 0,
+    "integrity_selftest_failures": 0,
+    "integrity_quarantined": 0,
+    "integrity_rollbacks": 0,
+    "integrity_unattributed": 0,
+    "integrity_ckpt_fingerprints": 0,
+    "integrity_ckpt_verified": 0,
+    "integrity_ckpt_mismatches": 0,
+    "integrity_serving_audits": 0,
+    "integrity_serving_failures": 0,
+    "integrity_preempt_requests": 0,
+    "integrity_preempt_exits": 0,
+}
+
+_MET_AUDITS = _obs_metrics.counter(
+    "mxnet_tpu_integrity_audits",
+    "shadow replay audits completed (training steps re-executed on a "
+    "rotated mesh and fingerprint-compared)")
+_MET_MISMATCHES = _obs_metrics.counter(
+    "mxnet_tpu_integrity_mismatches",
+    "fingerprint mismatches detected, across audit/checkpoint/serving "
+    "surfaces", labels=("surface",))
+_MET_QUARANTINED = _obs_metrics.gauge(
+    "mxnet_tpu_integrity_quarantined",
+    "devices currently in the sticky SDC quarantine set")
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+# -------------------------------------------------------------------- knobs
+
+def fingerprint_enabled():
+    """Is the in-graph step fingerprint armed?
+    (``MXNET_TPU_INTEGRITY_FINGERPRINT``; defaults to on whenever the
+    audit cadence is set — an audit without fingerprints is blind.)"""
+    v = os.environ.get("MXNET_TPU_INTEGRITY_FINGERPRINT")
+    if v is not None:
+        return v.strip().lower() not in ("", "0", "false", "off")
+    return audit_every() > 0
+
+
+def audit_every():
+    """Shadow-replay cadence in steps (``MXNET_TPU_INTEGRITY_AUDIT_
+    EVERY``; 0 = audits off)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_INTEGRITY_AUDIT_EVERY", "0"))
+    except ValueError:
+        return 0
+
+
+def audit_due(step_no):
+    every = audit_every()
+    return every > 0 and int(step_no) % every == 0
+
+
+def _selftest_rounds():
+    try:
+        return max(1, int(os.environ.get(
+            "MXNET_TPU_INTEGRITY_SELFTEST_N", "3")))
+    except ValueError:
+        return 3
+
+
+# ------------------------------------------------------------- xsf32-v1 fold
+#
+# Per leaf: reinterpret the raw bits as uint32 words; digest =
+# sum(words) ^ (sum(words*words) * 2654435761), all mod 2^32. Two
+# independent wrapping-sum channels (a plain sum and a square-sum) catch
+# any single flipped word and virtually all multi-word corruption; both
+# are commutative, associative, and exact, so the digest is independent
+# of reduction order — the property that makes one fingerprint hold
+# across eager/captured execution, sharded/replicated layouts, and dp=8
+# vs dp=4 meshes of the same logical state. (Sum-only reductions also
+# partition under GSPMD on every backend; an xor ALL-REDUCE does not —
+# the xor here mixes two already-reduced replicated scalars.) Leaves
+# combine in sorted-name order: acc = acc*1000003 + digest + crc32(name)
+# (mod 2^32) — names are static so the combine stays exact in-graph too.
+
+def _sorted_named(named):
+    items = named.items() if hasattr(named, "items") else named
+    return sorted((str(k), v) for k, v in items)
+
+
+def _np_words(arr):
+    """Host path: the leaf's raw bits as a flat uint32 array."""
+    import numpy as np
+
+    a = np.asarray(arr)
+    if a.dtype == np.bool_:
+        return a.astype(np.uint32).ravel()
+    flat = np.ascontiguousarray(a).ravel()
+    size = flat.dtype.itemsize
+    if size == 4:
+        return flat.view(np.uint32)
+    if size == 2:
+        return flat.view(np.uint16).astype(np.uint32)
+    if size == 1:
+        return flat.view(np.uint8).astype(np.uint32)
+    if size == 8:
+        return flat.view(np.uint32)  # two words per element
+    raise TypeError(f"xsf32-v1 cannot fold dtype {a.dtype}")
+
+
+def _digest_host(arr):
+    import numpy as np
+
+    words = _np_words(arr)
+    if words.size == 0:
+        return 0
+    # force the uint32 accumulator: numpy would otherwise sum in uint64
+    # and diverge from the traced fold's wrapping 32-bit sums
+    s1 = int(np.sum(words, dtype=np.uint32))
+    s2 = int(np.sum(words * words, dtype=np.uint32))
+    return (s1 ^ ((s2 * _DIGEST_MUL) & _MASK)) & _MASK
+
+
+def fold_host(named):
+    """Fingerprint of ``{name: array}`` computed host-side (numpy).
+    Bit-identical to :func:`fold_tree` of the same logical values."""
+    acc = _FOLD_SEED
+    for name, arr in _sorted_named(named):
+        acc = (acc * _FOLD_MUL + _digest_host(arr)
+               + zlib.crc32(name.encode("utf-8"))) & _MASK
+    return acc
+
+
+def _jnp_words(arr):
+    """Traced path: the leaf's raw bits as a flat uint32 array."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    import numpy as np
+
+    if arr.dtype == jnp.bool_:
+        return arr.astype(jnp.uint32).ravel()
+    size = np.dtype(arr.dtype).itemsize
+    if size == 4:
+        return lax.bitcast_convert_type(arr, jnp.uint32).ravel()
+    if size == 2:
+        return lax.bitcast_convert_type(
+            arr, jnp.uint16).astype(jnp.uint32).ravel()
+    if size == 1:
+        return lax.bitcast_convert_type(
+            arr, jnp.uint8).astype(jnp.uint32).ravel()
+    raise TypeError(f"xsf32-v1 cannot fold dtype {arr.dtype} in-graph")
+
+
+def fold_tree(named):
+    """Traced fingerprint of ``{name: jax array}`` — a uint32 scalar
+    computable as an extra output of a compiled step. Exact integer
+    reductions only, so eager/compiled/sharded all agree bitwise with
+    :func:`fold_host`."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    acc = jnp.uint32(_FOLD_SEED)
+    for name, arr in _sorted_named(named):
+        words = _jnp_words(jnp.asarray(arr))
+        if words.size == 0:
+            digest = jnp.uint32(0)
+        else:
+            s1 = jnp.sum(words, dtype=jnp.uint32)
+            s2 = jnp.sum(words * words, dtype=jnp.uint32)
+            digest = lax.bitwise_xor(s1, s2 * jnp.uint32(_DIGEST_MUL))
+        acc = (acc * jnp.uint32(_FOLD_MUL) + digest
+               + jnp.uint32(zlib.crc32(name.encode("utf-8"))))
+    return acc
+
+
+def step_fold(new_params, grads):
+    """The step fingerprint, traced: post-update params + gradients."""
+    named = {f"param:{k}": v for k, v in new_params.items()}
+    named.update({f"grad:{k}": v for k, v in grads.items()})
+    return fold_tree(named)
+
+
+def step_fold_host(new_params, grads):
+    """Host-side twin of :func:`step_fold` (the accumulated path, the
+    eager kill-switch path, and tests compute it here)."""
+    named = {f"param:{k}": v for k, v in new_params.items()}
+    named.update({f"grad:{k}": v for k, v in grads.items()})
+    return fold_host(named)
+
+
+def net_named_state(net):
+    """``(params, grads)`` name->array dicts of a gluon net's CURRENT
+    values (post-update params + per-parameter grads) — the operand set
+    of the captured-step fingerprint. One naming walk shared by the
+    traced fold inside the captured program, the eager kill-switch
+    path, and the determinism tests, so all three fold identical
+    operands."""
+    named_p = {}
+    named_g = {}
+    for name, p in net.collect_params().items():
+        try:
+            named_p[name] = p.data()._data
+        except Exception:
+            continue  # deferred/uninitialized parameter
+        if getattr(p, "grad_req", "null") == "null":
+            continue
+        try:
+            grads = p.list_grad()
+        except Exception:
+            continue
+        for j, g in enumerate(grads):
+            named_g[name if j == 0 else f"{name}:{j}"] = g.data_
+    return named_p, named_g
+
+
+def note_fingerprint_step():
+    """Count one step that carried an in-graph fingerprint output."""
+    _STATS["integrity_fingerprint_steps"] += 1
+
+
+def state_fingerprint(params):
+    """Fingerprint of a parameter state ``{name: array}`` alone —
+    topology-independent (recorded in checkpoint manifests, compared
+    across mesh shrinks, and between live and shadow-replay params)."""
+    return fold_host({f"param:{k}": v for k, v in params.items()})
+
+
+def manifest_fingerprint(params):
+    """The checkpoint-manifest integrity record of a parameter state:
+    ``{"algo": ALGO, "params": <uint32>}`` (resilience/checkpoint.py
+    stores it; :func:`verify_manifest_fingerprint` checks it on
+    restore)."""
+    fp = state_fingerprint(params)
+    _STATS["integrity_ckpt_fingerprints"] += 1
+    return {"algo": ALGO, "params": int(fp)}
+
+
+def verify_manifest_fingerprint(record, params):
+    """Does a restore's reassembled parameter state match the manifest's
+    recorded fingerprint? Records with an unknown algo (or none) verify
+    trivially — a future fold revision must not brick old checkpoints.
+    Counts and flight-records a mismatch; the caller decides whether to
+    raise (checkpoint restore treats it as corruption and falls back)."""
+    if not record or record.get("algo") != ALGO \
+            or record.get("params") is None:
+        return True
+    got = int(state_fingerprint(params))
+    if got == int(record["params"]):
+        _STATS["integrity_ckpt_verified"] += 1
+        return True
+    _STATS["integrity_ckpt_mismatches"] += 1
+    _MET_MISMATCHES.inc(surface="checkpoint")
+    _obs_flight.record("integrity", op="ckpt_mismatch",
+                       want=int(record["params"]), got=got)
+    return False
+
+
+# --------------------------------------------------------------- quarantine
+
+_QUARANTINE_LOCK = threading.Lock()
+_QUARANTINE: set = set()
+
+
+def quarantine_device(device_id, reason="selftest_failed"):
+    """Add a device to the sticky quarantine set. Quarantine survives
+    mesh shrinks and retries within the process — a chip that lied once
+    is never trusted again without operator intervention."""
+    device_id = int(device_id)
+    with _QUARANTINE_LOCK:
+        new = device_id not in _QUARANTINE
+        if new:
+            _QUARANTINE.add(device_id)
+            _STATS["integrity_quarantined"] += 1
+            _MET_QUARANTINED.set(len(_QUARANTINE))
+    if new:
+        _obs_flight.record("integrity", op="quarantine",
+                           device=device_id, reason=reason)
+
+
+def quarantined_devices():
+    with _QUARANTINE_LOCK:
+        return sorted(_QUARANTINE)
+
+
+def clear_quarantine():
+    with _QUARANTINE_LOCK:
+        _QUARANTINE.clear()
+        _MET_QUARANTINED.set(0)
+
+
+# ---------------------------------------------------------------- self-test
+
+def device_selftest(device, rounds=None):
+    """Known-answer self-test battery for ONE device: deterministic
+    int32 GEMMs whose exact product is computed on host. Integer matmul
+    has a single correct answer (no float reduction-order slack), so any
+    deviation is hardware corruption, not numerics. Returns True when
+    every round matches. The ``sdc_device_sticky`` fault corrupts the
+    victim device's result here, which is what lets the chaos drill
+    prove attribution without real broken silicon."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import faults as _faults
+
+    _STATS["integrity_selftests"] += 1
+    rounds = _selftest_rounds() if rounds is None else int(rounds)
+    n = 64
+    ok = True
+    for i in range(rounds):
+        # values bounded to +/-125 so 64-term int32 dot products can
+        # never overflow: golden host answer == exact device answer
+        a = ((np.arange(n * n, dtype=np.int64) * (3 * i + 7)) % 251
+             - 125).astype(np.int32).reshape(n, n)
+        b = ((np.arange(n * n, dtype=np.int64)[::-1] * (5 * i + 11)) % 241
+             - 120).astype(np.int32).reshape(n, n)
+        want = a @ b
+        got = np.asarray(jnp.matmul(jax.device_put(a, device),
+                                    jax.device_put(b, device)))
+        got = _faults.maybe_sdc_selftest(got, int(device.id))
+        if not np.array_equal(got, want):
+            ok = False
+            break
+    if not ok:
+        _STATS["integrity_selftest_failures"] += 1
+        _obs_flight.record("integrity", op="selftest_failed",
+                           device=int(device.id))
+    return ok
+
+
+# ------------------------------------------------- shadow replay audit core
+
+def snapshot_step(trainer, x, y):
+    """Retain the pre-step state on host when an audit is due for the
+    step about to run (called by ``ShardedTrainer._step_impl`` after the
+    step counter advanced, before execution). Returns the snapshot dict
+    the matching :func:`audit_step` consumes, or None when no audit is
+    due. Multi-process meshes are skipped: the global state is not
+    fully addressable from one host (counted, never silent)."""
+    if not audit_due(getattr(trainer, "_step_count", 0)):
+        return None
+    if getattr(trainer, "_multiproc", False):
+        _STATS["integrity_audit_skipped"] += 1
+        return None
+    import numpy as np
+
+    import jax
+
+    return {
+        "step": int(trainer._step_count),
+        "params": {k: np.asarray(v) for k, v in trainer.params.items()},
+        "aux": {k: np.asarray(v) for k, v in trainer.aux.items()},
+        "opt": jax.tree.map(np.asarray, trainer.opt_state),
+        "x": np.asarray(x),
+        "y": np.asarray(y),
+        "retries": 0,
+    }
+
+
+def _shadow_mesh(mesh):
+    """A same-shape mesh on a different physical device assignment:
+    prefer a disjoint slice of the unused devices, else rotate the full
+    device list by one. Same axis names and extents means the replayed
+    program has the identical GSPMD collective structure — bitwise-equal
+    outputs — while every logical position computes on different
+    hardware, so a sticky chip cannot corrupt both executions the same
+    way."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    flat = list(mesh.devices.flat)
+    all_devs = list(jax.devices())
+    used = {d.id for d in flat}
+    unused = [d for d in all_devs if d.id not in used]
+    if len(unused) >= len(flat):
+        new = unused[:len(flat)]
+    elif len(all_devs) > 1:
+        index = {d.id: i for i, d in enumerate(all_devs)}
+        new = [all_devs[(index[d.id] + 1) % len(all_devs)] for d in flat]
+    else:
+        new = flat  # single device: replay still catches transients
+    arr = np.asarray(new).reshape(mesh.devices.shape)
+    return Mesh(arr, mesh.axis_names)
+
+
+def _restore_snapshot(trainer, snap):
+    """Re-place the retained pre-step state onto the trainer's CURRENT
+    mesh shardings (the transient-SDC rollback)."""
+    import jax
+
+    trainer.params = {
+        k: jax.device_put(v, trainer._param_sharding[k])
+        for k, v in snap["params"].items()}
+    trainer.aux = {
+        k: jax.device_put(v, trainer._aux_sharding[k])
+        for k, v in snap["aux"].items()}
+    trainer.opt_state = jax.tree.map(
+        jax.device_put, snap["opt"], trainer._opt_sharding())
+
+
+def audit_step(trainer, snap, n=1, length=None, live_fp=None):
+    """The shadow replay audit: re-execute the snapshotted step on a
+    rotated mesh and compare fingerprints. Returns None (clean or no
+    snapshot), or ``"retry"`` after a transient-corruption rollback (the
+    caller re-runs the step); raises ``watchdog.PeerLostError`` naming
+    the quarantined rank(s) when a device fails the known-answer
+    self-test — the existing mesh-shrink recovery excises it."""
+    if snap is None:
+        return None
+    import numpy as np
+
+    _STATS["integrity_audits"] += 1
+    _MET_AUDITS.inc()
+    shadow = _shadow_mesh(trainer.mesh)
+    replay_params, replay_fp = trainer.integrity_replay(
+        shadow, snap["params"], snap["aux"], snap["opt"],
+        snap["x"], snap["y"], microbatches=n, length=length)
+    live_state = state_fingerprint(trainer.params)
+    shadow_state = state_fingerprint(
+        {k: np.asarray(v) for k, v in replay_params.items()})
+    ok = live_state == shadow_state
+    if ok and live_fp is not None and replay_fp is not None:
+        ok = int(np.asarray(live_fp)) == int(np.asarray(replay_fp))
+    step_no = int(getattr(trainer, "_step_count", snap["step"]))
+    _obs_flight.record("integrity", op="audit", step=step_no,
+                       match=bool(ok))
+    if ok:
+        return None
+    _STATS["integrity_audit_mismatches"] += 1
+    _MET_MISMATCHES.inc(surface="train")
+    _obs_flight.record("integrity", op="mismatch", step=step_no,
+                       live=live_state, shadow=shadow_state)
+    # attribution: known-answer battery over every primary-mesh device
+    from . import watchdog as _watchdog
+
+    flat = list(trainer.mesh.devices.flat)
+    bad = [(rank, dev) for rank, dev in enumerate(flat)
+           if not device_selftest(dev)]
+    if bad:
+        for rank, dev in bad:
+            quarantine_device(int(dev.id))
+            _watchdog.mark_peer_dead(rank)
+        err = _watchdog.PeerLostError(
+            f"integrity audit at step {step_no}: device(s) "
+            f"{[int(d.id) for _, d in bad]} failed the known-answer "
+            "self-test and are quarantined; excise via mesh shrink")
+        err.ranks = tuple(rank for rank, _ in bad)
+        raise err
+    # every device passes: transient corruption — roll back and re-run
+    snap["retries"] += 1
+    if snap["retries"] > 2:
+        _STATS["integrity_unattributed"] += 1
+        _obs_flight.record("integrity", op="unattributed", step=step_no)
+        return None
+    _restore_snapshot(trainer, snap)
+    _STATS["integrity_rollbacks"] += 1
+    _obs_flight.record("integrity", op="rollback", step=step_no)
+    return "retry"
+
+
+# ------------------------------------------------------------ serving audit
+
+def audit_serving(fleet, feeds, golden, model="default", timeout=10.0):
+    """Golden-query known-answer audit: submit ``feeds`` to every
+    HEALTHY replica directly (bypassing the router, so each replica's
+    own answer is attributable) and compare against ``golden`` (the
+    list of expected output arrays a known-good replica produced for
+    ``feeds``) bitwise. A lying replica is walked through the fleet's
+    DRAINING → DEAD → RESTARTING machinery via
+    ``fail_replica(reason="integrity_audit")``. Returns the list of
+    failed replica ids."""
+    import numpy as np
+
+    _STATS["integrity_serving_audits"] += 1
+    golden = [np.asarray(v) for v in golden]
+    failed = []
+    for replica in list(fleet.replicas(model)):
+        if getattr(replica, "state", None) != "HEALTHY":
+            continue
+        rid = int(replica.rid)
+        try:
+            out = replica.submit(feeds).result(timeout=timeout)
+        except Exception:
+            # loud failures are the probe loop's jurisdiction; the
+            # integrity audit hunts silent wrong answers only
+            continue
+        out = [np.asarray(v) for v in out]
+        clean = (len(out) == len(golden)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(out, golden)))
+        if clean:
+            continue
+        failed.append(rid)
+        _STATS["integrity_serving_failures"] += 1
+        _MET_MISMATCHES.inc(surface="serving")
+        _obs_flight.record("integrity", op="serving_mismatch",
+                           model=model, replica=rid)
+        fleet.fail_replica(rid=rid, model=model, reason="integrity_audit")
+    return failed
+
+
+# --------------------------------------------------------- preemption grace
+
+class Preempted(SystemExit):
+    """Clean preemption exit (code 0): the in-flight step finished, the
+    emergency checkpoint was published, and the trainer drained."""
+
+    def __init__(self, step, manifest=None):
+        super().__init__(0)
+        self.step = int(step)
+        self.manifest = manifest
+
+
+_PREEMPT = threading.Event()
+_PREV_SIGTERM = None
+_HANDLER_LOCK = threading.Lock()
+_HANDLER_INSTALLED = False
+
+
+def request_preempt(reason="sigterm"):
+    """Note a preemption notice: the NEXT step boundary finishes the
+    in-flight work, checkpoints, and raises :class:`Preempted`."""
+    if not _PREEMPT.is_set():
+        _STATS["integrity_preempt_requests"] += 1
+        _obs_flight.record("integrity", op="preempt_requested",
+                           reason=reason)
+    _PREEMPT.set()
+
+
+def preempt_requested():
+    return _PREEMPT.is_set()
+
+
+def clear_preempt():
+    _PREEMPT.clear()
+
+
+def install_preempt_handler():
+    """Trap SIGTERM so preemption drains instead of killing mid-step
+    (``MXNET_TPU_PREEMPT_SIGTERM``, default on). Idempotent; chains any
+    previously installed handler; silently skipped off the main thread
+    (signal handlers cannot be installed elsewhere)."""
+    global _PREV_SIGTERM, _HANDLER_INSTALLED
+
+    if os.environ.get("MXNET_TPU_PREEMPT_SIGTERM", "1").strip().lower() \
+            in ("0", "false", "off"):
+        return False
+    with _HANDLER_LOCK:
+        if _HANDLER_INSTALLED:
+            return True
+
+        def _on_sigterm(signum, frame):
+            request_preempt(reason="sigterm")
+            prev = _PREV_SIGTERM
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        try:
+            _PREV_SIGTERM = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # not the main thread
+            return False
+        _HANDLER_INSTALLED = True
+        return True
+
+
+def preempt_exit(trainer, loss=None):
+    """Finish preemption at a step boundary: fire an emergency async
+    checkpoint (published before exit), record the drain, and raise
+    :class:`Preempted`. Called by ``ShardedTrainer._step_impl`` when a
+    preemption notice (SIGTERM or the ``preempt`` fault) is pending."""
+    step = int(getattr(trainer, "_step_count", 0))
+    manifest = None
+    mgr = getattr(trainer, "_ckpt_mgr", None)
+    if mgr is not None:
+        manifest = mgr.save(step, trainer=trainer, async_=True)
+        mgr.wait_for_async()
+    _STATS["integrity_preempt_exits"] += 1
+    _obs_flight.record("integrity", op="preempt_exit", step=step,
+                       checkpointed=mgr is not None)
+    clear_preempt()
+    raise Preempted(step, manifest)
+
+
+def reset_state():
+    """Forget quarantine + preemption bookkeeping (tests/drills)."""
+    clear_quarantine()
+    clear_preempt()

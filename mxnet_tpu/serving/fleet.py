@@ -230,7 +230,10 @@ class _ReplicaFaultProxy:
         _faults.maybe_replica_crash(self._rid)
         _faults.maybe_replica_hang(self._rid)
         feeds = _faults.maybe_replica_nan_storm(self._rid, feeds)
-        return self._inner.predict_raw(feeds)
+        # sdc_serving corrupts the OUTPUT silently (no crash, no NaN
+        # storm): only the integrity golden-query audit can catch it
+        return _faults.maybe_sdc_serving(
+            self._rid, self._inner.predict_raw(feeds))
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -546,6 +546,15 @@ RESILIENCE_KEYS = frozenset({
     "elastic_mesh_shrinks",
     # dataloader (PR 2 counter, surfaced this PR)
     "dataloader_respawns",
+    # integrity / SDC defense (PR 20)
+    "integrity_fingerprint_steps", "integrity_audits",
+    "integrity_audit_skipped", "integrity_audit_mismatches",
+    "integrity_selftests", "integrity_selftest_failures",
+    "integrity_quarantined", "integrity_rollbacks",
+    "integrity_unattributed", "integrity_ckpt_fingerprints",
+    "integrity_ckpt_verified", "integrity_ckpt_mismatches",
+    "integrity_serving_audits", "integrity_serving_failures",
+    "integrity_preempt_requests", "integrity_preempt_exits",
 })
 
 

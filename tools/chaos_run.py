@@ -63,7 +63,9 @@ FAST_KINDS = ("nan_grad", "nan_serving", "ckpt_enospc",
               "perf_regression", "slo_burn", "step_time_anomaly",
               "record_corrupt", "nonfinite_grad", "rollout_bad_weights",
               "canary_slo_regression", "autoscale_flap",
-              "decode_replica_death", "kv_pool_exhaustion")
+              "decode_replica_death", "kv_pool_exhaustion",
+              "sdc_bitflip_param", "sdc_bitflip_grad",
+              "sdc_device_sticky", "sdc_serving", "preempt")
 
 # Flight-recorder contract (docs/observability.md): every drill must
 # leave a matching event trail — a drill whose injection leaves no
@@ -78,6 +80,19 @@ EXPECTED_FLIGHT_EVENTS = {
     "capture_step": (("fault", "fault", "nan_grad"),
                      ("fault", "fault", "hang_step")),
     "ckpt_async_crash": (("ckpt", "op", "async_failed"),),
+    # the SDC drills must leave the DETECTION trail too, not just the
+    # injection: a fault that fired but was never caught is the exact
+    # regression this defense exists to prevent
+    "sdc_bitflip_param": (("fault", "fault", "sdc_bitflip_param"),
+                          ("integrity", "op", "rollback")),
+    "sdc_bitflip_grad": (("fault", "fault", "sdc_bitflip_grad"),
+                         ("integrity", "op", "rollback")),
+    "sdc_device_sticky": (("fault", "fault", "sdc_device_sticky"),
+                          ("integrity", "op", "quarantine")),
+    "sdc_serving": (("fault", "fault", "sdc_serving"),
+                    ("integrity", "op", "serving_mismatch")),
+    "preempt": (("fault", "fault", "preempt"),
+                ("integrity", "op", "preempt_exit")),
 }
 
 
@@ -1545,6 +1560,263 @@ def _drill_kv_pool_exhaustion(mx, workdir):
         bat.close()
 
 
+# ------------------------------------------------ SDC / integrity drills
+
+def _sdc_build_trainer(mx, seed, prefix, mesh_devs, dp, mgr=None):
+    """A small sharded trainer with a FIXED prefix and seed, so a second
+    build (the bitwise oracle) gets identical param names and init."""
+    import jax
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(4, in_units=4, prefix=prefix)
+    net.initialize()
+    return ShardedTrainer(net, lambda p, l: ((p - l) ** 2),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1},
+                          mesh=create_mesh({"dp": dp},
+                                           (mesh_devs
+                                            or jax.devices())[:dp]),
+                          checkpoint_manager=mgr)
+
+
+def _host_params(trainer):
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in trainer.params.items()}
+
+
+def _params_equal(got, want):
+    import numpy as np
+
+    return (sorted(got) == sorted(want)
+            and all(np.array_equal(got[k], want[k]) for k in got))
+
+
+def _drill_sdc_transient(mx, workdir, kind):
+    """Transient SDC (kinds ``sdc_bitflip_param`` / ``sdc_bitflip_grad``):
+    one finite low-mantissa-bit flip in the post-step weights (fused
+    path) or the accumulated gradient (microbatches=2 path) that no NaN
+    sentinel can see. The shadow replay audit mismatches, every device
+    passes the known-answer self-test (so NO quarantine), the step rolls
+    back to the retained snapshot and re-runs — the final params are
+    bitwise-equal to an un-faulted oracle run."""
+    import numpy as np
+
+    from mxnet_tpu.resilience import faults, integrity
+
+    # the audit compiles replay executables inside the guarded step
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "120"
+    saved = os.environ.get("MXNET_TPU_INTEGRITY_AUDIT_EVERY")
+    os.environ["MXNET_TPU_INTEGRITY_AUDIT_EVERY"] = "1"
+    accum = kind == "sdc_bitflip_grad"
+    n = 2 if accum else None
+    x = np.arange(64, dtype=np.float32).reshape(16, 4) / 64
+    y = np.ones((16, 4), np.float32)
+    try:
+        before = integrity.stats()
+        oracle = _sdc_build_trainer(mx, 17, "sdc_net_", None, 4)
+        for _ in range(2):
+            oracle.step(x, y, microbatches=n)
+        want = _host_params(oracle)
+        trainer = _sdc_build_trainer(mx, 17, "sdc_net_", None, 4)
+        with faults.inject(kind, times=1) as f:
+            trainer.step(x, y, microbatches=n)   # corrupt -> rollback
+        trainer.step(x, y, microbatches=n)       # clean audited step
+        bitwise = _params_equal(_host_params(trainer), want)
+        d = {k: integrity.stats()[k] - before[k] for k in before}
+        ok = (f.fired == 1 and bitwise
+              and d["integrity_audit_mismatches"] >= 1
+              and d["integrity_rollbacks"] >= 1
+              and d["integrity_quarantined"] == 0
+              and not integrity.quarantined_devices())
+        return ok, (f"fired={f.fired} bitwise={bitwise} "
+                    f"mismatches={d['integrity_audit_mismatches']} "
+                    f"rollbacks={d['integrity_rollbacks']} "
+                    f"quarantined={integrity.quarantined_devices()}")
+    finally:
+        if saved is None:
+            os.environ.pop("MXNET_TPU_INTEGRITY_AUDIT_EVERY", None)
+        else:
+            os.environ["MXNET_TPU_INTEGRITY_AUDIT_EVERY"] = saved
+
+
+def _drill_sdc_device_sticky(mx, workdir):
+    """The end-to-end SDC gate: a sticky lying device corrupts every
+    step while it participates in the mesh. The audit mismatches, the
+    known-answer battery names exactly that chip, it is
+    sticky-quarantined and excised through the existing mesh-shrink +
+    reshardable-restore recovery (dp 4 -> 2); corruption stops the
+    moment the quarantine takes effect, training resumes bitwise
+    against an oracle trained on the shrunk mesh from the same
+    checkpoint, and the ``sdc_detected`` alert opens an incident from
+    the mismatch counters."""
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.observability import alerts
+    from mxnet_tpu.resilience import CheckpointManager, faults, integrity
+
+    if len(jax.devices()) < 4:
+        return False, "needs >= 4 devices (xla_force_host_platform_device_count)"
+    # recovery recompiles the step on the shrunk mesh inside the guarded
+    # scope — the deadline must cover compile time, not just execution
+    os.environ["MXNET_TPU_WATCHDOG_STEP_TIMEOUT"] = "120"
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_TPU_INTEGRITY_AUDIT_EVERY", "MXNET_TPU_FAULT_DEVICE")}
+    os.environ["MXNET_TPU_INTEGRITY_AUDIT_EVERY"] = "1"
+    os.environ["MXNET_TPU_FAULT_DEVICE"] = "0"
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    alerts.reset()
+    prev_alerts = alerts.set_enabled(False)  # synthetic clock below
+    before = integrity.stats()
+    try:
+        mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+        trainer = _sdc_build_trainer(mx, 19, "sdc_sticky_net_",
+                                     jax.devices(), 4, mgr=mgr)
+        trainer.step(x, y)                   # clean audited step 1
+        mgr.save(1, trainer=trainer)
+        t = 1000.0
+        alerts.evaluate(now=t, force=True)   # clean counter baseline
+        with faults.inject("sdc_device_sticky", times=None) as f:
+            loss = trainer.step(x, y)  # corrupt -> quarantine -> shrink
+        t += 30.0
+        alerts.evaluate(now=t, force=True)
+        fired = [i for i in alerts.open_incidents()
+                 if i["rule"] == "sdc_detected"]
+        new_dp = int(trainer.mesh.shape.get("dp", 0))
+        live_ids = {int(d.id) for d in trainer.mesh.devices.flat}
+        trainer.step(x, y)                   # resumes on the survivors
+        got = _host_params(trainer)
+        # shrunk-mesh oracle: the same checkpoint restored onto a clean
+        # dp=2 mesh that excludes the victim, replaying steps 2..3
+        mgr2 = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+        oracle = _sdc_build_trainer(mx, 19, "sdc_sticky_net_",
+                                    jax.devices()[2:], 2, mgr=mgr2)
+        mgr2.restore_latest(trainer=oracle)
+        oracle.step(x, y)
+        oracle.step(x, y)
+        bitwise = _params_equal(got, _host_params(oracle))
+        d = {k: integrity.stats()[k] - before[k] for k in before}
+        ok = (f.fired >= 1 and np.isfinite(float(loss))
+              and new_dp == 2 and 0 not in live_ids
+              and integrity.quarantined_devices() == [0]
+              and d["integrity_selftest_failures"] >= 1
+              and d["integrity_quarantined"] == 1
+              and len(fired) == 1 and bitwise)
+        return ok, (f"dp 4->{new_dp} quarantined="
+                    f"{integrity.quarantined_devices()} bitwise={bitwise} "
+                    f"alert_open={len(fired) == 1} fired={f.fired}")
+    finally:
+        alerts.set_enabled(prev_alerts)
+        alerts.reset()
+        integrity.reset_state()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _drill_sdc_serving(mx, workdir):
+    """A replica silently serves wrong-but-finite answers (one low bit
+    flipped in its output — no NaN probe fires). The golden-query audit
+    names exactly the lying replica, walks it through the fleet's
+    DRAINING -> DEAD -> RESTARTING machinery, and the restarted replica
+    passes a fresh audit bitwise."""
+    import numpy as np
+
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults, integrity
+
+    saved = os.environ.get("MXNET_TPU_FAULT_REPLICA")
+    os.environ["MXNET_TPU_FAULT_REPLICA"] = "0"
+
+    def factory():
+        mx.random.seed(23)
+        net = mx.gluon.nn.Dense(4, in_units=3, prefix="sdc_fleet_net_")
+        net.initialize()
+        return serving.Predictor.from_block(
+            net, input_shapes={"data": (3,)}, batch_sizes=(2,))
+
+    serving.reset_stats()
+    before = integrity.stats()
+    try:
+        x = np.ones((1, 3), np.float32)
+        with serving.Fleet(factory, replicas=2, probe_interval_ms=50,
+                           breaker_k=2, retries=2, backoff_ms=1,
+                           breaker_cooldown_ms=100,
+                           server_kw={"batch_timeout_ms": 1.0}) as fleet:
+            fleet.wait_healthy(timeout=20)
+            # golden answers from the known-good replica (rid 1)
+            good = [r for r in fleet.replicas() if r.rid == 1][0]
+            golden = good.submit(x).result(timeout=10)
+            clean = integrity.audit_serving(fleet, x, golden)
+            with faults.inject("sdc_serving", times=None) as f:
+                failed = integrity.audit_serving(fleet, x, golden)
+            recovered = fleet.wait_healthy(timeout=20)
+            after = integrity.audit_serving(fleet, x, golden)
+        s = serving.stats()
+        d = {k: integrity.stats()[k] - before[k] for k in before}
+        ok = (clean == [] and failed == [0] and f.fired >= 1
+              and recovered and after == []
+              and d["integrity_serving_failures"] >= 1
+              and s["fleet_restarts"] >= 1)
+        return ok, (f"failed={failed} recovered={recovered} "
+                    f"after={after} restarts={s['fleet_restarts']} "
+                    f"fired={f.fired}")
+    finally:
+        if saved is None:
+            os.environ.pop("MXNET_TPU_FAULT_REPLICA", None)
+        else:
+            os.environ["MXNET_TPU_FAULT_REPLICA"] = saved
+
+
+def _drill_preempt(mx, workdir):
+    """A preemption notice (the drillable twin of the SIGTERM trap): the
+    trainer finishes the in-flight step, publishes an emergency async
+    checkpoint, and exits cleanly via ``integrity.Preempted``; a fresh
+    trainer restores exactly the drained state and resumes."""
+    import numpy as np
+
+    import jax
+    from mxnet_tpu.resilience import CheckpointManager, faults, integrity
+
+    before = integrity.stats()
+    mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+    trainer = _sdc_build_trainer(mx, 29, "preempt_net_",
+                                 jax.devices(), 2, mgr=mgr)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4) / 32
+    y = np.ones((8, 4), np.float32)
+    trainer.step(x, y)
+    caught = None
+    with faults.inject("preempt", times=1) as f:
+        try:
+            trainer.step(x, y)
+        except integrity.Preempted as e:
+            caught = e
+    want = _host_params(trainer)  # the drained (post-step-2) state
+    mgr2 = CheckpointManager(os.path.join(workdir, "ckpt"), keep_n=3)
+    resumed = _sdc_build_trainer(mx, 29, "preempt_net_",
+                                 jax.devices(), 2, mgr=mgr2)
+    manifest = mgr2.restore_latest(trainer=resumed)
+    bitwise = (manifest is not None
+               and _params_equal(_host_params(resumed), want))
+    resumed.step(x, y)            # training resumes past the drain
+    d = {k: integrity.stats()[k] - before[k] for k in before}
+    ok = (f.fired == 1 and caught is not None
+          and getattr(caught, "step", None) == 2
+          and getattr(caught, "code", 1) == 0
+          and manifest is not None and manifest["step"] == 2
+          and bitwise and d["integrity_preempt_exits"] >= 1
+          and not integrity.preempt_requested())
+    return ok, (f"fired={f.fired} step={getattr(caught, 'step', None)} "
+                f"restored={None if manifest is None else manifest['step']} "
+                f"bitwise={bitwise}")
+
+
 def _dispatch_drill(mx, kind, tmp):
     if kind == "nan_grad":
         return _drill_nan_grad(mx, tmp)
@@ -1603,6 +1875,14 @@ def _dispatch_drill(mx, kind, tmp):
         return _drill_decode_replica_death(mx, tmp)
     if kind == "kv_pool_exhaustion":
         return _drill_kv_pool_exhaustion(mx, tmp)
+    if kind in ("sdc_bitflip_param", "sdc_bitflip_grad"):
+        return _drill_sdc_transient(mx, tmp, kind)
+    if kind == "sdc_device_sticky":
+        return _drill_sdc_device_sticky(mx, tmp)
+    if kind == "sdc_serving":
+        return _drill_sdc_serving(mx, tmp)
+    if kind == "preempt":
+        return _drill_preempt(mx, tmp)
     raise ValueError(f"unknown chaos kind {kind!r}")
 
 
@@ -1613,7 +1893,7 @@ def run_kind(kind, workdir=None):
     flight-recorder event (docs/observability.md) — no silent
     injections."""
     from mxnet_tpu.observability import flight as _obs_flight
-    from mxnet_tpu.resilience import faults, watchdog
+    from mxnet_tpu.resilience import faults, integrity, watchdog
 
     mx = _mx()
     saved_env = {k: os.environ.get(k) for k in _ENV}
@@ -1621,6 +1901,7 @@ def run_kind(kind, workdir=None):
     faults.reset()
     watchdog.reset_peers()
     watchdog.reset_pod()
+    integrity.reset_state()
     tmp = workdir or tempfile.mkdtemp(prefix="chaos_")
     mark = _obs_flight.last_seq()
     try:
@@ -1637,6 +1918,7 @@ def run_kind(kind, workdir=None):
         faults.reset()
         watchdog.reset_peers()
         watchdog.reset_pod()
+        integrity.reset_state()
         for k, v in saved_env.items():
             if v is None:
                 os.environ.pop(k, None)

@@ -55,7 +55,13 @@ def launch_local(n, cmd, port=None, env_extra=None, kill_siblings=True,
     ``{"rank", "code", "stderr_tail"}`` for programmatic callers
     (None on success). ``kill_siblings=False`` keeps survivors running —
     the elastic-recovery drills need the job to outlive one rank's
-    death."""
+    death.
+
+    A SIGTERM delivered to the launcher (a scheduler preemption notice)
+    is forwarded to every live worker so each rank's in-process handler
+    (``mxnet_tpu.resilience.integrity``) can finish its in-flight step,
+    cut an emergency checkpoint and exit 0; ranks still alive after
+    ``grace`` seconds get SIGKILL."""
     import tempfile
     import time
 
@@ -65,6 +71,18 @@ def launch_local(n, cmd, port=None, env_extra=None, kill_siblings=True,
     launch_local.last_failure = None
     procs = []
     logs = []
+    preempt = {"deadline": None}
+
+    def _forward_sigterm(signum, frame):
+        preempt["deadline"] = time.monotonic() + grace
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+
+    try:
+        prev_handler = signal.signal(signal.SIGTERM, _forward_sigterm)
+    except ValueError:  # not the main thread — skip the trap
+        prev_handler = None
     try:
         for rank in range(n):
             env = dict(os.environ)
@@ -103,7 +121,8 @@ def launch_local(n, cmd, port=None, env_extra=None, kill_siblings=True,
                         for q in live:
                             q.send_signal(signal.SIGTERM)
                         term_deadline = time.monotonic() + grace
-            if term_deadline is not None and time.monotonic() > term_deadline:
+            deadline = term_deadline or preempt["deadline"]
+            if deadline is not None and time.monotonic() > deadline:
                 for q in live:
                     if q.poll() is None:
                         q.kill()
@@ -117,6 +136,11 @@ def launch_local(n, cmd, port=None, env_extra=None, kill_siblings=True,
                 f"{rc}; stderr tail:\n{tail}\n")
         return rc
     finally:
+        if prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_handler)
+            except ValueError:
+                pass
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
